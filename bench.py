@@ -1,0 +1,89 @@
+"""Benchmark: ResNet-50 training throughput (img/s) on one TPU chip.
+
+Mirrors the reference's headline number — train_imagenet.py ResNet-50,
+batch 32 (reference: docs/how_to/perf.md:179-188, P100 = 181.53 img/s).
+``vs_baseline`` is measured against that P100 figure (BASELINE.md).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+BASELINE_P100_IMG_S = 181.53
+BATCH = 32
+WARMUP = 3
+STEPS = 12
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import mxnet_tpu as mx
+    from mxnet_tpu.models import resnet
+    from mxnet_tpu.executor import _build_graph_runner
+    from __graft_entry__ import _build_params
+
+    sym = resnet.get_symbol(num_classes=1000, num_layers=50,
+                            image_shape="3,224,224")
+    shapes = {"data": (BATCH, 3, 224, 224), "softmax_label": (BATCH,)}
+    runner, arg_names, aux_names, loss_mask = _build_graph_runner(sym)
+    args, aux = _build_params(sym, shapes)
+    rng_np = np.random.RandomState(0)
+    args["data"] = jnp.asarray(
+        rng_np.rand(*shapes["data"]).astype(np.float32))
+    args["softmax_label"] = jnp.asarray(
+        (rng_np.rand(BATCH) * 1000).astype(np.float32))
+    param_names = [nm for nm in arg_names if nm not in shapes]
+    momenta = {nm: jnp.zeros_like(args[nm]) for nm in param_names}
+    lr, mom = 0.1, 0.9
+
+    def train_step(arg_vals, aux_vals, mom_vals, rng):
+        """Full training step: fwd+bwd+SGD-momentum in ONE XLA program."""
+        watched = {nm: arg_vals[nm] for nm in param_names}
+        rest = {nm: arg_vals[nm] for nm in shapes}
+
+        def f(w):
+            outs, new_aux = runner({**rest, **w}, aux_vals, True, rng)
+            return outs, new_aux
+
+        outs, vjp_fn, new_aux = jax.vjp(f, watched, has_aux=True)
+        heads = [jnp.ones_like(o) if il else jnp.zeros_like(o)
+                 for o, il in zip(outs, loss_mask)]
+        (grads,) = vjp_fn(heads)
+        new_params, new_mom = {}, {}
+        for nm in param_names:
+            m = mom * mom_vals[nm] - lr * grads[nm] / BATCH
+            new_mom[nm] = m
+            new_params[nm] = arg_vals[nm] + m
+        return {**rest, **new_params}, new_aux, new_mom
+
+    jitted = jax.jit(train_step, donate_argnums=(0, 1, 2))
+    key = jax.random.PRNGKey(0)
+
+    for i in range(WARMUP):
+        args, aux, momenta = jitted(args, aux, momenta,
+                                    jax.random.fold_in(key, i))
+    jax.block_until_ready(args["conv0_weight"])
+
+    tic = time.perf_counter()
+    for i in range(STEPS):
+        args, aux, momenta = jitted(args, aux, momenta,
+                                    jax.random.fold_in(key, 100 + i))
+    jax.block_until_ready(args["conv0_weight"])
+    toc = time.perf_counter()
+
+    img_s = BATCH * STEPS / (toc - tic)
+    print(json.dumps({
+        "metric": "resnet50_train_img_per_sec_batch32_1chip",
+        "value": round(img_s, 2),
+        "unit": "img/s",
+        "vs_baseline": round(img_s / BASELINE_P100_IMG_S, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

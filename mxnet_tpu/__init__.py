@@ -1,0 +1,51 @@
+"""mxnet_tpu: a TPU-native deep-learning framework with MXNet's capabilities.
+
+Brand-new implementation on JAX/XLA/Pallas/pjit of the full reference API
+surface (reference: anirudh2290/mxnet, NNVM-era v0.9 — see SURVEY.md):
+NDArray + Symbol hybrid, Module training stack, KVStore data parallelism
+(as ICI/DCN collectives), RecordIO data pipeline, optimizers/initializers/
+metrics/RNN cells. Import as ``import mxnet_tpu as mx``.
+"""
+from . import base
+from .base import MXNetError
+from .context import Context, cpu, gpu, tpu, current_context, num_gpus
+from . import ops  # populates the op registry (must precede nd/sym autogen)
+from . import ndarray
+from . import ndarray as nd
+from . import _op_gen
+_op_gen.init_ndarray_module(ndarray.__dict__)
+from . import symbol
+from . import symbol as sym
+symbol._init_symbol_module(symbol.__dict__)
+from .symbol import Group
+from . import random
+from .attribute import AttrScope
+from .name import NameManager, Prefix
+from .executor import Executor
+from . import io
+from . import recordio
+from . import initializer
+from .initializer import init_registry  # noqa: F401
+from . import optimizer
+from .optimizer import Optimizer
+from . import lr_scheduler
+from . import metric
+from . import callback
+from . import monitor
+from .monitor import Monitor
+from . import kvstore as kv
+from . import model
+from .model import FeedForward
+from . import module
+from . import module as mod
+from . import rnn
+from . import visualization
+from . import visualization as viz
+from . import profiler
+from . import test_utils
+from . import autograd
+from . import parallel
+from . import contrib
+from . import image
+
+__version__ = "0.1.0"

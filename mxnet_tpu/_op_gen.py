@@ -1,0 +1,57 @@
+"""Auto-generation of mx.nd.* imperative functions from the op registry.
+
+The reference builds every binding's op functions at import from C-side
+registry metadata (reference: python/mxnet/ndarray.py:875
+``_init_ndarray_module`` via MXSymbolGetAtomicSymbolInfo). Here the registry
+is Python, so generation is a direct closure over ``imperative_invoke``.
+"""
+from __future__ import annotations
+
+from .ndarray import NDArray, imperative_invoke
+from .ops.registry import OP_REGISTRY, get_op
+
+
+def _make_ndarray_function(op_name):
+    opdef = get_op(op_name)
+
+    def fn(*args, **kwargs):
+        out = kwargs.pop("out", None)
+        kwargs.pop("name", None)
+        # split kwargs into tensor inputs vs attrs
+        tensor_kwargs = {k: v for k, v in kwargs.items()
+                         if isinstance(v, NDArray)}
+        params = {k: v for k, v in kwargs.items()
+                  if not isinstance(v, NDArray)}
+        inputs = list(args)
+        if tensor_kwargs:
+            attrs = opdef.normalize_attrs(params)
+            in_names = opdef.input_names(attrs)
+            by_name = [None] * len(in_names)
+            for i, a in enumerate(inputs):
+                by_name[i] = a
+            for k, v in tensor_kwargs.items():
+                if k in in_names:
+                    by_name[in_names.index(k)] = v
+                else:
+                    try:
+                        by_name[by_name.index(None)] = v
+                    except ValueError:
+                        by_name.append(v)
+            inputs = [a for a in by_name if a is not None]
+        if callable(opdef._inputs) and "num_args" in opdef.attr_spec \
+                and "num_args" not in params:
+            params["num_args"] = len(inputs)
+        return imperative_invoke(op_name, *inputs, out=out, **params)
+
+    fn.__name__ = op_name
+    fn.__doc__ = opdef.doc or f"imperative {op_name}"
+    return fn
+
+
+def init_ndarray_module(namespace):
+    for op_name in list(OP_REGISTRY):
+        if op_name.startswith("_backward"):
+            continue
+        if op_name in namespace:
+            continue  # don't clobber hand-written factories (zeros, sort, ..)
+        namespace[op_name] = _make_ndarray_function(op_name)

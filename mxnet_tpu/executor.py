@@ -1,0 +1,389 @@
+"""Executor: binds a Symbol to a device and runs it.
+
+Reference pipeline (reference: src/executor/graph_executor.cc:333-446):
+``Bind`` runs Gradient/PlaceDevice/InferShape/PlanMemory passes, allocates a
+memory pool, wraps nodes in cached engine ops, and ``Forward``/``Backward``
+push them to the dependency engine.
+
+TPU-native pipeline: ``bind`` topologically closes the Symbol into ONE pure
+JAX function and hands it to ``jax.jit`` — XLA performs memory planning,
+fusion, scheduling and (on request) ``jax.vjp`` performs the Gradient pass.
+Three compiled programs are built lazily per executor:
+
+  * ``fwd_infer``  — forward, is_train=False (prediction path);
+  * ``fwd_train``  — forward, is_train=True (dropout on, BN batch stats);
+  * ``fwd_bwd``    — forward + cotangent propagation in a single XLA
+    program — the analog of the reference's bulk-exec segment covering the
+    whole fwd+bwd graph (graph_executor.cc:678-756), and the hot path of
+    ``Module.fit``.
+
+Laziness contract: ``forward(is_train=True)`` only *records* inputs; the
+computation happens on first access of ``outputs`` (fwd program) or at
+``backward()`` (fused program) — so a ``forward_backward`` pair costs exactly
+one XLA execution, like the reference's single engine pass, while
+``forward``-then-read still behaves eagerly from the caller's view.
+
+Mutation contract: ``backward()`` applies ``grad_req`` (write/add) by
+swapping new buffers into the bound grad NDArrays; aux states (BN moving
+stats) are swapped after every training forward — Python aliases stay
+coherent because NDArray is a mutable cell (see ndarray.py).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .base import MXNetError
+from .context import current_context
+from .ndarray import NDArray, zeros as nd_zeros
+from .ops.registry import get_op
+from . import random as _random
+
+__all__ = ["Executor"]
+
+
+def _build_graph_runner(symbol):
+    """Close the symbol graph into run(arg_vals, aux_vals, is_train, rng).
+
+    Returns (runner, arg_names, aux_names, loss_mask). The runner is pure:
+    dict-of-arrays in, (outputs, new_aux_dict) out — directly jittable.
+    """
+    nodes = symbol._topo_nodes()
+    node_index = {id(n): i for i, n in enumerate(nodes)}
+    arg_names = symbol.list_arguments()
+    aux_names = symbol.list_auxiliary_states()
+    loss_mask = []
+    for node, _ in symbol._outputs:
+        loss_mask.append(bool(not node.is_variable and
+                              node.opdef().is_loss))
+
+    def run(arg_vals, aux_vals, is_train, rng):
+        vals = {}       # id(node) -> list of output arrays
+        new_aux = {}
+        for node in nodes:
+            if node.is_variable:
+                if node._extra.get("__is_aux__"):
+                    vals[id(node)] = [aux_vals[node.name]]
+                else:
+                    vals[id(node)] = [arg_vals[node.name]]
+                continue
+            opdef = node.opdef()
+            aux_n = len(opdef.aux_names(node.attrs))
+            in_entries = [vals[id(inp)][idx] for inp, idx in node.inputs]
+            regular = in_entries[:len(in_entries) - aux_n] if aux_n \
+                else in_entries
+            aux = in_entries[len(in_entries) - aux_n:] if aux_n else []
+            krng = jax.random.fold_in(rng, node_index[id(node)]) \
+                if opdef.need_rng else None
+            outs, aux_out = opdef.forward(node.attrs, regular, aux,
+                                          is_train, krng)
+            vals[id(node)] = outs
+            if aux_n and is_train:
+                for (inp, _), new_val in zip(
+                        node.inputs[len(node.inputs) - aux_n:], aux_out):
+                    new_aux[inp.name] = new_val
+        outputs = [vals[id(n)][i] for n, i in symbol._outputs]
+        return outputs, new_aux
+
+    return run, arg_names, aux_names, loss_mask
+
+
+class Executor:
+    """reference: include/mxnet/executor.h + python/mxnet/executor.py."""
+
+    def __init__(self, symbol, ctx, args, args_grad=None, grad_req="write",
+                 aux_states=None, group2ctx=None, shared_exec=None):
+        self._symbol = symbol
+        self._ctx = ctx
+        self._group2ctx = group2ctx or {}
+        self._monitor_callback = None
+
+        self._runner, self.arg_names, self.aux_names, self._loss_mask = \
+            _build_graph_runner(symbol)
+        self.output_names = symbol.list_outputs()
+
+        # ---- normalize arg arrays -------------------------------------
+        self.arg_arrays = self._normalize_args(args, self.arg_names, "args")
+        self.aux_arrays = self._normalize_args(aux_states, self.aux_names,
+                                               "aux_states", allow_none=True)
+        self.grad_req = self._normalize_req(grad_req)
+        self.grad_arrays = self._normalize_grads(args_grad)
+
+        # compiled program cache: (kind, ) -> jitted fn
+        self._jit_cache = {}
+        self._pending = None      # recorded inputs awaiting execution
+        self._outputs = None      # computed output NDArrays
+
+    # ------------------------------------------------------------ normalize
+    def _normalize_args(self, args, names, what, allow_none=False):
+        if args is None:
+            if allow_none or not names:
+                return [None] * len(names)
+            raise MXNetError(f"bind requires {what}")
+        if isinstance(args, dict):
+            out = []
+            for nm in names:
+                if nm not in args:
+                    if allow_none:
+                        out.append(None)
+                        continue
+                    raise MXNetError(f"missing {what} entry {nm!r}")
+                out.append(args[nm])
+            return out
+        args = list(args)
+        if len(args) != len(names):
+            raise MXNetError(
+                f"{what} length {len(args)} != expected {len(names)}")
+        return args
+
+    def _normalize_req(self, grad_req):
+        if isinstance(grad_req, str):
+            return {nm: grad_req for nm in self.arg_names}
+        if isinstance(grad_req, (list, tuple)):
+            return dict(zip(self.arg_names, grad_req))
+        if isinstance(grad_req, dict):
+            return {nm: grad_req.get(nm, "null") for nm in self.arg_names}
+        raise MXNetError("invalid grad_req")
+
+    def _normalize_grads(self, args_grad):
+        if args_grad is None:
+            return [None] * len(self.arg_names)
+        if isinstance(args_grad, dict):
+            return [args_grad.get(nm) for nm in self.arg_names]
+        args_grad = list(args_grad)
+        if len(args_grad) != len(self.arg_names):
+            raise MXNetError("args_grad length mismatch")
+        return args_grad
+
+    # ------------------------------------------------------------ dict views
+    @property
+    def arg_dict(self):
+        return dict(zip(self.arg_names, self.arg_arrays))
+
+    @property
+    def grad_dict(self):
+        return dict(zip(self.arg_names, self.grad_arrays))
+
+    @property
+    def aux_dict(self):
+        return dict(zip(self.aux_names, self.aux_arrays))
+
+    @property
+    def output_dict(self):
+        return dict(zip(self.output_names, self.outputs))
+
+    # ------------------------------------------------------------- programs
+    def _watched(self):
+        return [nm for nm in self.arg_names
+                if self.grad_req.get(nm, "null") != "null"]
+
+    def _get_program(self, kind):
+        fn = self._jit_cache.get(kind)
+        if fn is not None:
+            return fn
+        runner = self._runner
+        if kind in ("fwd_infer", "fwd_train"):
+            is_train = kind == "fwd_train"
+
+            def prog(arg_vals, aux_vals, rng):
+                return runner(arg_vals, aux_vals, is_train, rng)
+
+            fn = jax.jit(prog)
+        elif kind == "fwd_bwd":
+            watched = self._watched()
+
+            def prog(arg_vals, aux_vals, rng, head_grads):
+                w = {nm: arg_vals[nm] for nm in watched}
+                rest = {nm: v for nm, v in arg_vals.items()
+                        if nm not in w}
+
+                def f(wvals):
+                    outs, new_aux = runner({**rest, **wvals}, aux_vals,
+                                           True, rng)
+                    return outs, new_aux
+
+                outs, vjp_fn, new_aux = jax.vjp(f, w, has_aux=True)
+                grads, = vjp_fn(head_grads)
+                return outs, new_aux, grads
+
+            fn = jax.jit(prog)
+        else:
+            raise ValueError(kind)
+        self._jit_cache[kind] = fn
+        return fn
+
+    # -------------------------------------------------------------- forward
+    def forward(self, is_train=False, **kwargs):
+        """Set optional input kwargs and run (lazily when training).
+
+        reference: python/mxnet/executor.py forward / MXExecutorForward.
+        """
+        if kwargs:
+            ad = self.arg_dict
+            for nm, val in kwargs.items():
+                if nm not in ad:
+                    raise MXNetError(f"unknown forward argument {nm!r}")
+                if isinstance(val, NDArray):
+                    ad[nm]._set(val.asjax().astype(ad[nm].dtype))
+                else:
+                    ad[nm]._set(jnp.asarray(val, dtype=ad[nm].dtype))
+        rng = _random.next_key()
+        self._pending = ("fwd_train" if is_train else "fwd_infer", rng)
+        self._outputs = None
+        if not is_train:
+            self._materialize_outputs()
+        return self.outputs
+
+    def _arg_vals(self):
+        return {nm: a.asjax() for nm, a in zip(self.arg_names,
+                                               self.arg_arrays)}
+
+    def _aux_vals(self):
+        return {nm: a.asjax() for nm, a in zip(self.aux_names,
+                                               self.aux_arrays)}
+
+    def _materialize_outputs(self):
+        if self._outputs is not None or self._pending is None:
+            return
+        kind, rng = self._pending
+        prog = self._get_program(kind)
+        outs, new_aux = prog(self._arg_vals(), self._aux_vals(), rng)
+        self._finish(outs, new_aux)
+
+    def _finish(self, outs, new_aux, grads=None):
+        self._outputs = [NDArray(o, ctx=self._ctx) for o in outs]
+        if new_aux:
+            aux_d = self.aux_dict
+            for nm, val in new_aux.items():
+                aux_d[nm]._set(val)
+        if grads is not None:
+            gd = dict(zip(self.arg_names, self.grad_arrays))
+            for nm, g in grads.items():
+                dst = gd.get(nm)
+                if dst is None:
+                    continue
+                req = self.grad_req.get(nm, "null")
+                if req == "write":
+                    dst._set(g.astype(dst.dtype))
+                elif req == "add":
+                    dst._set(dst.asjax() + g.astype(dst.dtype))
+        if self._monitor_callback is not None:
+            for nm, arr in zip(self.output_names, self._outputs):
+                self._monitor_callback(nm, arr)
+
+    @property
+    def outputs(self):
+        self._materialize_outputs()
+        return self._outputs if self._outputs is not None else []
+
+    # -------------------------------------------------------------- backward
+    def backward(self, out_grads=None):
+        """Propagate gradients (fused fwd+bwd XLA program).
+
+        reference: MXExecutorBackward -> RunOps over the backward segment.
+        """
+        if self._pending is None:
+            raise MXNetError("backward() requires a prior forward(is_train=True)")
+        kind, rng = self._pending
+        if kind != "fwd_train":
+            raise MXNetError("backward() after forward(is_train=False)")
+        # head gradients: user-provided, else ones for loss heads
+        if out_grads is None:
+            heads = None
+        elif isinstance(out_grads, NDArray):
+            heads = [out_grads]
+        else:
+            heads = list(out_grads)
+        arg_vals = self._arg_vals()
+        out_shapes = None
+        if heads is None:
+            # ones for loss heads (their custom_vjp ignores the value),
+            # zeros for data heads -> no spurious gradient
+            outs_struct = jax.eval_shape(
+                lambda a, x, r: self._runner(a, x, True, r)[0],
+                arg_vals, self._aux_vals(), jax.random.PRNGKey(0))
+            heads = [jnp.ones(o.shape, o.dtype) if is_loss
+                     else jnp.zeros(o.shape, o.dtype)
+                     for o, is_loss in zip(outs_struct, self._loss_mask)]
+        else:
+            heads = [h.asjax() if isinstance(h, NDArray) else jnp.asarray(h)
+                     for h in heads]
+        prog = self._get_program("fwd_bwd")
+        outs, new_aux, grads = prog(arg_vals, self._aux_vals(), rng, heads)
+        self._finish(outs, new_aux, grads)
+        self._pending = None
+
+    # ------------------------------------------------------------- utilities
+    def copy_params_from(self, arg_params, aux_params=None,
+                         allow_extra_params=False):
+        """reference: executor.py copy_params_from."""
+        ad = self.arg_dict
+        for nm, arr in arg_params.items():
+            if nm in ad:
+                ad[nm]._set(jnp.asarray(
+                    arr.asnumpy() if isinstance(arr, NDArray) else arr,
+                    dtype=ad[nm].dtype))
+            elif not allow_extra_params:
+                raise MXNetError(f"unknown param {nm!r}")
+        if aux_params:
+            xd = self.aux_dict
+            for nm, arr in aux_params.items():
+                if nm in xd:
+                    xd[nm]._set(jnp.asarray(
+                        arr.asnumpy() if isinstance(arr, NDArray) else arr,
+                        dtype=xd[nm].dtype))
+                elif not allow_extra_params:
+                    raise MXNetError(f"unknown aux param {nm!r}")
+
+    def reshape(self, partial_shaping=False, allow_up_sizing=False, **kwargs):
+        """Re-bind with new shapes (fresh XLA programs compile on demand)."""
+        arg_shapes, _, aux_shapes = self._symbol.infer_shape(**kwargs)
+        new_args = {}
+        for nm, s, old in zip(self.arg_names, arg_shapes, self.arg_arrays):
+            if tuple(old.shape) == tuple(s):
+                new_args[nm] = old
+            else:
+                new_args[nm] = nd_zeros(s, ctx=self._ctx, dtype=old.dtype)
+        new_grads = {}
+        for nm, s, old in zip(self.arg_names, arg_shapes, self.grad_arrays):
+            if old is None:
+                continue
+            new_grads[nm] = old if tuple(old.shape) == tuple(s) else \
+                nd_zeros(s, ctx=self._ctx, dtype=old.dtype)
+        new_aux = {}
+        for nm, s, old in zip(self.aux_names, aux_shapes, self.aux_arrays):
+            new_aux[nm] = old if tuple(old.shape) == tuple(s) else \
+                nd_zeros(s, ctx=self._ctx, dtype=old.dtype)
+        return Executor(self._symbol, self._ctx, new_args, new_grads,
+                        self.grad_req, new_aux, self._group2ctx)
+
+    def set_monitor_callback(self, callback):
+        self._monitor_callback = callback
+
+    def debug_str(self):
+        lines = [f"Symbol outputs: {self.output_names}"]
+        for node in self._symbol._topo_nodes():
+            kind = "var" if node.is_variable else node.op
+            lines.append(f"  {kind:<20} {node.name}")
+        return "\n".join(lines)
+
+    # ----------------------------------------------------------- simple_bind
+    @staticmethod
+    def _simple_bind(symbol, ctx, grad_req, type_dict, group2ctx, shapes):
+        arg_shapes, out_shapes, aux_shapes = symbol.infer_shape(**shapes)
+        arg_names = symbol.list_arguments()
+        aux_names = symbol.list_auxiliary_states()
+        type_dict = type_dict or {}
+        args = {}
+        for nm, s in zip(arg_names, arg_shapes):
+            args[nm] = nd_zeros(s, ctx=ctx,
+                                dtype=type_dict.get(nm, np.float32))
+        req = grad_req if isinstance(grad_req, dict) else \
+            {nm: grad_req for nm in arg_names}
+        grads = {nm: nd_zeros(s, ctx=ctx, dtype=type_dict.get(nm, np.float32))
+                 for nm, s in zip(arg_names, arg_shapes)
+                 if req.get(nm, "null") != "null"}
+        aux = {nm: nd_zeros(s, ctx=ctx)
+               for nm, s in zip(aux_names, aux_shapes)}
+        return Executor(symbol, ctx, args, grads, grad_req, aux, group2ctx)

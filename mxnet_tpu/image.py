@@ -1,0 +1,408 @@
+"""Image pipeline: decode, augmenters, ImageIter (reference:
+python/mxnet/image.py, 559 LoC + the C++ src/io/ pipeline).
+
+The reference's high-throughput path is a C++ OpenCV decode+augment chain;
+here decode is cv2/PIL (gated) feeding numpy, with augmenters as pure
+functions. ImageRecordIter is provided over the byte-compatible RecordIO
+reader with a thread pool for decode (the C++ pipeline's replacement; wrap
+in PrefetchingIter for the background-producer behavior).
+"""
+from __future__ import annotations
+
+import os
+import random as pyrandom
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+from .base import MXNetError
+from .ndarray import NDArray, array
+from .io import DataIter, DataBatch, DataDesc
+from . import recordio
+
+
+def _cv2():
+    try:
+        import cv2
+        return cv2
+    except ImportError:
+        return None
+
+
+def imdecode(buf, flag=1, to_rgb=True, **kwargs):
+    """Decode an image byte buffer -> (H, W, C) ndarray.
+    reference: image.py imdecode (mx.img)."""
+    cv2 = _cv2()
+    if cv2 is not None:
+        img = cv2.imdecode(np.frombuffer(buf, dtype=np.uint8), flag)
+        if img is None:
+            raise MXNetError("cannot decode image")
+        if to_rgb and img.ndim == 3:
+            img = img[..., ::-1]
+        return array(img)
+    try:
+        from PIL import Image
+        import io as _io
+        img = np.asarray(Image.open(_io.BytesIO(buf)).convert("RGB"))
+        return array(img)
+    except ImportError:
+        raise MXNetError("imdecode requires cv2 or PIL")
+
+
+def _asnp(img):
+    return img.asnumpy() if isinstance(img, NDArray) else np.asarray(img)
+
+
+def resize_short(src, size, interp=2):
+    """Resize shorter edge to `size`. reference: image.py resize_short."""
+    img = _asnp(src)
+    h, w = img.shape[:2]
+    if h > w:
+        new_h, new_w = size * h // w, size
+    else:
+        new_h, new_w = size, size * w // h
+    return array(_resize(img, new_w, new_h, interp))
+
+
+def _resize(img, w, h, interp=2):
+    cv2 = _cv2()
+    if cv2 is not None:
+        return cv2.resize(img, (w, h), interpolation=interp)
+    from PIL import Image
+    return np.asarray(Image.fromarray(img.astype(np.uint8)).resize((w, h)))
+
+
+def fixed_crop(src, x0, y0, w, h, size=None, interp=2):
+    img = _asnp(src)
+    out = img[y0:y0 + h, x0:x0 + w]
+    if size is not None and (w, h) != size:
+        out = _resize(out, size[0], size[1], interp)
+    return array(out)
+
+
+def random_crop(src, size, interp=2):
+    img = _asnp(src)
+    h, w = img.shape[:2]
+    new_w, new_h = size
+    x0 = pyrandom.randint(0, max(w - new_w, 0))
+    y0 = pyrandom.randint(0, max(h - new_h, 0))
+    out = fixed_crop(img, x0, y0, min(new_w, w), min(new_h, h), size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def center_crop(src, size, interp=2):
+    img = _asnp(src)
+    h, w = img.shape[:2]
+    new_w, new_h = size
+    x0 = max((w - new_w) // 2, 0)
+    y0 = max((h - new_h) // 2, 0)
+    out = fixed_crop(img, x0, y0, min(new_w, w), min(new_h, h), size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def random_size_crop(src, size, min_area=0.08, ratio=(3 / 4.0, 4 / 3.0),
+                     interp=2):
+    img = _asnp(src)
+    h, w = img.shape[:2]
+    area = h * w
+    for _ in range(10):
+        target_area = pyrandom.uniform(min_area, 1.0) * area
+        log_ratio = (np.log(ratio[0]), np.log(ratio[1]))
+        aspect = np.exp(pyrandom.uniform(*log_ratio))
+        new_w = int(round(np.sqrt(target_area * aspect)))
+        new_h = int(round(np.sqrt(target_area / aspect)))
+        if new_w <= w and new_h <= h:
+            x0 = pyrandom.randint(0, w - new_w)
+            y0 = pyrandom.randint(0, h - new_h)
+            return fixed_crop(img, x0, y0, new_w, new_h, size, interp), \
+                (x0, y0, new_w, new_h)
+    return center_crop(src, size, interp)
+
+
+def color_normalize(src, mean, std=None):
+    img = _asnp(src).astype(np.float32)
+    img = img - _asnp(mean)
+    if std is not None:
+        img = img / _asnp(std)
+    return array(img)
+
+
+# ------------------------------------------------------------- augmenters
+def ResizeAug(size, interp=2):
+    def aug(src):
+        return [resize_short(src, size, interp)]
+    return aug
+
+
+def RandomCropAug(size, interp=2):
+    def aug(src):
+        return [random_crop(src, size, interp)[0]]
+    return aug
+
+
+def RandomSizedCropAug(size, min_area=0.08, ratio=(3 / 4.0, 4 / 3.0),
+                       interp=2):
+    def aug(src):
+        return [random_size_crop(src, size, min_area, ratio, interp)[0]]
+    return aug
+
+
+def CenterCropAug(size, interp=2):
+    def aug(src):
+        return [center_crop(src, size, interp)[0]]
+    return aug
+
+
+def RandomOrderAug(ts):
+    def aug(src):
+        srcs = [src]
+        ts_shuffled = list(ts)
+        pyrandom.shuffle(ts_shuffled)
+        for t in ts_shuffled:
+            srcs = sum([t(s) for s in srcs], [])
+        return srcs
+    return aug
+
+
+def ColorJitterAug(brightness, contrast, saturation):
+    coef = np.array([[[0.299, 0.587, 0.114]]], dtype=np.float32)
+
+    def aug(src):
+        img = _asnp(src).astype(np.float32)
+        if brightness > 0:
+            alpha = 1.0 + pyrandom.uniform(-brightness, brightness)
+            img = img * alpha
+        if contrast > 0:
+            alpha = 1.0 + pyrandom.uniform(-contrast, contrast)
+            gray = (img * coef).sum(axis=2, keepdims=True)
+            img = img * alpha + gray.mean() * (1 - alpha)
+        if saturation > 0:
+            alpha = 1.0 + pyrandom.uniform(-saturation, saturation)
+            gray = (img * coef).sum(axis=2, keepdims=True)
+            img = img * alpha + gray * (1 - alpha)
+        return [array(img)]
+    return aug
+
+
+def LightingAug(alphastd, eigval, eigvec):
+    def aug(src):
+        img = _asnp(src).astype(np.float32)
+        alpha = np.random.normal(0, alphastd, size=(3,))
+        rgb = np.dot(_asnp(eigvec) * alpha, _asnp(eigval))
+        return [array(img + rgb)]
+    return aug
+
+
+def ColorNormalizeAug(mean, std):
+    def aug(src):
+        return [color_normalize(src, mean, std)]
+    return aug
+
+
+def HorizontalFlipAug(p):
+    def aug(src):
+        if pyrandom.random() < p:
+            return [array(_asnp(src)[:, ::-1])]
+        return [src]
+    return aug
+
+
+def CastAug():
+    def aug(src):
+        return [array(_asnp(src).astype(np.float32))]
+    return aug
+
+
+def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
+                    rand_mirror=False, mean=None, std=None, brightness=0,
+                    contrast=0, saturation=0, pca_noise=0, inter_method=2):
+    """reference: image.py CreateAugmenter."""
+    auglist = []
+    if resize > 0:
+        auglist.append(ResizeAug(resize, inter_method))
+    crop_size = (data_shape[2], data_shape[1])
+    if rand_resize:
+        assert rand_crop
+        auglist.append(RandomSizedCropAug(crop_size, 0.3, (3.0 / 4.0,
+                                                           4.0 / 3.0),
+                                          inter_method))
+    elif rand_crop:
+        auglist.append(RandomCropAug(crop_size, inter_method))
+    else:
+        auglist.append(CenterCropAug(crop_size, inter_method))
+    if rand_mirror:
+        auglist.append(HorizontalFlipAug(0.5))
+    auglist.append(CastAug())
+    if brightness or contrast or saturation:
+        auglist.append(ColorJitterAug(brightness, contrast, saturation))
+    if pca_noise > 0:
+        eigval = np.array([55.46, 4.794, 1.148])
+        eigvec = np.array([[-0.5675, 0.7192, 0.4009],
+                           [-0.5808, -0.0045, -0.8140],
+                           [-0.5836, -0.6948, 0.4203]])
+        auglist.append(LightingAug(pca_noise, eigval, eigvec))
+    if mean is True:
+        mean = np.array([123.68, 116.28, 103.53])
+    if std is True:
+        std = np.array([58.395, 57.12, 57.375])
+    if mean is not None:
+        assert std is not None
+        auglist.append(ColorNormalizeAug(mean, std))
+    return auglist
+
+
+class ImageIter(DataIter):
+    """Pure-python image iterator over .rec or .lst/raw images.
+    reference: image.py ImageIter; decode parallelized with a thread pool
+    (the reference's OMP decode loop, iter_image_recordio_2.cc:28)."""
+
+    def __init__(self, batch_size, data_shape, label_width=1,
+                 path_imgrec=None, path_imglist=None, path_root=None,
+                 path_imgidx=None, shuffle=False, part_index=0, num_parts=1,
+                 aug_list=None, imgrec=None, data_name="data",
+                 label_name="softmax_label", num_threads=4, **kwargs):
+        super().__init__(batch_size)
+        assert path_imgrec or path_imglist or imgrec
+        if path_imgrec:
+            if path_imgidx:
+                self.imgrec = recordio.MXIndexedRecordIO(path_imgidx,
+                                                         path_imgrec, "r")
+                self.imgidx = list(self.imgrec.keys)
+            else:
+                self.imgrec = recordio.MXRecordIO(path_imgrec, "r")
+                self.imgidx = None
+        else:
+            self.imgrec = imgrec
+            self.imgidx = None
+
+        self.imglist = None
+        if path_imglist:
+            with open(path_imglist) as fin:
+                imglist = {}
+                imgkeys = []
+                for line in fin:
+                    line = line.strip().split("\t")
+                    label = np.array(line[1:-1], dtype=np.float32)
+                    key = int(line[0])
+                    imglist[key] = (label, line[-1])
+                    imgkeys.append(key)
+                self.imglist = imglist
+                self.imgidx = imgkeys
+        self.path_root = path_root
+
+        self.shuffle = shuffle
+        if num_parts > 1 and self.imgidx is not None:
+            n = len(self.imgidx) // num_parts
+            self.imgidx = self.imgidx[part_index * n:(part_index + 1) * n]
+        self.data_shape = tuple(data_shape)
+        self.label_width = label_width
+        self.aug_list = aug_list if aug_list is not None else \
+            CreateAugmenter(data_shape)
+        self.data_name = data_name
+        self.label_name = label_name
+        self._pool = ThreadPoolExecutor(max_workers=num_threads)
+        self.cur = 0
+        self.seq = self.imgidx
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return [DataDesc(self.data_name,
+                         (self.batch_size,) + self.data_shape)]
+
+    @property
+    def provide_label(self):
+        shape = (self.batch_size,) if self.label_width == 1 else \
+            (self.batch_size, self.label_width)
+        return [DataDesc(self.label_name, shape)]
+
+    def reset(self):
+        if self.shuffle and self.seq is not None:
+            pyrandom.shuffle(self.seq)
+        if self.imgrec is not None:
+            self.imgrec.reset()
+        self.cur = 0
+
+    def _read_one(self, i=None):
+        if self.seq is not None and self.imglist is None:
+            s = self.imgrec.read_idx(self.seq[i])
+            header, img_bytes = recordio.unpack(s)
+            label = header.label
+        elif self.imglist is not None:
+            label, fname = self.imglist[self.seq[i]]
+            with open(os.path.join(self.path_root, fname), "rb") as f:
+                img_bytes = f.read()
+        else:
+            s = self.imgrec.read()
+            if s is None:
+                return None
+            header, img_bytes = recordio.unpack(s)
+            label = header.label
+        return label, img_bytes
+
+    def _decode_augment(self, item):
+        label, img_bytes = item
+        img = imdecode(img_bytes)
+        for aug in self.aug_list:
+            img = aug(img)[0]
+        arr = _asnp(img).astype(np.float32)
+        if arr.ndim == 2:
+            arr = arr[:, :, None]
+        arr = arr.transpose(2, 0, 1)  # HWC -> CHW (reference layout)
+        return arr, label
+
+    def next(self):
+        items = []
+        for _ in range(self.batch_size):
+            if self.seq is not None:
+                if self.cur >= len(self.seq):
+                    break
+                item = self._read_one(self.cur)
+                self.cur += 1
+            else:
+                item = self._read_one()
+                if item is None:
+                    break
+            items.append(item)
+        if not items:
+            raise StopIteration
+        pad = self.batch_size - len(items)
+        decoded = list(self._pool.map(self._decode_augment, items))
+        data = np.zeros((self.batch_size,) + self.data_shape,
+                        dtype=np.float32)
+        labels = np.zeros((self.batch_size, self.label_width),
+                          dtype=np.float32)
+        for i, (arr, label) in enumerate(decoded):
+            data[i] = arr
+            lab = np.atleast_1d(np.asarray(label, dtype=np.float32))
+            labels[i, :self.label_width] = lab[:self.label_width]
+        if self.label_width == 1:
+            labels = labels[:, 0]
+        return DataBatch([array(data)], [array(labels)], pad=pad)
+
+
+def ImageRecordIter(path_imgrec, data_shape, batch_size, path_imgidx=None,
+                    shuffle=False, rand_crop=False, rand_mirror=False,
+                    mean_r=0, mean_g=0, mean_b=0, std_r=1, std_g=1, std_b=1,
+                    resize=0, part_index=0, num_parts=1, prefetch=True,
+                    data_name="data", label_name="softmax_label", **kwargs):
+    """Factory matching the reference's ImageRecordIter params
+    (reference: iter_image_recordio_2.cc registration :559-579)."""
+    mean = None
+    std = None
+    if mean_r or mean_g or mean_b:
+        mean = np.array([mean_r, mean_g, mean_b])
+    if std_r != 1 or std_g != 1 or std_b != 1:
+        std = np.array([std_r, std_g, std_b])
+    aug_list = CreateAugmenter(data_shape, resize=resize,
+                               rand_crop=rand_crop, rand_mirror=rand_mirror,
+                               mean=mean, std=std)
+    it = ImageIter(batch_size, data_shape, path_imgrec=path_imgrec,
+                   path_imgidx=path_imgidx, shuffle=shuffle,
+                   part_index=part_index, num_parts=num_parts,
+                   aug_list=aug_list, data_name=data_name,
+                   label_name=label_name, **kwargs)
+    if prefetch:
+        from .io import PrefetchingIter
+        return PrefetchingIter(it)
+    return it

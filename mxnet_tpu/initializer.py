@@ -1,0 +1,336 @@
+"""Weight initializers (reference: python/mxnet/initializer.py, 612 LoC).
+
+Same name-pattern dispatch contract as the reference: an Initializer is
+called as ``init(name, arr)`` and routes on the parameter name suffix
+(weight/bias/gamma/beta/moving_*). Randomness uses the framework's global
+functional RNG (mxnet_tpu/random.py).
+
+Registry: SURVEY.md A.6 list — Load, Mixed, Zero, One, Constant, Uniform,
+Normal, Orthogonal, Xavier, MSRAPrelu, Bilinear, LSTMBias, FusedRNN.
+"""
+from __future__ import annotations
+
+import json
+import re
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .base import MXNetError
+from .ndarray import NDArray
+from . import random as _random
+
+__all__ = ["Initializer", "Load", "Mixed", "Zero", "One", "Constant",
+           "Uniform", "Normal", "Orthogonal", "Xavier", "MSRAPrelu",
+           "Bilinear", "LSTMBias", "register", "init_registry"]
+
+init_registry = {}
+
+
+def register(klass):
+    init_registry[klass.__name__.lower()] = klass
+    return klass
+
+
+class Initializer:
+    """Base: route by parameter name. reference: initializer.py:21-120."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def dumps(self):
+        return json.dumps([self.__class__.__name__.lower(), self._kwargs])
+
+    def __call__(self, name, arr):
+        if not isinstance(name, str):
+            raise TypeError("name must be string")
+        if not isinstance(arr, NDArray):
+            raise TypeError("arr must be NDArray")
+        if name.startswith("upsampling"):
+            self._init_bilinear(name, arr)
+        elif name.endswith("bias"):
+            self._init_bias(name, arr)
+        elif name.endswith("gamma"):
+            self._init_gamma(name, arr)
+        elif name.endswith("beta"):
+            self._init_beta(name, arr)
+        elif name.endswith("weight"):
+            self._init_weight(name, arr)
+        elif name.endswith("moving_mean"):
+            self._init_zero(name, arr)
+        elif name.endswith("moving_var"):
+            self._init_one(name, arr)
+        elif name.endswith("moving_inv_var"):
+            self._init_zero(name, arr)
+        elif name.endswith("moving_avg"):
+            self._init_zero(name, arr)
+        else:
+            self._init_default(name, arr)
+
+    def _init_bilinear(self, _, arr):
+        shape = arr.shape
+        weight = np.zeros(int(np.prod(shape)), dtype=np.float32)
+        f = np.ceil(shape[3] / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        for i in range(int(np.prod(shape))):
+            x = i % shape[3]
+            y = (i // shape[3]) % shape[2]
+            weight[i] = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+        arr._set(jnp.asarray(weight.reshape(shape)))
+
+    def _init_zero(self, _, arr):
+        arr._set(jnp.zeros(arr.shape, arr.dtype))
+
+    def _init_one(self, _, arr):
+        arr._set(jnp.ones(arr.shape, arr.dtype))
+
+    def _init_bias(self, _, arr):
+        arr._set(jnp.zeros(arr.shape, arr.dtype))
+
+    def _init_gamma(self, _, arr):
+        arr._set(jnp.ones(arr.shape, arr.dtype))
+
+    def _init_beta(self, _, arr):
+        arr._set(jnp.zeros(arr.shape, arr.dtype))
+
+    def _init_weight(self, name, arr):
+        raise NotImplementedError("must override _init_weight")
+
+    def _init_default(self, name, arr):
+        raise ValueError(
+            f"Unknown initialization pattern for {name}. Default "
+            "initialization is now limited to weight/bias/gamma/beta/"
+            "moving_* suffixes.")
+
+
+@register
+class Load:
+    """Init from an existing param dict. reference: initializer.py:209."""
+
+    def __init__(self, param, default_init=None, verbose=False):
+        if isinstance(param, str):
+            from .ndarray import load as nd_load
+            param = nd_load(param)
+        self.param = {}
+        for nm, arr in param.items():
+            self.param[nm.split(":", 1)[-1]] = arr
+        self.default_init = default_init
+        self.verbose = verbose
+
+    def __call__(self, name, arr):
+        if name in self.param:
+            src = self.param[name]
+            if tuple(src.shape) != tuple(arr.shape):
+                raise MXNetError(
+                    f"Parameter {name} shape mismatch {src.shape} vs "
+                    f"{arr.shape}")
+            arr._set(src.asjax() if isinstance(src, NDArray)
+                     else jnp.asarray(src))
+        else:
+            if self.default_init is None:
+                raise MXNetError(f"Cannot init parameter {name}")
+            self.default_init(name, arr)
+
+
+@register
+class Mixed:
+    """Pattern->initializer dispatch. reference: initializer.py:252."""
+
+    def __init__(self, patterns, initializers):
+        if len(patterns) != len(initializers):
+            raise MXNetError("pattern/initializer length mismatch")
+        self.map = list(zip([re.compile(p) for p in patterns], initializers))
+
+    def __call__(self, name, arr):
+        for prog, init in self.map:
+            if prog.match(name):
+                init(name, arr)
+                return
+        raise ValueError(f"parameter {name} did not match any pattern; add "
+                         "a '.*' catch-all")
+
+
+@register
+class Zero(Initializer):
+    def _init_weight(self, _, arr):
+        arr._set(jnp.zeros(arr.shape, arr.dtype))
+    _init_default = _init_weight
+
+
+@register
+class One(Initializer):
+    def _init_weight(self, _, arr):
+        arr._set(jnp.ones(arr.shape, arr.dtype))
+    _init_default = _init_weight
+
+
+@register
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        super().__init__(value=value)
+        self.value = value
+
+    def _init_weight(self, _, arr):
+        arr._set(jnp.full(arr.shape, self.value, arr.dtype))
+    _init_default = _init_weight
+
+
+@register
+class Uniform(Initializer):
+    """U(-scale, scale). reference: initializer.py:352."""
+
+    def __init__(self, scale=0.07):
+        super().__init__(scale=scale)
+        self.scale = scale
+
+    def _init_weight(self, _, arr):
+        arr._set(jax.random.uniform(_random.next_key(), arr.shape,
+                                    dtype=jnp.float32, minval=-self.scale,
+                                    maxval=self.scale).astype(arr.dtype))
+
+
+@register
+class Normal(Initializer):
+    """N(0, sigma). reference: initializer.py:385."""
+
+    def __init__(self, sigma=0.01):
+        super().__init__(sigma=sigma)
+        self.sigma = sigma
+
+    def _init_weight(self, _, arr):
+        arr._set((self.sigma * jax.random.normal(
+            _random.next_key(), arr.shape, dtype=jnp.float32))
+            .astype(arr.dtype))
+
+
+@register
+class Orthogonal(Initializer):
+    """reference: initializer.py:418 (Saxe et al.)."""
+
+    def __init__(self, scale=1.414, rand_type="uniform"):
+        super().__init__(scale=scale, rand_type=rand_type)
+        self.scale = scale
+        self.rand_type = rand_type
+
+    def _init_weight(self, _, arr):
+        nout = arr.shape[0]
+        nin = int(np.prod(arr.shape[1:]))
+        key = _random.next_key()
+        if self.rand_type == "uniform":
+            tmp = jax.random.uniform(key, (nout, nin), minval=-1.0,
+                                     maxval=1.0)
+        else:
+            tmp = jax.random.normal(key, (nout, nin))
+        u, _, v = np.linalg.svd(np.asarray(tmp), full_matrices=False)
+        q = u if u.shape == (nout, nin) else v
+        arr._set(jnp.asarray(self.scale * q.reshape(arr.shape),
+                             dtype=arr.dtype))
+
+
+@register
+class Xavier(Initializer):
+    """reference: initializer.py:455."""
+
+    def __init__(self, rnd_type="uniform", factor_type="avg", magnitude=3):
+        super().__init__(rnd_type=rnd_type, factor_type=factor_type,
+                         magnitude=magnitude)
+        self.rnd_type = rnd_type
+        self.factor_type = factor_type
+        self.magnitude = float(magnitude)
+
+    def _init_weight(self, _, arr):
+        shape = arr.shape
+        hw_scale = 1.0
+        if len(shape) > 2:
+            hw_scale = np.prod(shape[2:])
+        fan_in = (shape[1] if len(shape) > 1 else shape[0]) * hw_scale
+        fan_out = shape[0] * hw_scale
+        if self.factor_type == "avg":
+            factor = (fan_in + fan_out) / 2.0
+        elif self.factor_type == "in":
+            factor = fan_in
+        elif self.factor_type == "out":
+            factor = fan_out
+        else:
+            raise ValueError("Incorrect factor type")
+        scale = np.sqrt(self.magnitude / factor)
+        key = _random.next_key()
+        if self.rnd_type == "uniform":
+            val = jax.random.uniform(key, shape, dtype=jnp.float32,
+                                     minval=-scale, maxval=scale)
+        elif self.rnd_type == "gaussian":
+            val = scale * jax.random.normal(key, shape, dtype=jnp.float32)
+        else:
+            raise ValueError("Unknown random type")
+        arr._set(val.astype(arr.dtype))
+
+
+@register
+class MSRAPrelu(Xavier):
+    """reference: initializer.py:501 (He init with slope correction)."""
+
+    def __init__(self, factor_type="avg", slope=0.25):
+        magnitude = 2.0 / (1 + slope ** 2)
+        super().__init__("gaussian", factor_type, magnitude)
+        self._kwargs = {"factor_type": factor_type, "slope": slope}
+
+
+@register
+class Bilinear(Initializer):
+    """reference: initializer.py:522."""
+
+    def _init_weight(self, name, arr):
+        self._init_bilinear(name, arr)
+
+
+@register
+class LSTMBias(Initializer):
+    """Forget-gate bias init. reference: initializer.py:540."""
+
+    def __init__(self, forget_bias=1.0):
+        super().__init__(forget_bias=forget_bias)
+        self.forget_bias = forget_bias
+
+    def _init_bias(self, name, arr):
+        b = np.zeros(arr.shape, dtype=np.float32)
+        num_hidden = int(b.shape[0] / 4)
+        b[num_hidden:2 * num_hidden] = self.forget_bias
+        arr._set(jnp.asarray(b, dtype=arr.dtype))
+
+    _init_weight = Initializer._init_bias
+
+
+class FusedRNN(Initializer):
+    """Init packed fused-RNN parameter blobs. reference: initializer.py:562."""
+
+    def __init__(self, init, num_hidden, num_layers, mode,
+                 bidirectional=False, forget_bias=1.0):
+        if isinstance(init, str):
+            klass, kwargs = json.loads(init)
+            init = init_registry[klass.lower()](**kwargs)
+        super().__init__(init=init.dumps() if init else None,
+                         num_hidden=num_hidden, num_layers=num_layers,
+                         mode=mode, bidirectional=bidirectional,
+                         forget_bias=forget_bias)
+        self._init = init
+        self._num_hidden = num_hidden
+        self._num_layers = num_layers
+        self._mode = mode
+        self._bidirectional = bidirectional
+        self._forget_bias = forget_bias
+
+    def _init_weight(self, name, arr):
+        from .rnn.rnn_cell import FusedRNNCell
+        cell = FusedRNNCell(self._num_hidden, self._num_layers,
+                            self._mode, self._bidirectional,
+                            forget_bias=self._forget_bias)
+        args = cell.unpack_weights({"parameters": arr})
+        for nm in args:
+            desc = nm  # e.g. ..._i2h_weight
+            if nm.endswith("bias") and self._forget_bias is not None \
+                    and self._mode == "lstm":
+                continue  # already set by unpack? no — init below
+            self._init(desc, args[nm])
+        packed = cell.pack_weights(args)
+        arr._set(packed["parameters"].asjax())

@@ -1,0 +1,206 @@
+"""KVStore: parameter synchronization.
+
+Reference architecture (reference: src/kvstore/): ``local``/``device`` reduce
+gradients across local GPUs through the engine (comm.h), ``dist_*`` go
+through a ZMQ parameter server (ps-lite, kvstore_dist.h). The *API* —
+init/push/pull/set_optimizer/rank/num_workers/barrier — is the compatibility
+surface (SURVEY.md §5.8).
+
+TPU-native design: there is no parameter server. Within a host, "reduce"
+is a jnp sum (one fused XLA op across device copies); across hosts,
+``dist_sync`` semantics are an all-reduce over the JAX distributed runtime
+(ICI/DCN collectives) — the server vanishes, rank = ``jax.process_index()``.
+``dist_async`` has no collective analog and is documented unsupported
+(SURVEY.md §7 hard parts); creating it raises with that explanation.
+
+Note the actual data-parallel hot path in this framework does NOT round-trip
+gradients through KVStore handles: Module binds ONE sharded executor and XLA
+inserts the psum (see module/executor_group.py). KVStore remains for API
+parity, for the update_on_kvstore path, and for multi-host grad sync.
+"""
+from __future__ import annotations
+
+import pickle
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .base import MXNetError
+from .ndarray import NDArray, zeros
+from . import optimizer as opt
+
+__all__ = ["KVStore", "create"]
+
+
+def _ctype_key_value(key, vals):
+    """Normalize to (list_of_keys, list_of_list_of_NDArray)."""
+    if isinstance(key, (int, str)):
+        key = [key]
+        vals = [vals]
+    out_vals = []
+    for v in vals:
+        if isinstance(v, NDArray):
+            out_vals.append([v])
+        else:
+            out_vals.append(list(v))
+    return list(key), out_vals
+
+
+class KVStore:
+    """Single-process store ('local'/'device'). reference:
+    src/kvstore/kvstore_local.h:40-130."""
+
+    def __init__(self, kind="local"):
+        self._kind = kind
+        self._store = {}
+        self._updater = None
+        self._optimizer = None
+
+    # ---------------------------------------------------------------- meta
+    @property
+    def type(self):
+        return self._kind
+
+    @property
+    def rank(self):
+        return 0
+
+    @property
+    def num_workers(self):
+        return 1
+
+    # ---------------------------------------------------------------- core
+    def init(self, key, value):
+        keys, vals = _ctype_key_value(key, value)
+        for k, vlist in zip(keys, vals):
+            if k in self._store:
+                raise MXNetError(f"key {k!r} already initialized")
+            self._store[k] = vlist[0].copy()
+
+    def push(self, key, value, priority=0):
+        """Reduce values; run updater or assign (reference semantics:
+        kvstore_local.h Push -> Comm::Reduce -> updater/assign)."""
+        keys, vals = _ctype_key_value(key, value)
+        for k, vlist in zip(keys, vals):
+            if k not in self._store:
+                raise MXNetError(f"key {k!r} not initialized")
+            if len(vlist) == 1:
+                merged = vlist[0].copy()
+            else:
+                acc = vlist[0].asjax()
+                for v in vlist[1:]:
+                    acc = acc + v.asjax()
+                merged = NDArray(acc, ctx=vlist[0].context)
+            if self._updater is not None:
+                self._updater(k, merged, self._store[k])
+            else:
+                self._store[k]._set(merged.asjax())
+
+    def pull(self, key, out=None, priority=0):
+        """Broadcast stored value into out arrays."""
+        assert out is not None
+        keys, outs = _ctype_key_value(key, out)
+        for k, olist in zip(keys, outs):
+            if k not in self._store:
+                raise MXNetError(f"key {k!r} not initialized")
+            src = self._store[k]
+            for o in olist:
+                # land the value in the destination's existing placement
+                # (keeps mesh-sharded arrays sharded)
+                o._set(jax.device_put(src.asjax(), o.asjax().sharding))
+
+    # ------------------------------------------------------------ optimizer
+    def set_optimizer(self, optimizer):
+        """reference: kvstore.py:226 — local mode installs the updater
+        closure; dist mode ships the (pickled) optimizer to the server.
+        Here there is no server: always install locally."""
+        self._optimizer = optimizer
+        self._set_updater(opt.get_updater(optimizer))
+
+    def _set_updater(self, updater):
+        self._updater = updater
+
+    def _barrier(self):
+        pass
+
+    def _send_command_to_servers(self, head, body):
+        pass
+
+    # --------------------------------------------------------- persistence
+    def save_optimizer_states(self, fname):
+        if self._updater is None:
+            raise MXNetError("no updater/optimizer set")
+        states = {k: v.asnumpy() if isinstance(v, NDArray) else v
+                  for k, v in getattr(self._updater, "states", {}).items()}
+        with open(fname, "wb") as fout:
+            pickle.dump(states, fout)
+
+    def load_optimizer_states(self, fname):
+        if self._updater is None:
+            raise MXNetError("no updater/optimizer set")
+        with open(fname, "rb") as fin:
+            states = pickle.load(fin)
+        self._updater.states.update(states)
+
+
+class KVStoreDistSync(KVStore):
+    """dist_sync over the JAX distributed runtime.
+
+    reference semantics: kvstore_dist.h ZPush/ZPull + server merge-all-then-
+    update (kvstore_dist_server.h:164-198). Realization: every process holds
+    a replica; push() all-reduces the gradient across processes (psum over
+    DCN/ICI), then the updater runs identically on every replica — the
+    arithmetic invariant of dist_sync (nightly test formula) holds because
+    sum-then-update on N replicas == server-side update.
+    """
+
+    def __init__(self, kind):
+        super().__init__(kind)
+        self._nproc = jax.process_count()
+
+    @property
+    def rank(self):
+        return jax.process_index()
+
+    @property
+    def num_workers(self):
+        return self._nproc
+
+    def push(self, key, value, priority=0):
+        keys, vals = _ctype_key_value(key, value)
+        for k, vlist in zip(keys, vals):
+            if k not in self._store:
+                raise MXNetError(f"key {k!r} not initialized")
+            acc = vlist[0].asjax()
+            for v in vlist[1:]:
+                acc = acc + v.asjax()
+            if self._nproc > 1:
+                from jax.experimental import multihost_utils
+                acc = multihost_utils.process_allgather(acc).sum(axis=0)
+            merged = NDArray(acc, ctx=vlist[0].context)
+            if self._updater is not None:
+                self._updater(k, merged, self._store[k])
+            else:
+                self._store[k]._set(merged.asjax())
+
+    def _barrier(self):
+        if self._nproc > 1:
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices("kvstore_barrier")
+
+
+def create(name="local"):
+    """Factory. reference: src/kvstore/kvstore.cc:17-45 (substring match)."""
+    if not isinstance(name, str):
+        raise TypeError("name must be a string")
+    if "dist_async" in name:
+        raise MXNetError(
+            "dist_async has no TPU-native equivalent: asynchronous "
+            "parameter-server updates do not map onto XLA collectives "
+            "(SURVEY.md §7). Use dist_sync (all-reduce) instead.")
+    if "dist" in name:
+        return KVStoreDistSync(name)
+    if "device" in name or "local" in name:
+        return KVStore(name)
+    raise MXNetError(f"unknown kvstore type {name!r}")

@@ -1,0 +1,355 @@
+"""Evaluation metrics (reference: python/mxnet/metric.py, 490 LoC).
+
+Same accumulate-on-host contract as the reference: ``update(labels, preds)``
+takes lists of NDArrays, ``get()`` returns (name, value). The ``asnumpy()``
+inside update is the step's only sync point — identical to the reference's
+behavior (SURVEY.md §3.1).
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as _np
+
+from .base import MXNetError
+from .ndarray import NDArray
+
+__all__ = ["EvalMetric", "Accuracy", "TopKAccuracy", "F1", "Perplexity",
+           "MAE", "MSE", "RMSE", "CrossEntropy", "CustomMetric",
+           "CompositeEvalMetric", "np", "create"]
+
+
+def check_label_shapes(labels, preds, shape=0):
+    if shape == 0:
+        label_shape, pred_shape = len(labels), len(preds)
+    else:
+        label_shape, pred_shape = labels.shape, preds.shape
+    if label_shape != pred_shape:
+        raise ValueError(f"Shape of labels {label_shape} does not match "
+                         f"shape of predictions {pred_shape}")
+
+
+class EvalMetric:
+    """Base metric. reference: metric.py:21-85."""
+
+    def __init__(self, name, num=None):
+        self.name = name
+        self.num = num
+        self.reset()
+
+    def update(self, labels, preds):
+        raise NotImplementedError
+
+    def reset(self):
+        if self.num is None:
+            self.num_inst = 0
+            self.sum_metric = 0.0
+        else:
+            self.num_inst = [0] * self.num
+            self.sum_metric = [0.0] * self.num
+
+    def get(self):
+        if self.num is None:
+            if self.num_inst == 0:
+                return (self.name, float("nan"))
+            return (self.name, self.sum_metric / self.num_inst)
+        names = [f"{self.name}_{i}" for i in range(self.num)]
+        values = [x / y if y != 0 else float("nan")
+                  for x, y in zip(self.sum_metric, self.num_inst)]
+        return (names, values)
+
+    def get_name_value(self):
+        name, value = self.get()
+        if not isinstance(name, list):
+            name = [name]
+        if not isinstance(value, list):
+            value = [value]
+        return list(zip(name, value))
+
+    def __str__(self):
+        return f"EvalMetric: {dict(self.get_name_value())}"
+
+
+class CompositeEvalMetric(EvalMetric):
+    """reference: metric.py:86."""
+
+    def __init__(self, metrics=None, name="composite"):
+        super().__init__(name)
+        self.metrics = metrics if metrics is not None else []
+
+    def add(self, metric):
+        self.metrics.append(create(metric))
+
+    def get_metric(self, index):
+        try:
+            return self.metrics[index]
+        except IndexError:
+            return ValueError(f"Metric index {index} is out of range")
+
+    def update(self, labels, preds):
+        for metric in self.metrics:
+            metric.update(labels, preds)
+
+    def reset(self):
+        try:
+            for metric in self.metrics:
+                metric.reset()
+        except AttributeError:
+            pass
+
+    def get(self):
+        names = []
+        results = []
+        for metric in self.metrics:
+            result = metric.get()
+            names.append(result[0])
+            results.append(result[1])
+        return (names, results)
+
+
+class Accuracy(EvalMetric):
+    """reference: metric.py:132."""
+
+    def __init__(self):
+        super().__init__("accuracy")
+
+    def update(self, labels, preds):
+        check_label_shapes(labels, preds)
+        for label, pred_label in zip(labels, preds):
+            pred = pred_label.asnumpy() if isinstance(pred_label, NDArray) \
+                else _np.asarray(pred_label)
+            if pred.ndim > 1 and pred.shape != _np.asarray(
+                    label.asnumpy() if isinstance(label, NDArray)
+                    else label).shape:
+                pred = _np.argmax(pred, axis=1)
+            lab = (label.asnumpy() if isinstance(label, NDArray)
+                   else _np.asarray(label)).astype("int32")
+            pred = pred.astype("int32").reshape(lab.shape)
+            self.sum_metric += int((pred.flat == lab.flat).sum())
+            self.num_inst += len(pred.flat)
+
+
+class TopKAccuracy(EvalMetric):
+    """reference: metric.py:152."""
+
+    def __init__(self, top_k=1):
+        super().__init__("top_k_accuracy")
+        self.top_k = top_k
+        assert self.top_k > 1, "Please use Accuracy if top_k is no more than 1"
+        self.name += f"_{self.top_k}"
+
+    def update(self, labels, preds):
+        check_label_shapes(labels, preds)
+        for label, pred_label in zip(labels, preds):
+            pred = _np.argsort(pred_label.asnumpy().astype("float32"), axis=1)
+            lab = label.asnumpy().astype("int32")
+            check_label_shapes(lab, pred)
+            num_samples = pred.shape[0]
+            num_dims = len(pred.shape)
+            if num_dims == 1:
+                self.sum_metric += (pred.flat == lab.flat).sum()
+            elif num_dims == 2:
+                num_classes = pred.shape[1]
+                top_k = min(num_classes, self.top_k)
+                for j in range(top_k):
+                    self.sum_metric += (
+                        pred[:, num_classes - 1 - j].flat == lab.flat).sum()
+            self.num_inst += num_samples
+
+
+class F1(EvalMetric):
+    """Binary F1. reference: metric.py:183."""
+
+    def __init__(self):
+        super().__init__("f1")
+
+    def update(self, labels, preds):
+        check_label_shapes(labels, preds)
+        for label, pred in zip(labels, preds):
+            pred = pred.asnumpy()
+            label = label.asnumpy().astype("int32")
+            pred_label = _np.argmax(pred, axis=1)
+            check_label_shapes(label, pred)
+            if len(_np.unique(label)) > 2:
+                raise ValueError("F1 currently only supports binary "
+                                 "classification.")
+            true_pos = ((pred_label == 1) & (label == 1)).sum()
+            false_pos = ((pred_label == 1) & (label == 0)).sum()
+            false_neg = ((pred_label == 0) & (label == 1)).sum()
+            precision = true_pos / (true_pos + false_pos) \
+                if true_pos + false_pos > 0 else 0.0
+            recall = true_pos / (true_pos + false_neg) \
+                if true_pos + false_neg > 0 else 0.0
+            f1_score = 2 * (precision * recall) / (precision + recall) \
+                if precision + recall > 0 else 0.0
+            self.sum_metric += f1_score
+            self.num_inst += 1
+
+
+class Perplexity(EvalMetric):
+    """reference: metric.py:230."""
+
+    def __init__(self, ignore_label, axis=-1):
+        super().__init__("Perplexity")
+        self.ignore_label = ignore_label
+        self.axis = axis
+
+    def update(self, labels, preds):
+        assert len(labels) == len(preds)
+        loss = 0.0
+        num = 0
+        for label, pred in zip(labels, preds):
+            lab = label.asnumpy().astype("int32").reshape(-1)
+            prob = pred.asnumpy().reshape(-1, pred.shape[-1] if self.axis
+                                          in (-1, pred.ndim - 1)
+                                          else pred.shape[self.axis])
+            picked = prob[_np.arange(lab.shape[0]), lab]
+            if self.ignore_label is not None:
+                ignore = (lab == self.ignore_label)
+                picked = _np.where(ignore, 1.0, picked)
+                num -= int(ignore.sum())
+            loss -= _np.sum(_np.log(_np.maximum(1e-10, picked)))
+            num += lab.shape[0]
+        self.sum_metric += float(math.exp(loss / max(num, 1))) * max(num, 1)
+        self.num_inst += max(num, 1)
+
+    def get(self):
+        if self.num_inst == 0:
+            return (self.name, float("nan"))
+        return (self.name, self.sum_metric / self.num_inst)
+
+
+class MAE(EvalMetric):
+    """reference: metric.py:274."""
+
+    def __init__(self):
+        super().__init__("mae")
+
+    def update(self, labels, preds):
+        check_label_shapes(labels, preds)
+        for label, pred in zip(labels, preds):
+            label = label.asnumpy()
+            pred = pred.asnumpy()
+            if len(label.shape) == 1:
+                label = label.reshape(label.shape[0], 1)
+            if pred.shape != label.shape:
+                pred = pred.reshape(label.shape)
+            self.sum_metric += _np.abs(label - pred).mean()
+            self.num_inst += 1
+
+
+class MSE(EvalMetric):
+    """reference: metric.py:293."""
+
+    def __init__(self):
+        super().__init__("mse")
+
+    def update(self, labels, preds):
+        check_label_shapes(labels, preds)
+        for label, pred in zip(labels, preds):
+            label = label.asnumpy()
+            pred = pred.asnumpy()
+            if len(label.shape) == 1:
+                label = label.reshape(label.shape[0], 1)
+            if pred.shape != label.shape:
+                pred = pred.reshape(label.shape)
+            self.sum_metric += ((label - pred) ** 2.0).mean()
+            self.num_inst += 1
+
+
+class RMSE(EvalMetric):
+    """reference: metric.py:311."""
+
+    def __init__(self):
+        super().__init__("rmse")
+
+    def update(self, labels, preds):
+        check_label_shapes(labels, preds)
+        for label, pred in zip(labels, preds):
+            label = label.asnumpy()
+            pred = pred.asnumpy()
+            if len(label.shape) == 1:
+                label = label.reshape(label.shape[0], 1)
+            if pred.shape != label.shape:
+                pred = pred.reshape(label.shape)
+            self.sum_metric += _np.sqrt(((label - pred) ** 2.0).mean())
+            self.num_inst += 1
+
+
+class CrossEntropy(EvalMetric):
+    """reference: metric.py:329."""
+
+    def __init__(self, eps=1e-8):
+        super().__init__("cross-entropy")
+        self.eps = eps
+
+    def update(self, labels, preds):
+        check_label_shapes(labels, preds)
+        for label, pred in zip(labels, preds):
+            label = label.asnumpy()
+            pred = pred.asnumpy()
+            label = label.ravel()
+            assert label.shape[0] == pred.shape[0]
+            prob = pred[_np.arange(label.shape[0]), _np.int64(label)]
+            self.sum_metric += (-_np.log(prob + self.eps)).sum()
+            self.num_inst += label.shape[0]
+
+
+class CustomMetric(EvalMetric):
+    """Wrap a python feval. reference: metric.py:364."""
+
+    def __init__(self, feval, name=None, allow_extra_outputs=False):
+        if name is None:
+            name = feval.__name__
+            if name.find("<") != -1:
+                name = f"custom({name})"
+        super().__init__(name)
+        self._feval = feval
+        self._allow_extra_outputs = allow_extra_outputs
+
+    def update(self, labels, preds):
+        if not self._allow_extra_outputs:
+            check_label_shapes(labels, preds)
+        for pred, label in zip(preds, labels):
+            label = label.asnumpy()
+            pred = pred.asnumpy()
+            reval = self._feval(label, pred)
+            if isinstance(reval, tuple):
+                (sum_metric, num_inst) = reval
+                self.sum_metric += sum_metric
+                self.num_inst += num_inst
+            else:
+                self.sum_metric += reval
+                self.num_inst += 1
+
+
+def np(numpy_feval, name=None, allow_extra_outputs=False):
+    """Wrap a numpy feval into a metric. reference: metric.py:405."""
+    def feval(label, pred):
+        return numpy_feval(label, pred)
+    feval.__name__ = numpy_feval.__name__
+    return CustomMetric(feval, name, allow_extra_outputs)
+
+
+def create(metric, **kwargs):
+    """Create by name/callable/list. reference: metric.py:430."""
+    if callable(metric):
+        return CustomMetric(metric)
+    if isinstance(metric, EvalMetric):
+        return metric
+    if isinstance(metric, list):
+        composite_metric = CompositeEvalMetric()
+        for child_metric in metric:
+            composite_metric.add(create(child_metric, **kwargs))
+        return composite_metric
+    metrics = {
+        "acc": Accuracy, "accuracy": Accuracy, "f1": F1,
+        "mae": MAE, "mse": MSE, "rmse": RMSE,
+        "ce": CrossEntropy, "cross-entropy": CrossEntropy,
+        "top_k_accuracy": TopKAccuracy, "perplexity": Perplexity,
+    }
+    try:
+        return metrics[metric.lower()](**kwargs)
+    except Exception:
+        raise ValueError(f"Metric must be either callable or in "
+                         f"{sorted(metrics)}")

@@ -1,0 +1,8 @@
+"""Model zoo: symbol builders for the reference's example configs
+(reference: example/image-classification/symbols/, example/rnn/)."""
+from . import mlp
+from . import lenet
+from . import resnet
+from . import alexnet
+from . import vgg
+from . import inception_bn

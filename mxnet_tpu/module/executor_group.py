@@ -1,0 +1,275 @@
+"""DataParallelExecutorGroup: device-parallel execution of one symbol.
+
+Reference design (reference: python/mxnet/module/executor_group.py, 651 LoC):
+slice the batch across devices (``decide_slices``, :207-231), bind one
+Executor per context (:537-629), fan out forward/backward, sum gradients via
+KVStore.
+
+TPU-native design — the central SPMD decision of this framework: bind ONE
+executor whose data arrays are sharded over a ``jax.sharding.Mesh`` data
+axis and whose params are replicated. XLA's SPMD partitioner then runs the
+very same jitted fwd+bwd program on every chip and inserts the gradient
+all-reduce (psum over ICI) automatically — replacing the reference's
+per-device executors + KVStore push/pull with compiler-inserted collectives
+(SURVEY.md §5.8 "TPU-native equivalent"). The class keeps the reference's
+surface (param_arrays/grad_arrays/forward/backward/update_metric) so Module
+and the KVStore update paths work unchanged: with one logical executor,
+``param_arrays`` holds one entry per param.
+"""
+from __future__ import annotations
+
+import logging
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..base import MXNetError
+from ..ndarray import NDArray, zeros as nd_zeros
+from ..io import DataDesc
+
+__all__ = ["DataParallelExecutorGroup"]
+
+
+class DataParallelExecutorGroup:
+    def __init__(self, symbol, contexts, workload, data_shapes, label_shapes,
+                 param_names, for_training, inputs_need_grad,
+                 shared_group=None, logger=logging, fixed_param_names=None,
+                 grad_req="write", state_names=None):
+        self.symbol = symbol
+        self.contexts = contexts
+        self.workload = workload
+        self.for_training = for_training
+        self.inputs_need_grad = inputs_need_grad
+        self.logger = logger
+        self.fixed_param_names = fixed_param_names or []
+        self.state_names = state_names or []
+        self.param_names = param_names
+
+        self.arg_names = symbol.list_arguments()
+        self.aux_names = symbol.list_auxiliary_states()
+
+        data_shapes = [x if isinstance(x, DataDesc) else DataDesc(*x)
+                       for x in data_shapes]
+        if label_shapes is not None:
+            label_shapes = [x if isinstance(x, DataDesc) else DataDesc(*x)
+                            for x in label_shapes]
+        self.data_names = [x.name for x in data_shapes]
+        self.label_names = [x.name for x in label_shapes] \
+            if label_shapes is not None else []
+
+        # grad_req per arg (reference: executor_group.py:233-268)
+        if isinstance(grad_req, str):
+            base_req = grad_req
+        else:
+            base_req = None
+        self.grad_req = {}
+        for name in self.arg_names:
+            if name in self.param_names:
+                req = (base_req or (grad_req.get(name, "null")
+                                    if isinstance(grad_req, dict) else "write"))
+                if not for_training or name in self.fixed_param_names:
+                    req = "null"
+            elif name in self.data_names:
+                req = (base_req or "write") if inputs_need_grad else "null"
+                if not for_training:
+                    req = "null"
+            else:
+                req = "null"
+            self.grad_req[name] = req
+
+        # ---- mesh construction over the bound contexts -------------------
+        devices = [c.jax_device() for c in contexts]
+        self._n_dev = len(devices)
+        if self._n_dev > 1 and len(set(devices)) != self._n_dev:
+            raise MXNetError(
+                f"contexts {contexts} resolve to only {len(set(devices))} "
+                f"distinct devices ({sorted(set(str(d) for d in devices))}). "
+                "On a CPU host set XLA_FLAGS="
+                "--xla_force_host_platform_device_count=N to get N virtual "
+                "devices.")
+        if self._n_dev > 1:
+            self._mesh = Mesh(np.array(devices), ("data",))
+            self._data_sharding = NamedSharding(self._mesh, P("data"))
+            self._repl_sharding = NamedSharding(self._mesh, P())
+        else:
+            self._mesh = None
+            self._data_sharding = None
+            self._repl_sharding = None
+
+        self.batch_size = data_shapes[0].shape[
+            DataDesc.get_batch_axis(data_shapes[0].layout)]
+        if self._n_dev > 1 and self.batch_size % self._n_dev != 0:
+            raise MXNetError(
+                f"batch size {self.batch_size} must be divisible by the "
+                f"number of devices {self._n_dev}")
+
+        self.data_shapes = data_shapes
+        self.label_shapes = label_shapes
+
+        self._bind_exec(shared_group)
+
+    # ------------------------------------------------------------------ bind
+    def _place(self, arr, kind):
+        """Device-place a jnp array: batch-sharded or replicated."""
+        if self._mesh is None:
+            return jax.device_put(arr, self.contexts[0].jax_device())
+        sharding = self._data_sharding if kind == "data" \
+            else self._repl_sharding
+        return jax.device_put(arr, sharding)
+
+    def _bind_exec(self, shared_group):
+        from ..executor import Executor
+        shapes = {d.name: d.shape for d in self.data_shapes}
+        if self.label_shapes is not None:
+            shapes.update({l.name: l.shape for l in self.label_shapes})
+        arg_shapes, out_shapes, aux_shapes = \
+            self.symbol.infer_shape(**shapes)
+        arg_types = {d.name: d.dtype for d in self.data_shapes}
+
+        shared_params = {}
+        if shared_group is not None:
+            shared_params = dict(zip(shared_group.arg_names,
+                                     shared_group.executor.arg_arrays))
+
+        args = {}
+        grads = {}
+        for name, shape in zip(self.arg_names, arg_shapes):
+            kind = "data" if (name in self.data_names or
+                              name in self.label_names) else "param"
+            if name in shared_params:
+                args[name] = shared_params[name]  # shared NDArray cell
+            else:
+                dtype = arg_types.get(name, np.float32)
+                args[name] = NDArray(self._place(
+                    jnp.zeros(shape, dtype=np.dtype(dtype)
+                              if dtype != np.float64 else np.float32), kind))
+            if self.grad_req.get(name, "null") != "null":
+                grads[name] = NDArray(self._place(
+                    jnp.zeros(shape, dtype=np.float32), kind))
+        aux = {}
+        shared_aux = {}
+        if shared_group is not None:
+            shared_aux = dict(zip(shared_group.aux_names,
+                                  shared_group.executor.aux_arrays))
+        for name, shape in zip(self.aux_names, aux_shapes):
+            aux[name] = shared_aux.get(name) or NDArray(
+                self._place(jnp.zeros(shape, dtype=np.float32), "param"))
+
+        self.executor = Executor(self.symbol, self.contexts[0], args, grads,
+                                 self.grad_req, aux)
+        self.execs = [self.executor]  # reference-compat alias
+
+        # param/grad arrays in reference layout: list (over params) of
+        # list (over "devices" — here the single logical executor)
+        self.param_arrays = [[self.executor.arg_dict[name]]
+                             for name in self.param_names]
+        self.grad_arrays = [[self.executor.grad_dict[name]]
+                            for name in self.param_names
+                            if self.grad_req.get(name, "null") != "null"]
+        # keep 1:1 with param_arrays for Module.update zip (None when fixed)
+        self.grad_arrays = [[self.executor.grad_dict.get(name)]
+                            for name in self.param_names]
+        self.aux_arrays = [[a] for a in self.executor.aux_arrays]
+
+        self.data_arrays = [self.executor.arg_dict[name]
+                            for name in self.data_names]
+        self.label_arrays = [self.executor.arg_dict[name]
+                             for name in self.label_names
+                             if name in self.executor.arg_dict]
+
+    # -------------------------------------------------------------- params
+    def set_params(self, arg_params, aux_params):
+        """reference: executor_group.py set_params -> copy into the bound
+        arrays, preserving sharded placement."""
+        ad = self.executor.arg_dict
+        for name, arr in arg_params.items():
+            if name in ad:
+                val = arr.asjax() if isinstance(arr, NDArray) \
+                    else jnp.asarray(arr)
+                ad[name]._set(self._place(val.astype(ad[name].dtype),
+                                          "param"))
+        xd = self.executor.aux_dict
+        for name, arr in (aux_params or {}).items():
+            if name in xd:
+                val = arr.asjax() if isinstance(arr, NDArray) \
+                    else jnp.asarray(arr)
+                xd[name]._set(self._place(val.astype(xd[name].dtype),
+                                          "param"))
+
+    def get_params(self, arg_params, aux_params):
+        """Copy params out (device->host). reference: executor_group.py."""
+        for name in self.param_names:
+            arg_params[name] = self.executor.arg_dict[name].copy()
+        for name in self.aux_names:
+            aux_params[name] = self.executor.aux_dict[name].copy()
+
+    # -------------------------------------------------------------- forward
+    def forward(self, data_batch, is_train=None):
+        """Load the full batch sharded over the mesh and run.
+
+        reference: executor_group.py:355-379 _load_data + per-exec forward;
+        here the shard happens in jax.device_put (host->HBM splits, which
+        overlap with compute thanks to async dispatch).
+        """
+        if is_train is None:
+            is_train = self.for_training
+        kwargs = {}
+        for name, arr in zip(self.data_names, data_batch.data):
+            val = arr.asjax() if isinstance(arr, NDArray) else jnp.asarray(
+                np.asarray(arr))
+            dst = self.executor.arg_dict[name]
+            kwargs[name] = None
+            dst._set(self._place(val.astype(dst.dtype), "data"))
+        if is_train or True:
+            if self.label_names and data_batch.label:
+                for name, arr in zip(self.label_names, data_batch.label):
+                    if name not in self.executor.arg_dict:
+                        continue
+                    val = arr.asjax() if isinstance(arr, NDArray) else \
+                        jnp.asarray(np.asarray(arr))
+                    dst = self.executor.arg_dict[name]
+                    dst._set(self._place(val.astype(dst.dtype), "data"))
+        self.executor.forward(is_train=is_train)
+
+    def backward(self, out_grads=None):
+        assert self.for_training, "re-bind with for_training=True"
+        self.executor.backward(out_grads=out_grads)
+
+    # -------------------------------------------------------------- outputs
+    def get_outputs(self, merge_multi_context=True):
+        outs = self.executor.outputs
+        if merge_multi_context:
+            return outs
+        return [[o] for o in outs]
+
+    def get_input_grads(self, merge_multi_context=True):
+        assert self.inputs_need_grad
+        grads = [self.executor.grad_dict[name] for name in self.data_names]
+        if merge_multi_context:
+            return grads
+        return [[g] for g in grads]
+
+    def update_metric(self, eval_metric, labels):
+        """reference: executor_group.py:510 — metric on device outputs."""
+        eval_metric.update(labels, self.executor.outputs)
+
+    def get_states(self, merge_multi_context=True):
+        assert not self.state_names
+        return []
+
+    def set_states(self, states=None, value=None):
+        pass
+
+    def install_monitor(self, mon):
+        mon.install_exe(self.executor)
+
+    def bind_exec(self, data_shapes, label_shapes, shared_group=None,
+                  reshape=False):
+        self.data_shapes = [x if isinstance(x, DataDesc) else DataDesc(*x)
+                            for x in data_shapes]
+        if label_shapes is not None:
+            self.label_shapes = [x if isinstance(x, DataDesc)
+                                 else DataDesc(*x) for x in label_shapes]
+        self._bind_exec(shared_group)

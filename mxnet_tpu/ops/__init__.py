@@ -1,0 +1,6 @@
+"""Operator library: importing this package populates the registry."""
+from .registry import OP_REGISTRY, get_op, list_ops, register, alias
+from . import tensor  # noqa: F401 — registers tensor ops
+from . import nn  # noqa: F401 — registers layer ops
+from . import loss  # noqa: F401 — registers loss heads
+from . import optimizer_op  # noqa: F401 — registers fused updates

@@ -1,0 +1,261 @@
+"""Output/loss-head ops.
+
+The reference's output ops (SoftmaxOutput, the regression outputs, MakeLoss,
+SVMOutput — reference: src/operator/softmax_output-inl.h:1-381,
+regression_output-inl.h, make_loss-inl.h, svm_output-inl.h) have a special
+contract: their *backward ignores the incoming head gradient* and emits the
+loss gradient directly ((p - onehot(label)) * grad_scale for softmax). They
+are simultaneously "predict heads" (forward output = prediction) and "loss
+heads" (backward = loss grad).
+
+TPU-native realization: ``jax.custom_vjp`` (attrs as static nondiff args)
+pins the exact same gradient, so ``jax.vjp`` over the composed graph — the
+replacement for the NNVM Gradient pass — produces identical cotangents to
+the reference's hand-written backward kernels. The executor seeds ones as
+head cotangents for ops marked ``is_loss`` (matching GraphExecutor's
+head-grad entries, graph_executor.cc:178-230).
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..base import parse_bool, parse_float, parse_int
+from .registry import register, alias
+
+
+# --------------------------------------------------------------------------
+# SoftmaxOutput
+# --------------------------------------------------------------------------
+def _softmax_out_fwd_impl(data, label, attrs):
+    multi = parse_bool(attrs.get("multi_output", False))
+    if multi:
+        prob = jax.nn.softmax(data, axis=1)
+    elif data.ndim == 1:
+        prob = jax.nn.softmax(data, axis=-1)
+    else:
+        prob = jax.nn.softmax(data.reshape(data.shape[0], -1),
+                              axis=-1).reshape(data.shape)
+    return prob
+
+
+def _softmax_out_grad(prob, label, attrs):
+    multi = parse_bool(attrs.get("multi_output", False))
+    grad_scale = parse_float(attrs.get("grad_scale", 1.0))
+    use_ignore = parse_bool(attrs.get("use_ignore", False))
+    ignore_label = parse_float(attrs.get("ignore_label", -1.0))
+    normalization = attrs.get("normalization", "null")
+    if multi:
+        # data (n, c, d1...) label (n, d1...)
+        onehot = jax.nn.one_hot(label.astype(jnp.int32), prob.shape[1],
+                                axis=1, dtype=prob.dtype)
+        grad = prob - onehot
+        mask = jnp.ones_like(label, dtype=prob.dtype)
+        if use_ignore:
+            mask = (label != ignore_label).astype(prob.dtype)
+        grad = grad * jnp.expand_dims(mask, 1)
+        valid = jnp.sum(mask)
+    else:
+        flat = prob.reshape(prob.shape[0], -1)
+        onehot = jax.nn.one_hot(label.astype(jnp.int32).reshape(-1),
+                                flat.shape[-1], dtype=prob.dtype)
+        grad = (flat - onehot).reshape(prob.shape)
+        mask = jnp.ones((prob.shape[0],), dtype=prob.dtype)
+        if use_ignore:
+            mask = (label.reshape(-1) != ignore_label).astype(prob.dtype)
+        grad = grad * mask.reshape((-1,) + (1,) * (prob.ndim - 1))
+        valid = jnp.sum(mask)
+    if normalization == "batch":
+        grad = grad / prob.shape[0]
+    elif normalization == "valid":
+        grad = grad / jnp.maximum(valid, 1.0)
+    return grad * grad_scale
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _softmax_output_fn(data, label, attrs_tuple):
+    return _softmax_out_fwd_impl(data, label, dict(attrs_tuple))
+
+
+def _softmax_output_fwd(data, label, attrs_tuple):
+    prob = _softmax_out_fwd_impl(data, label, dict(attrs_tuple))
+    return prob, (prob, label)
+
+
+def _softmax_output_bwd(attrs_tuple, res, g):
+    prob, label = res
+    # reference semantics: head grad ignored, loss grad emitted directly
+    grad = _softmax_out_grad(prob, label, dict(attrs_tuple))
+    return grad.astype(prob.dtype), jnp.zeros_like(label)
+
+
+_softmax_output_fn.defvjp(_softmax_output_fwd, _softmax_output_bwd)
+
+_SOFTMAX_ATTRS = {
+    "grad_scale": (parse_float, 1.0), "ignore_label": (parse_float, -1.0),
+    "multi_output": (parse_bool, False), "use_ignore": (parse_bool, False),
+    "preserve_shape": (parse_bool, False), "normalization": (None, "null"),
+    "out_grad": (parse_bool, False),
+}
+
+
+def _softmax_infer(attrs, in_shapes):
+    data_s = in_shapes[0]
+    label_s = in_shapes[1] if len(in_shapes) > 1 else None
+    if data_s is not None:
+        if parse_bool(attrs.get("multi_output", False)):
+            label_s = (data_s[0],) + tuple(data_s[2:])
+        else:
+            label_s = (data_s[0],)
+    return [data_s, label_s], [data_s], []
+
+
+@register("SoftmaxOutput", inputs=("data", "label"), is_loss=True,
+          attr_spec=dict(_SOFTMAX_ATTRS), infer_shape=_softmax_infer)
+def _softmax_output_op(attrs, data, label):
+    return _softmax_output_fn(data, label, tuple(sorted(attrs.items())))
+
+alias("Softmax", "SoftmaxOutput")
+
+
+# --------------------------------------------------------------------------
+# Regression outputs (reference: regression_output-inl.h) — forward is
+# identity/sigmoid; backward = (pred - label) * grad_scale / num_output
+# --------------------------------------------------------------------------
+def _make_regression(transform, grad_fn):
+    @partial(jax.custom_vjp, nondiff_argnums=(2,))
+    def reg(data, label, grad_scale):
+        return transform(data)
+
+    def fwd(data, label, grad_scale):
+        out = transform(data)
+        return out, (out, label)
+
+    def bwd(grad_scale, res, g):
+        out, label = res
+        denom = out.size // out.shape[0] if out.ndim > 1 else 1
+        grad = grad_fn(out, label.reshape(out.shape)) * grad_scale / denom
+        return grad.astype(out.dtype), jnp.zeros_like(label)
+
+    reg.defvjp(fwd, bwd)
+    return reg
+
+
+_LINREG = _make_regression(lambda x: x, lambda o, l: o - l)
+_LOGREG = _make_regression(jax.nn.sigmoid, lambda o, l: o - l)
+_MAEREG = _make_regression(lambda x: x, lambda o, l: jnp.sign(o - l))
+
+_REG_ATTRS = {"grad_scale": (parse_float, 1.0)}
+
+
+def _reg_infer(attrs, in_shapes):
+    data_s = in_shapes[0]
+    return [data_s, data_s], [data_s], []
+
+
+for _name, _fn in (("LinearRegressionOutput", _LINREG),
+                   ("LogisticRegressionOutput", _LOGREG),
+                   ("MAERegressionOutput", _MAEREG)):
+    register(_name, inputs=("data", "label"), is_loss=True,
+             attr_spec=dict(_REG_ATTRS), infer_shape=_reg_infer,
+             simple=(lambda attrs, data, label, _f=_fn:
+                     _f(data, label,
+                        parse_float(attrs.get("grad_scale", 1.0)))))
+
+
+# --------------------------------------------------------------------------
+# SVMOutput (reference: svm_output-inl.h)
+# --------------------------------------------------------------------------
+@partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+def _svm_fn(data, label, margin, reg_coef, use_linear):
+    return data
+
+
+def _svm_fwd(data, label, margin, reg_coef, use_linear):
+    return data, (data, label)
+
+
+def _svm_bwd(margin, reg_coef, use_linear, res, g):
+    data, label = res
+    n, c = data.shape
+    onehot = jax.nn.one_hot(label.astype(jnp.int32), c, dtype=data.dtype)
+    score_correct = jnp.sum(data * onehot, axis=1, keepdims=True)
+    viol = data - score_correct + margin
+    if use_linear:
+        mask = ((viol > 0).astype(data.dtype)) * (1 - onehot)
+        grad = mask - onehot * jnp.sum(mask, axis=1, keepdims=True)
+    else:
+        maskv = jnp.maximum(viol, 0) * (1 - onehot)
+        grad = 2 * maskv - 2 * onehot * jnp.sum(maskv, axis=1, keepdims=True)
+    return grad * reg_coef, jnp.zeros_like(label)
+
+
+_svm_fn.defvjp(_svm_fwd, _svm_bwd)
+
+
+@register("SVMOutput", inputs=("data", "label"), is_loss=True,
+          attr_spec={"margin": (parse_float, 1.0),
+                     "regularization_coefficient": (parse_float, 1.0),
+                     "use_linear": (parse_bool, False)},
+          infer_shape=lambda attrs, s: ([s[0], (s[0][0],) if s[0] else None],
+                                        [s[0]], []))
+def _svm_output(attrs, data, label):
+    return _svm_fn(data, label, parse_float(attrs.get("margin", 1.0)),
+                   parse_float(attrs.get("regularization_coefficient", 1.0)),
+                   parse_bool(attrs.get("use_linear", False)))
+
+
+# --------------------------------------------------------------------------
+# MakeLoss (reference: make_loss-inl.h) — forward identity, backward = ones *
+# grad_scale (turns any symbol into a loss)
+# --------------------------------------------------------------------------
+@partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def _makeloss_fn(data, grad_scale, norm):
+    return data
+
+
+def _makeloss_fwd(data, grad_scale, norm):
+    return data, data
+
+
+def _makeloss_bwd(grad_scale, norm, res, g):
+    shape, dtype = res.shape, res.dtype
+    grad = jnp.full(shape, grad_scale, dtype=dtype)
+    if norm == "batch":
+        grad = grad / shape[0]
+    elif norm == "valid":
+        grad = grad / float(np.prod(shape))
+    return (grad,)
+
+
+_makeloss_fn.defvjp(_makeloss_fwd, _makeloss_bwd)
+
+
+@register("MakeLoss", inputs=("data",), is_loss=True,
+          attr_spec={"grad_scale": (parse_float, 1.0),
+                     "valid_thresh": (parse_float, 0.0),
+                     "normalization": (None, "null")},
+          infer_shape=lambda attrs, s: (s, [s[0]], []))
+def _make_loss_op(attrs, data):
+    return _makeloss_fn(data, parse_float(attrs.get("grad_scale", 1.0)),
+                        attrs.get("normalization", "null"))
+
+
+@register("IdentityAttachKLSparseReg", inputs=("data",),
+          attr_spec={"sparseness_target": (parse_float, 0.1),
+                     "penalty": (parse_float, 0.001),
+                     "momentum": (parse_float, 0.9)},
+          infer_shape=lambda attrs, s: (s, [s[0]], []))
+def _identity_kl(attrs, data):
+    # identity forward; the KL-sparsity penalty enters only through the
+    # gradient (value-zero term kl - stop_gradient(kl))
+    target = parse_float(attrs.get("sparseness_target", 0.1))
+    penalty = parse_float(attrs.get("penalty", 0.001))
+    rho_hat = jnp.mean(data, axis=0, keepdims=True)
+    kl = penalty * jnp.sum(
+        target * jnp.log(target / (rho_hat + 1e-12)) +
+        (1 - target) * jnp.log((1 - target) / (1 - rho_hat + 1e-12)))
+    return data + (kl - jax.lax.stop_gradient(kl))

@@ -1,0 +1,801 @@
+"""Neural-network layer ops.
+
+The reference implements these as stateful ``OperatorProperty`` classes over
+mshadow/cuDNN (reference: src/operator/*-inl.h, e.g. convolution-inl.h:1-570,
+batch_norm-inl.h:1-358). Here each layer is a pure JAX function registered in
+the unified registry; XLA lowers convs/matmuls onto the MXU and fuses the
+elementwise epilogues, which is what cuDNN kernel selection + mshadow fusion
+did for GPUs.
+
+API conventions preserved from the reference: NCHW data layout, the same
+parameter names (kernel/stride/pad/num_filter/num_hidden/...), auto-created
+weight/bias inputs with bidirectional shape inference (weight shapes deduced
+from data shapes at bind time), aux states for BatchNorm moving stats.
+
+dtype note: inputs compute in their incoming dtype — bfloat16 flows through
+every layer untouched (TPU-native mixed precision); BatchNorm statistics are
+accumulated in float32 regardless of input dtype for stability.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import parse_tuple, parse_bool, parse_int, parse_float
+from .registry import register, alias
+
+
+def _pair(v, default):
+    t = parse_tuple(v, None) if v is not None else None
+    if t is None:
+        return default
+    if len(t) == 1:
+        return (t[0], t[0])
+    return t
+
+
+# --------------------------------------------------------------------------
+# FullyConnected (reference: fully_connected-inl.h)
+# --------------------------------------------------------------------------
+def _fc_inputs(attrs):
+    if parse_bool(attrs.get("no_bias", False)):
+        return ["data", "weight"]
+    return ["data", "weight", "bias"]
+
+
+def _fc_infer(attrs, in_shapes):
+    num_hidden = parse_int(attrs["num_hidden"])
+    no_bias = parse_bool(attrs.get("no_bias", False))
+    data_s = in_shapes[0]
+    out_s = None
+    w_s = in_shapes[1] if len(in_shapes) > 1 else None
+    if data_s is not None:
+        in_dim = int(np.prod(data_s[1:], dtype=np.int64))
+        w_s = (num_hidden, in_dim)
+        out_s = (data_s[0], num_hidden)
+    new_in = [data_s, w_s] + ([] if no_bias else [(num_hidden,)])
+    return new_in, [out_s], []
+
+
+@register("FullyConnected", inputs=_fc_inputs,
+          attr_spec={"num_hidden": (parse_int, None),
+                     "no_bias": (parse_bool, False),
+                     "flatten": (parse_bool, True)},
+          infer_shape=_fc_infer)
+def _fully_connected(attrs, data, weight, bias=None):
+    if data.ndim > 2 and attrs.get("flatten", True):
+        data = data.reshape((data.shape[0], -1))
+    # weight stored (num_hidden, in_dim) per reference layout -> x @ W^T on MXU
+    out = jnp.dot(data, weight.T.astype(data.dtype),
+                  preferred_element_type=jnp.float32).astype(data.dtype)
+    if bias is not None:
+        out = out + bias.astype(data.dtype)
+    return out
+
+
+# --------------------------------------------------------------------------
+# Convolution / Deconvolution (reference: convolution-inl.h,
+# deconvolution-inl.h; cudnn_convolution.h autotune -> XLA picks algorithms)
+# --------------------------------------------------------------------------
+_CONV_ATTRS = {
+    "kernel": (parse_tuple, None), "stride": (parse_tuple, None),
+    "dilate": (parse_tuple, None), "pad": (parse_tuple, None),
+    "num_filter": (parse_int, None), "num_group": (parse_int, 1),
+    "no_bias": (parse_bool, False), "workspace": (parse_int, 1024),
+    "cudnn_tune": (None, None), "cudnn_off": (parse_bool, False),
+    "layout": (None, None),
+}
+
+
+def _conv_inputs(attrs):
+    if parse_bool(attrs.get("no_bias", False)):
+        return ["data", "weight"]
+    return ["data", "weight", "bias"]
+
+
+def _conv_out_dim(in_dim, k, s, p, d):
+    return (in_dim + 2 * p - (d * (k - 1) + 1)) // s + 1
+
+
+def _conv_infer(attrs, in_shapes):
+    kernel = parse_tuple(attrs["kernel"])
+    nf = parse_int(attrs["num_filter"])
+    ng = parse_int(attrs.get("num_group", 1))
+    no_bias = parse_bool(attrs.get("no_bias", False))
+    nd = len(kernel)
+    stride = _ntuple(attrs.get("stride"), nd, 1)
+    pad = _ntuple(attrs.get("pad"), nd, 0)
+    dilate = _ntuple(attrs.get("dilate"), nd, 1)
+    data_s = in_shapes[0]
+    w_s, out_s = None, None
+    if data_s is not None:
+        cin = data_s[1]
+        w_s = (nf, cin // ng) + kernel
+        spatial = tuple(_conv_out_dim(data_s[2 + i], kernel[i], stride[i],
+                                      pad[i], dilate[i]) for i in range(nd))
+        out_s = (data_s[0], nf) + spatial
+    new_in = [data_s, w_s] + ([] if no_bias else [(nf,)])
+    return new_in, [out_s], []
+
+
+def _ntuple(v, n, default):
+    t = parse_tuple(v) if v is not None else None
+    if t is None:
+        return (default,) * n
+    if len(t) != n:
+        t = tuple(t) + (default,) * (n - len(t))
+    return t
+
+
+@register("Convolution", inputs=_conv_inputs, attr_spec=dict(_CONV_ATTRS),
+          infer_shape=_conv_infer)
+def _convolution(attrs, data, weight, bias=None):
+    kernel = parse_tuple(attrs["kernel"])
+    nd = len(kernel)
+    stride = _ntuple(attrs.get("stride"), nd, 1)
+    pad = _ntuple(attrs.get("pad"), nd, 0)
+    dilate = _ntuple(attrs.get("dilate"), nd, 1)
+    ng = parse_int(attrs.get("num_group", 1))
+    if nd == 1:
+        dn = lax.conv_dimension_numbers(data.shape, weight.shape,
+                                        ("NCH", "OIH", "NCH"))
+    elif nd == 2:
+        dn = lax.conv_dimension_numbers(data.shape, weight.shape,
+                                        ("NCHW", "OIHW", "NCHW"))
+    else:
+        dn = lax.conv_dimension_numbers(data.shape, weight.shape,
+                                        ("NCDHW", "OIDHW", "NCDHW"))
+    out = lax.conv_general_dilated(
+        data, weight.astype(data.dtype), stride,
+        [(p, p) for p in pad], rhs_dilation=dilate,
+        dimension_numbers=dn, feature_group_count=ng,
+        preferred_element_type=jnp.float32).astype(data.dtype)
+    if bias is not None:
+        out = out + bias.astype(data.dtype).reshape((1, -1) + (1,) * nd)
+    return out
+
+alias("Convolution_v1", "Convolution")
+
+
+def _deconv_infer(attrs, in_shapes):
+    kernel = parse_tuple(attrs["kernel"])
+    nf = parse_int(attrs["num_filter"])
+    ng = parse_int(attrs.get("num_group", 1))
+    no_bias = parse_bool(attrs.get("no_bias", True))
+    nd = len(kernel)
+    stride = _ntuple(attrs.get("stride"), nd, 1)
+    pad = _ntuple(attrs.get("pad"), nd, 0)
+    adj = _ntuple(attrs.get("adj"), nd, 0)
+    data_s = in_shapes[0]
+    w_s, out_s = None, None
+    if data_s is not None:
+        cin = data_s[1]
+        w_s = (cin, nf // ng) + kernel
+        spatial = tuple(stride[i] * (data_s[2 + i] - 1) + kernel[i]
+                        - 2 * pad[i] + adj[i] for i in range(nd))
+        out_s = (data_s[0], nf) + spatial
+    new_in = [data_s, w_s] + ([] if no_bias else [(nf,)])
+    return new_in, [out_s], []
+
+
+@register("Deconvolution", inputs=_conv_inputs,
+          attr_spec={**_CONV_ATTRS, "adj": (parse_tuple, None),
+                     "target_shape": (parse_tuple, None),
+                     "no_bias": (parse_bool, True)},
+          infer_shape=_deconv_infer)
+def _deconvolution(attrs, data, weight, bias=None):
+    kernel = parse_tuple(attrs["kernel"])
+    nd = len(kernel)
+    stride = _ntuple(attrs.get("stride"), nd, 1)
+    pad = _ntuple(attrs.get("pad"), nd, 0)
+    ng = parse_int(attrs.get("num_group", 1))
+    spec = ("NCHW", "IOHW", "NCHW") if nd == 2 else ("NCH", "IOH", "NCH")
+    dn = lax.conv_dimension_numbers(data.shape, weight.shape, spec)
+    out = lax.conv_transpose(
+        data, weight.astype(data.dtype), stride,
+        [(p, p) for p in pad], dimension_numbers=dn,
+        transpose_kernel=True) if ng == 1 else _grouped_deconv(
+            data, weight, stride, pad, dn, ng)
+    if bias is not None:
+        out = out + bias.astype(data.dtype).reshape((1, -1) + (1,) * nd)
+    return out
+
+
+def _grouped_deconv(data, weight, stride, pad, dn, ng):
+    xs = jnp.split(data, ng, axis=1)
+    ws = jnp.split(weight, ng, axis=0)
+    outs = [lax.conv_transpose(x, w.astype(x.dtype), stride,
+                               [(p, p) for p in pad], dimension_numbers=dn,
+                               transpose_kernel=True)
+            for x, w in zip(xs, ws)]
+    return jnp.concatenate(outs, axis=1)
+
+
+# --------------------------------------------------------------------------
+# Pooling (reference: pooling-inl.h + nn/pool.h kernels)
+# --------------------------------------------------------------------------
+def _pool_infer(attrs, in_shapes):
+    data_s = in_shapes[0]
+    if data_s is None:
+        return in_shapes, [None], []
+    kernel = parse_tuple(attrs["kernel"])
+    nd = len(kernel)
+    stride = _ntuple(attrs.get("stride"), nd, 1)
+    pad = _ntuple(attrs.get("pad"), nd, 0)
+    if parse_bool(attrs.get("global_pool", False)):
+        out_s = data_s[:2] + (1,) * nd
+    else:
+        conv = parse_bool(attrs.get("pooling_convention", "valid") == "full")
+        dims = []
+        for i in range(nd):
+            x = data_s[2 + i] + 2 * pad[i] - kernel[i]
+            if conv:
+                dims.append(int(np.ceil(x / stride[i])) + 1)
+            else:
+                dims.append(x // stride[i] + 1)
+        out_s = data_s[:2] + tuple(dims)
+    return in_shapes, [out_s], []
+
+
+@register("Pooling", inputs=("data",),
+          attr_spec={"kernel": (parse_tuple, None), "pool_type": (None, "max"),
+                     "global_pool": (parse_bool, False),
+                     "pooling_convention": (None, "valid"),
+                     "stride": (parse_tuple, None), "pad": (parse_tuple, None)},
+          infer_shape=_pool_infer)
+def _pooling(attrs, data):
+    nd = data.ndim - 2
+    if parse_bool(attrs.get("global_pool", False)):
+        kernel = data.shape[2:]
+        stride = (1,) * nd
+        pad = (0,) * nd
+    else:
+        kernel = parse_tuple(attrs["kernel"])
+        stride = _ntuple(attrs.get("stride"), nd, 1)
+        pad = _ntuple(attrs.get("pad"), nd, 0)
+    ptype = attrs.get("pool_type", "max")
+    window = (1, 1) + tuple(kernel)
+    strides = (1, 1) + tuple(stride)
+    pads = ((0, 0), (0, 0)) + tuple((p, p) for p in pad)
+    if ptype == "max":
+        init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) else \
+            jnp.iinfo(data.dtype).min
+        return lax.reduce_window(data, init, lax.max, window, strides, pads)
+    if ptype in ("avg", "sum"):
+        summed = lax.reduce_window(data, 0.0, lax.add, window, strides, pads)
+        if ptype == "sum":
+            return summed
+        # count_include_pad=True semantics (reference default)
+        return summed / np.prod(kernel)
+    raise ValueError(f"pool_type {ptype}")
+
+alias("Pooling_v1", "Pooling")
+
+
+# --------------------------------------------------------------------------
+# Activation family (reference: activation-inl.h, leaky_relu-inl.h)
+# --------------------------------------------------------------------------
+_ID_INFER = lambda attrs, s: (s, [s[0]], [])
+
+
+@register("Activation", inputs=("data",), attr_spec={"act_type": (None, "relu")},
+          infer_shape=_ID_INFER)
+def _activation(attrs, x):
+    t = attrs.get("act_type", "relu")
+    if t == "relu":
+        return jnp.maximum(x, 0)
+    if t == "sigmoid":
+        return jax.nn.sigmoid(x)
+    if t == "tanh":
+        return jnp.tanh(x)
+    if t == "softrelu":
+        return jax.nn.softplus(x)
+    if t == "softsign":
+        return x / (1 + jnp.abs(x))
+    raise ValueError(f"act_type {t}")
+
+
+def _lrelu_inputs(attrs):
+    if attrs.get("act_type", "leaky") == "prelu":
+        return ["data", "gamma"]
+    return ["data"]
+
+
+def _lrelu_infer(attrs, in_shapes):
+    data_s = in_shapes[0]
+    if attrs.get("act_type", "leaky") == "prelu":
+        g = (data_s[1],) if data_s is not None else None
+        return [data_s, g], [data_s], []
+    return in_shapes, [data_s], []
+
+
+def _lrelu_fwd(attrs, inputs, aux, is_train, rng):
+    t = attrs.get("act_type", "leaky")
+    x = inputs[0]
+    slope = parse_float(attrs.get("slope", 0.25))
+    if t == "leaky":
+        return [jnp.where(x > 0, x, slope * x)], []
+    if t == "elu":
+        return [jnp.where(x > 0, x, slope * (jnp.exp(x) - 1))], []
+    if t == "prelu":
+        gamma = inputs[1].reshape((1, -1) + (1,) * (x.ndim - 2))
+        return [jnp.where(x > 0, x, gamma * x)], []
+    if t == "rrelu":
+        lo = parse_float(attrs.get("lower_bound", 0.125))
+        hi = parse_float(attrs.get("upper_bound", 0.334))
+        if is_train:
+            slope_r = jax.random.uniform(rng, x.shape, dtype=x.dtype,
+                                         minval=lo, maxval=hi)
+        else:
+            slope_r = (lo + hi) / 2.0
+        return [jnp.where(x > 0, x, slope_r * x)], []
+    raise ValueError(f"act_type {t}")
+
+
+register("LeakyReLU", inputs=_lrelu_inputs, full=_lrelu_fwd, need_rng=True,
+         attr_spec={"act_type": (None, "leaky"), "slope": (parse_float, 0.25),
+                    "lower_bound": (parse_float, 0.125),
+                    "upper_bound": (parse_float, 0.334)},
+         infer_shape=_lrelu_infer)
+
+
+@register("SoftmaxActivation", inputs=("data",),
+          attr_spec={"mode": (None, "instance")}, infer_shape=_ID_INFER)
+def _softmax_activation(attrs, x):
+    if attrs.get("mode", "instance") == "channel":
+        return jax.nn.softmax(x, axis=1)
+    return jax.nn.softmax(x.reshape(x.shape[0], -1), axis=-1).reshape(x.shape)
+
+
+@register("softmax", inputs=("data",), attr_spec={"axis": (parse_int, -1),
+                                                  "temperature": (None, None)})
+def _softmax_op(attrs, x):
+    t = attrs.get("temperature")
+    if t not in (None, "None"):
+        x = x / parse_float(t)
+    return jax.nn.softmax(x, axis=attrs.get("axis", -1))
+
+
+@register("log_softmax", inputs=("data",), attr_spec={"axis": (parse_int, -1)})
+def _log_softmax_op(attrs, x):
+    return jax.nn.log_softmax(x, axis=attrs.get("axis", -1))
+
+
+# --------------------------------------------------------------------------
+# BatchNorm (reference: batch_norm-inl.h; aux = moving_mean/moving_var,
+# updated in-place during training via the executor's aux swap)
+# --------------------------------------------------------------------------
+def _bn_infer(attrs, in_shapes):
+    data_s = in_shapes[0]
+    c = (data_s[1],) if data_s is not None else None
+    return [data_s, c, c], [data_s, c, c], [c, c]
+
+
+def _bn_fwd(attrs, inputs, aux, is_train, rng):
+    data, gamma, beta = inputs
+    moving_mean, moving_var = aux
+    eps = parse_float(attrs.get("eps", 1e-3))
+    momentum = parse_float(attrs.get("momentum", 0.9))
+    fix_gamma = parse_bool(attrs.get("fix_gamma", True))
+    use_global = parse_bool(attrs.get("use_global_stats", False))
+    axes = (0,) + tuple(range(2, data.ndim))
+    bshape = (1, -1) + (1,) * (data.ndim - 2)
+    if fix_gamma:
+        gamma = jnp.ones_like(gamma)
+    if is_train and not use_global:
+        x32 = data.astype(jnp.float32)
+        mean = jnp.mean(x32, axis=axes)
+        var = jnp.var(x32, axis=axes)
+        new_mean = momentum * moving_mean + (1 - momentum) * mean
+        new_var = momentum * moving_var + (1 - momentum) * var
+    else:
+        mean, var = moving_mean, moving_var
+        new_mean, new_var = moving_mean, moving_var
+    inv = lax.rsqrt(var + eps)
+    out = (data - mean.reshape(bshape).astype(data.dtype)) * \
+        (inv.reshape(bshape) * gamma.reshape(bshape)).astype(data.dtype) + \
+        beta.reshape(bshape).astype(data.dtype)
+    return [out, mean, var], [new_mean, new_var]
+
+
+register("BatchNorm", inputs=("data", "gamma", "beta"),
+         aux=("moving_mean", "moving_var"), full=_bn_fwd,
+         num_outputs=3, output_names=["output", "mean", "var"],
+         num_visible=lambda attrs: 3 if attrs.get("output_mean_var") else 1,
+         attr_spec={"eps": (parse_float, 1e-3), "momentum": (parse_float, 0.9),
+                    "fix_gamma": (parse_bool, True),
+                    "use_global_stats": (parse_bool, False),
+                    "output_mean_var": (parse_bool, False)},
+         infer_shape=_bn_infer)
+alias("CuDNNBatchNorm", "BatchNorm")
+
+
+def _in_infer(attrs, in_shapes):
+    data_s = in_shapes[0]
+    c = (data_s[1],) if data_s is not None else None
+    return [data_s, c, c], [data_s], []
+
+
+@register("InstanceNorm", inputs=("data", "gamma", "beta"),
+          attr_spec={"eps": (parse_float, 1e-3)}, infer_shape=_in_infer)
+def _instance_norm(attrs, data, gamma, beta):
+    eps = attrs.get("eps", 1e-3)
+    axes = tuple(range(2, data.ndim))
+    mean = jnp.mean(data, axis=axes, keepdims=True)
+    var = jnp.var(data, axis=axes, keepdims=True)
+    bshape = (1, -1) + (1,) * (data.ndim - 2)
+    return (data - mean) * lax.rsqrt(var + eps) * gamma.reshape(bshape) + \
+        beta.reshape(bshape)
+
+
+@register("L2Normalization", inputs=("data",),
+          attr_spec={"eps": (parse_float, 1e-10), "mode": (None, "instance")},
+          infer_shape=_ID_INFER)
+def _l2_normalization(attrs, data):
+    eps = attrs.get("eps", 1e-10)
+    mode = attrs.get("mode", "instance")
+    if mode == "instance":
+        axes = tuple(range(1, data.ndim))
+        kd = True
+    elif mode == "channel":
+        axes, kd = (1,), True
+    elif mode == "spatial":
+        axes, kd = tuple(range(2, data.ndim)), True
+    norm = jnp.sqrt(jnp.sum(jnp.square(data), axis=axes, keepdims=kd) + eps)
+    return data / norm
+
+
+@register("LRN", inputs=("data",),
+          attr_spec={"alpha": (parse_float, 1e-4), "beta": (parse_float, 0.75),
+                     "knorm": (parse_float, 2.0), "nsize": (parse_int, 5)},
+          num_outputs=2, num_visible=1, output_names=["output", "tmp_norm"],
+          infer_shape=lambda attrs, s: (s, [s[0], s[0]], []))
+def _lrn(attrs, data):
+    nsize = attrs["nsize"]
+    alpha, beta, knorm = attrs["alpha"], attrs["beta"], attrs["knorm"]
+    sq = jnp.square(data)
+    half = nsize // 2
+    padded = jnp.pad(sq, ((0, 0), (half, half), (0, 0), (0, 0)))
+    windows = sum(padded[:, i:i + data.shape[1]] for i in range(nsize))
+    norm = (knorm + alpha / nsize * windows) ** beta
+    return data / norm, norm
+
+
+# --------------------------------------------------------------------------
+# Dropout (reference: dropout-inl.h; functional rng)
+# --------------------------------------------------------------------------
+def _dropout_fwd(attrs, inputs, aux, is_train, rng):
+    x = inputs[0]
+    p = parse_float(attrs.get("p", 0.5))
+    if not is_train or p <= 0.0:
+        return [x, jnp.ones_like(x)], []
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(rng, keep, x.shape).astype(x.dtype) / keep
+    return [x * mask, mask], []
+
+
+register("Dropout", inputs=("data",), full=_dropout_fwd, need_rng=True,
+         num_outputs=2, num_visible=1, output_names=["output", "mask"],
+         attr_spec={"p": (parse_float, 0.5), "mode": (None, "training")},
+         infer_shape=lambda attrs, s: (s, [s[0], s[0]], []))
+
+
+# --------------------------------------------------------------------------
+# Concat / SliceChannel / UpSampling / Crop
+# --------------------------------------------------------------------------
+def _concat_inputs(attrs):
+    return [f"arg{i}" for i in range(parse_int(attrs.get("num_args", 2)))]
+
+
+def _concat_infer(attrs, in_shapes):
+    dim = parse_int(attrs.get("dim", 1))
+    known = [s for s in in_shapes if s is not None]
+    if not known:
+        return in_shapes, [None], []
+    total = sum(s[dim] for s in in_shapes if s is not None)
+    if any(s is None for s in in_shapes):
+        return in_shapes, [None], []
+    out = list(known[0])
+    out[dim] = total
+    return in_shapes, [tuple(out)], []
+
+
+@register("Concat", inputs=_concat_inputs,
+          attr_spec={"num_args": (parse_int, 2), "dim": (parse_int, 1)},
+          infer_shape=_concat_infer)
+def _concat(attrs, *xs):
+    return jnp.concatenate(xs, axis=parse_int(attrs.get("dim", 1)))
+
+alias("concat", "Concat")
+
+
+def _slice_channel_outputs(attrs):
+    return parse_int(attrs.get("num_outputs", 1))
+
+
+def _slice_channel_infer(attrs, in_shapes):
+    n = parse_int(attrs.get("num_outputs", 1))
+    axis = parse_int(attrs.get("axis", 1))
+    squeeze = parse_bool(attrs.get("squeeze_axis", False))
+    data_s = in_shapes[0]
+    if data_s is None:
+        return in_shapes, [None] * n, []
+    out = list(data_s)
+    out[axis] = out[axis] // n
+    if squeeze and out[axis] == 1:
+        out.pop(axis)
+    return in_shapes, [tuple(out)] * n, []
+
+
+@register("SliceChannel", inputs=("data",),
+          attr_spec={"num_outputs": (parse_int, 1), "axis": (parse_int, 1),
+                     "squeeze_axis": (parse_bool, False)},
+          num_outputs=_slice_channel_outputs,
+          infer_shape=_slice_channel_infer)
+def _slice_channel(attrs, x):
+    n = parse_int(attrs.get("num_outputs", 1))
+    axis = parse_int(attrs.get("axis", 1))
+    outs = jnp.split(x, n, axis=axis)
+    if parse_bool(attrs.get("squeeze_axis", False)):
+        outs = [jnp.squeeze(o, axis=axis) for o in outs]
+    return tuple(outs)
+
+alias("split", "SliceChannel")
+
+
+def _upsampling_inputs(attrs):
+    n = parse_int(attrs.get("num_args", 1))
+    if attrs.get("sample_type", "nearest") == "bilinear":
+        return ["data", "weight"]
+    return [f"arg{i}" for i in range(n)]
+
+
+@register("UpSampling", inputs=_upsampling_inputs,
+          attr_spec={"scale": (parse_int, 2), "num_filter": (parse_int, 0),
+                     "sample_type": (None, "nearest"),
+                     "multi_input_mode": (None, "concat"),
+                     "num_args": (parse_int, 1), "workspace": (parse_int, 512)})
+def _upsampling(attrs, *xs):
+    scale = parse_int(attrs.get("scale", 2))
+    stype = attrs.get("sample_type", "nearest")
+    if stype == "nearest":
+        outs = []
+        target = None
+        for x in xs:
+            up = jnp.repeat(jnp.repeat(x, scale, axis=2), scale, axis=3) \
+                if target is None else x
+            if target is None:
+                target = up.shape[2:]
+            outs.append(up)
+        if len(outs) == 1:
+            return outs[0]
+        if attrs.get("multi_input_mode", "concat") == "sum":
+            return sum(outs)
+        return jnp.concatenate(outs, axis=1)
+    # bilinear: deconvolution with (learnable) bilinear kernel
+    data, weight = xs
+    k = 2 * scale - scale % 2
+    pad = int(np.ceil((scale - 1) / 2.0))
+    dn = lax.conv_dimension_numbers(data.shape, weight.shape,
+                                    ("NCHW", "IOHW", "NCHW"))
+    return lax.conv_transpose(data, weight.astype(data.dtype),
+                              (scale, scale), [(pad, pad), (pad, pad)],
+                              dimension_numbers=dn, transpose_kernel=True)
+
+
+@register("Crop", inputs=lambda attrs: ["data", "crop_like"][:parse_int(
+    attrs.get("num_args", 1))],
+    attr_spec={"num_args": (parse_int, 1), "offset": (parse_tuple, (0, 0)),
+               "h_w": (parse_tuple, (0, 0)),
+               "center_crop": (parse_bool, False)})
+def _crop_op(attrs, data, crop_like=None):
+    oy, ox = attrs.get("offset", (0, 0))
+    if crop_like is not None:
+        h, w = crop_like.shape[2], crop_like.shape[3]
+    else:
+        h, w = attrs.get("h_w", (0, 0))
+    if parse_bool(attrs.get("center_crop", False)):
+        oy = (data.shape[2] - h) // 2
+        ox = (data.shape[3] - w) // 2
+    return lax.dynamic_slice(data, (0, 0, oy, ox),
+                             (data.shape[0], data.shape[1], h, w))
+
+
+# --------------------------------------------------------------------------
+# Sequence ops (reference: sequence_last/mask/reverse-inl.h; axis 0 = time)
+# --------------------------------------------------------------------------
+def _seq_inputs(attrs):
+    if parse_bool(attrs.get("use_sequence_length", False)):
+        return ["data", "sequence_length"]
+    return ["data"]
+
+
+@register("SequenceLast", inputs=_seq_inputs,
+          attr_spec={"use_sequence_length": (parse_bool, False)})
+def _sequence_last(attrs, data, sequence_length=None):
+    if sequence_length is None:
+        return data[-1]
+    idx = (sequence_length.astype(jnp.int32) - 1)
+    return jnp.take_along_axis(
+        data, idx.reshape((1, -1) + (1,) * (data.ndim - 2)), axis=0)[0]
+
+
+@register("SequenceMask", inputs=_seq_inputs,
+          attr_spec={"use_sequence_length": (parse_bool, False),
+                     "value": (parse_float, 0.0)})
+def _sequence_mask(attrs, data, sequence_length=None):
+    if sequence_length is None:
+        return data
+    t = data.shape[0]
+    steps = jnp.arange(t).reshape((t,) + (1,) * (data.ndim - 1))
+    mask = steps < sequence_length.astype(jnp.int32).reshape(
+        (1, -1) + (1,) * (data.ndim - 2))
+    return jnp.where(mask, data, attrs.get("value", 0.0))
+
+
+@register("SequenceReverse", inputs=_seq_inputs,
+          attr_spec={"use_sequence_length": (parse_bool, False)})
+def _sequence_reverse(attrs, data, sequence_length=None):
+    if sequence_length is None:
+        return jnp.flip(data, axis=0)
+    t = data.shape[0]
+    lengths = sequence_length.astype(jnp.int32)
+    steps = jnp.arange(t)
+    # per-batch reverse of the first `len` steps, identity elsewhere
+    idx = jnp.where(steps[:, None] < lengths[None, :],
+                    lengths[None, :] - 1 - steps[:, None], steps[:, None])
+    return jnp.take_along_axis(
+        data, idx.reshape(idx.shape + (1,) * (data.ndim - 2)), axis=0)
+
+
+# --------------------------------------------------------------------------
+# Spatial ops: ROIPooling, BilinearSampler, GridGenerator,
+# SpatialTransformer, Correlation (reference: src/operator/<name>-inl.h)
+# --------------------------------------------------------------------------
+@register("ROIPooling", inputs=("data", "rois"),
+          attr_spec={"pooled_size": (parse_tuple, None),
+                     "spatial_scale": (parse_float, 1.0)})
+def _roi_pooling(attrs, data, rois):
+    ph, pw = attrs["pooled_size"]
+    scale = attrs["spatial_scale"]
+    h, w = data.shape[2], data.shape[3]
+
+    def one_roi(roi):
+        batch_idx = roi[0].astype(jnp.int32)
+        x1 = jnp.round(roi[1] * scale).astype(jnp.int32)
+        y1 = jnp.round(roi[2] * scale).astype(jnp.int32)
+        x2 = jnp.round(roi[3] * scale).astype(jnp.int32)
+        y2 = jnp.round(roi[4] * scale).astype(jnp.int32)
+        rh = jnp.maximum(y2 - y1 + 1, 1)
+        rw = jnp.maximum(x2 - x1 + 1, 1)
+        img = data[batch_idx]
+
+        ys = jnp.arange(h)
+        xs = jnp.arange(w)
+
+        def pool_cell(py, px):
+            hstart = y1 + (py * rh) // ph
+            hend = y1 + ((py + 1) * rh + ph - 1) // ph
+            wstart = x1 + (px * rw) // pw
+            wend = x1 + ((px + 1) * rw + pw - 1) // pw
+            ymask = (ys >= hstart) & (ys < jnp.minimum(hend, h))
+            xmask = (xs >= wstart) & (xs < jnp.minimum(wend, w))
+            mask = ymask[:, None] & xmask[None, :]
+            masked = jnp.where(mask[None], img, -jnp.inf)
+            out = jnp.max(masked, axis=(1, 2))
+            return jnp.where(jnp.any(mask), out, 0.0)
+
+        cells = jax.vmap(lambda py: jax.vmap(
+            lambda px: pool_cell(py, px))(jnp.arange(pw)))(jnp.arange(ph))
+        return jnp.transpose(cells, (2, 0, 1))
+
+    return jax.vmap(one_roi)(rois)
+
+
+@register("BilinearSampler", inputs=("data", "grid"))
+def _bilinear_sampler(attrs, data, grid):
+    n, c, h, w = data.shape
+    gx = (grid[:, 0] + 1) * (w - 1) / 2.0
+    gy = (grid[:, 1] + 1) * (h - 1) / 2.0
+
+    x0 = jnp.floor(gx)
+    y0 = jnp.floor(gy)
+    wx = gx - x0
+    wy = gy - y0
+
+    def gather(img, yy, xx):
+        yi = jnp.clip(yy.astype(jnp.int32), 0, h - 1)
+        xi = jnp.clip(xx.astype(jnp.int32), 0, w - 1)
+        valid = (yy >= 0) & (yy <= h - 1) & (xx >= 0) & (xx <= w - 1)
+        out = img[:, yi, xi]
+        return out * valid[None].astype(img.dtype)
+
+    def one(img, x0_, y0_, wx_, wy_):
+        v00 = gather(img, y0_, x0_)
+        v01 = gather(img, y0_, x0_ + 1)
+        v10 = gather(img, y0_ + 1, x0_)
+        v11 = gather(img, y0_ + 1, x0_ + 1)
+        return (v00 * (1 - wx_) * (1 - wy_) + v01 * wx_ * (1 - wy_) +
+                v10 * (1 - wx_) * wy_ + v11 * wx_ * wy_)
+
+    return jax.vmap(one)(data, x0, y0, wx, wy)
+
+
+@register("GridGenerator", inputs=lambda attrs: (
+    ["data"] if attrs.get("transform_type", "affine") == "affine"
+    else ["data"]),
+    attr_spec={"transform_type": (None, "affine"),
+               "target_shape": (parse_tuple, (0, 0))})
+def _grid_generator(attrs, data):
+    ttype = attrs.get("transform_type", "affine")
+    if ttype == "affine":
+        h, w = attrs["target_shape"]
+        n = data.shape[0]
+        theta = data.reshape(n, 2, 3)
+        ys, xs = jnp.meshgrid(jnp.linspace(-1, 1, h), jnp.linspace(-1, 1, w),
+                              indexing="ij")
+        ones = jnp.ones_like(xs)
+        coords = jnp.stack([xs.ravel(), ys.ravel(), ones.ravel()])  # (3, h*w)
+        grid = jnp.einsum("nij,jk->nik", theta, coords)  # (n, 2, h*w)
+        return grid.reshape(n, 2, h, w)
+    # warp: data is (n, 2, h, w) flow field
+    n, _, h, w = data.shape
+    ys, xs = jnp.meshgrid(jnp.arange(h, dtype=data.dtype),
+                          jnp.arange(w, dtype=data.dtype), indexing="ij")
+    gx = (data[:, 0] + xs) * 2 / (w - 1) - 1
+    gy = (data[:, 1] + ys) * 2 / (h - 1) - 1
+    return jnp.stack([gx, gy], axis=1)
+
+
+@register("SpatialTransformer", inputs=("data", "loc"),
+          attr_spec={"target_shape": (parse_tuple, (0, 0)),
+                     "transform_type": (None, "affine"),
+                     "sampler_type": (None, "bilinear")})
+def _spatial_transformer(attrs, data, loc):
+    grid = _grid_generator.__wrapped__(
+        {"transform_type": "affine", "target_shape": attrs["target_shape"]},
+        loc) if hasattr(_grid_generator, "__wrapped__") else None
+    # direct composition: affine grid then bilinear sample
+    h, w = attrs["target_shape"]
+    n = loc.shape[0]
+    theta = loc.reshape(n, 2, 3)
+    ys, xs = jnp.meshgrid(jnp.linspace(-1, 1, h), jnp.linspace(-1, 1, w),
+                          indexing="ij")
+    ones = jnp.ones_like(xs)
+    coords = jnp.stack([xs.ravel(), ys.ravel(), ones.ravel()])
+    grid = jnp.einsum("nij,jk->nik", theta, coords).reshape(n, 2, h, w)
+    return _bilinear_sampler_impl(data, grid)
+
+
+def _bilinear_sampler_impl(data, grid):
+    from .registry import get_op
+    out, _ = get_op("BilinearSampler").forward({}, [data, grid], [], False, None)
+    return out[0]
+
+
+@register("Correlation", inputs=("data1", "data2"),
+          attr_spec={"kernel_size": (parse_int, 1),
+                     "max_displacement": (parse_int, 1),
+                     "stride1": (parse_int, 1), "stride2": (parse_int, 1),
+                     "pad_size": (parse_int, 0),
+                     "is_multiply": (parse_bool, True)},
+          num_outputs=2, num_visible=1, output_names=["output", "tmp"])
+def _correlation(attrs, data1, data2):
+    md = attrs["max_displacement"]
+    s2 = attrs["stride2"]
+    pad = attrs["pad_size"]
+    d1 = jnp.pad(data1, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    d2 = jnp.pad(data2, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    n, c, h, w = d1.shape
+    disp = list(range(-md, md + 1, s2))
+    outs = []
+    for dy in disp:
+        for dx in disp:
+            shifted = jnp.roll(d2, (-dy, -dx), axis=(2, 3))
+            prod = jnp.mean(d1 * shifted, axis=1)
+            outs.append(prod)
+    out = jnp.stack(outs, axis=1)
+    crop = out[:, :, pad:h - pad if pad else h, pad:w - pad if pad else w]
+    return crop, jnp.zeros((1,), dtype=data1.dtype)

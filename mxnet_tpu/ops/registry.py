@@ -1,0 +1,200 @@
+"""The single operator registry.
+
+The reference has *two* op worlds — legacy ``OperatorProperty`` layer ops and
+NNVM ``FCompute`` tensor ops (reference: include/mxnet/operator.h:34-546,
+include/mxnet/op_attr_types.h:33-63) — dual-compiled for cpu/gpu against
+mshadow templates. Here there is exactly ONE registry: every op is a pure JAX
+function plus declarative metadata. XLA replaces mshadow (kernel codegen,
+fusion, memory planning) and the same definition serves:
+
+  * imperative NDArray calls (``mx.nd.Convolution(...)``) — the JAX fn runs
+    eagerly (async dispatch gives the engine-like pipelining for free);
+  * symbolic Symbol nodes (``mx.sym.Convolution(...)``) — the executor traces
+    the same fn under ``jax.jit`` so the whole graph compiles to one XLA
+    program (the analog of the reference's bulk-exec segments,
+    graph_executor.cc:678-756);
+  * gradient construction — ``jax.vjp`` of the composed graph replaces the
+    NNVM ``Gradient`` pass + per-op ``FGradient`` registrations.
+
+Op forward signature (the "FCompute" of this framework):
+
+    forward(attrs, inputs, aux, is_train, rng) -> (outputs, new_aux)
+
+where ``attrs`` is the typed param dict, ``inputs``/``aux`` are lists of
+jax.Arrays, and outputs/new_aux are lists of jax.Arrays. Most ops register a
+*simple* forward ``fn(attrs, *inputs) -> array|tuple`` and are wrapped.
+
+Like the reference's ``_init_ndarray_module``/``_init_symbol_module``
+(python/mxnet/ndarray.py:875, symbol.py:1585), the user-facing ``mx.nd.*`` and
+``mx.sym.*`` functions are auto-generated from this registry at import time.
+"""
+from __future__ import annotations
+
+from ..base import MXNetError
+
+__all__ = ["OpDef", "register", "get_op", "list_ops", "OP_REGISTRY"]
+
+OP_REGISTRY = {}
+
+
+class OpDef:
+    """Metadata + kernel for one operator.
+
+    Parameters
+    ----------
+    name : canonical op name (the public API surface name).
+    forward : full-signature forward (attrs, inputs, aux, is_train, rng).
+    inputs : list of input names, or callable(attrs)->list for variadic ops
+        (e.g. Concat's num_args; reference: ListArguments()).
+    aux : auxiliary-state names (BatchNorm moving stats; reference:
+        ListAuxiliaryStates()).
+    num_outputs : int or callable(attrs)->int.
+    output_names : list or callable(attrs)->list (reference: FListOutputNames).
+    attr_spec : dict name -> (parser, default). Unknown kwargs are kept
+        verbatim (MXNet tolerates extra attrs in JSON round-trips).
+    infer_shape : optional fn(attrs, in_shapes)->(in_shapes, out_shapes,
+        aux_shapes) for bidirectional inference (weight shapes deduced from
+        data, reference: per-op InferShape). When absent, shapes are derived
+        by abstract evaluation (jax.eval_shape) which requires complete
+        input shapes.
+    infer_type : optional fn(attrs, in_types)->(in_types, out_types,
+        aux_types).
+    need_rng : forward consumes the rng key (Dropout, samplers).
+    is_loss : op is a loss head (SoftmaxOutput family) — executor seeds its
+        cotangent with ones for backward() with no out_grads.
+    mutate_inputs : names of inputs the op writes (optimizer update ops;
+        reference: FMutateInputs). Imperative invoke swaps the new buffer
+        into the corresponding NDArray handle.
+    """
+
+    def __init__(self, name, forward, inputs=("data",), aux=(),
+                 num_outputs=1, output_names=None, attr_spec=None,
+                 infer_shape=None, infer_type=None, need_rng=False,
+                 is_loss=False, mutate_inputs=(), num_visible=None, doc=""):
+        self.name = name
+        self.forward = forward
+        self._inputs = inputs
+        self._aux = aux
+        self._num_outputs = num_outputs
+        self._num_visible = num_visible
+        self._output_names = output_names
+        self.attr_spec = attr_spec or {}
+        self.infer_shape = infer_shape
+        self.infer_type = infer_type
+        self.need_rng = need_rng
+        self.is_loss = is_loss
+        self.mutate_inputs = tuple(mutate_inputs)
+        self.doc = doc
+
+    # --- variadic-aware accessors ---------------------------------------
+    def input_names(self, attrs=None):
+        if callable(self._inputs):
+            return list(self._inputs(attrs or {}))
+        return list(self._inputs)
+
+    def aux_names(self, attrs=None):
+        if callable(self._aux):
+            return list(self._aux(attrs or {}))
+        return list(self._aux)
+
+    def num_outputs(self, attrs=None):
+        if callable(self._num_outputs):
+            return self._num_outputs(attrs or {})
+        return self._num_outputs
+
+    def num_visible_outputs(self, attrs=None):
+        """Outputs exposed to composition (reference: NNVM
+        num_visible_outputs — BatchNorm hides mean/var, Dropout its mask)."""
+        if self._num_visible is None:
+            return self.num_outputs(attrs)
+        if callable(self._num_visible):
+            return self._num_visible(attrs or {})
+        return self._num_visible
+
+    def output_names(self, attrs=None):
+        if self._output_names is None:
+            n = self.num_outputs(attrs)
+            return ["output"] if n == 1 else [f"output{i}" for i in range(n)]
+        if callable(self._output_names):
+            return list(self._output_names(attrs or {}))
+        return list(self._output_names)
+
+    def normalize_attrs(self, kwargs):
+        """Parse raw kwargs/JSON strings into the typed attr dict."""
+        attrs = {}
+        for key, val in kwargs.items():
+            if val is None:
+                continue
+            spec = self.attr_spec.get(key)
+            if spec is not None:
+                parser = spec[0]
+                attrs[key] = parser(val) if parser else val
+            else:
+                attrs[key] = val
+        for key, spec in self.attr_spec.items():
+            if key not in attrs and len(spec) > 1 and spec[1] is not None:
+                attrs[key] = spec[1]
+        return attrs
+
+    def __repr__(self):
+        return f"OpDef({self.name})"
+
+
+def _wrap_simple(fn):
+    """Lift fn(attrs, *inputs) -> array|tuple into the full signature."""
+    def forward(attrs, inputs, aux, is_train, rng):
+        out = fn(attrs, *inputs)
+        if isinstance(out, (tuple, list)):
+            return list(out), []
+        return [out], []
+    return forward
+
+
+def register(name, inputs=("data",), simple=None, full=None, **kw):
+    """Register an op. Use as a decorator or direct call.
+
+    ``simple=fn`` registers fn(attrs, *inputs); ``full=fn`` registers the
+    5-arg signature. As a decorator, wraps a simple fn unless
+    ``full_signature=True`` is passed.
+    """
+    full_signature = kw.pop("full_signature", False)
+
+    def do_register(fn, is_full):
+        forward = fn if is_full else _wrap_simple(fn)
+        opdef = OpDef(name, forward, inputs=inputs, **kw)
+        if name in OP_REGISTRY:
+            raise MXNetError(f"op {name!r} registered twice")
+        OP_REGISTRY[name] = opdef
+        return fn
+
+    if simple is not None:
+        do_register(simple, False)
+        return OP_REGISTRY[name]
+    if full is not None:
+        do_register(full, True)
+        return OP_REGISTRY[name]
+
+    def decorator(fn):
+        do_register(fn, full_signature)
+        return fn
+
+    return decorator
+
+
+def alias(new_name, existing):
+    """Register an alternative public name for an existing op."""
+    opdef = get_op(existing)
+    if new_name not in OP_REGISTRY:
+        OP_REGISTRY[new_name] = opdef
+    return opdef
+
+
+def get_op(name):
+    try:
+        return OP_REGISTRY[name]
+    except KeyError:
+        raise MXNetError(f"operator {name!r} is not registered") from None
+
+
+def list_ops():
+    return sorted(OP_REGISTRY)

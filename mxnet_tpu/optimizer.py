@@ -1,0 +1,426 @@
+"""Optimizers (reference: python/mxnet/optimizer.py, 755 LoC).
+
+Same registry/API contract as the reference: ``Optimizer.create_optimizer``,
+``create_state``/``update`` per weight index, ``lr_mult``/``wd_mult`` pulled
+from symbol attrs (``__lr_mult__``), ``rescale_grad``, ``clip_gradient``,
+``get_updater`` closure for the KVStore local-update path.
+
+The hot updates (SGD/momentum/Adam/RMSProp) call the fused update ops
+(mxnet_tpu/ops/optimizer_op.py) exactly as the reference calls
+``mx.nd.sgd_update`` etc. (reference: optimizer.py:278-320) — one XLA kernel
+per weight, buffers donated/swapped in place. The rest are expressed in
+NDArray arithmetic (still fused by XLA at trace time under jit).
+"""
+from __future__ import annotations
+
+import math
+import logging
+
+import numpy as np
+
+from .base import MXNetError
+from .ndarray import NDArray, zeros, imperative_invoke
+from . import ndarray as nd
+
+__all__ = ["Optimizer", "SGD", "DCASGD", "NAG", "SGLD", "ccSGD", "Adam",
+           "AdaGrad", "RMSProp", "AdaDelta", "Ftrl", "Test", "create",
+           "get_updater", "register"]
+
+
+class Optimizer:
+    """Base optimizer. reference: optimizer.py:21-277."""
+
+    opt_registry = {}
+
+    @staticmethod
+    def register(klass):
+        name = klass.__name__.lower()
+        Optimizer.opt_registry[name] = klass
+        return klass
+
+    @staticmethod
+    def create_optimizer(name, **kwargs):
+        if name.lower() in Optimizer.opt_registry:
+            return Optimizer.opt_registry[name.lower()](**kwargs)
+        raise ValueError(f"Cannot find optimizer {name}")
+
+    def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
+                 clip_gradient=None, learning_rate=0.01, lr_scheduler=None,
+                 sym=None, begin_num_update=0):
+        self.rescale_grad = rescale_grad
+        self.lr = learning_rate
+        self.lr_scheduler = lr_scheduler
+        if lr_scheduler is not None:
+            self.lr_scheduler.base_lr = learning_rate
+        self.wd = wd
+        self.lr_mult = {}
+        self.wd_mult = {}
+        self.begin_num_update = begin_num_update
+        self.num_update = begin_num_update
+        self._index_update_count = {}
+        self.clip_gradient = clip_gradient
+        if param_idx2name is None:
+            param_idx2name = {}
+        assert isinstance(param_idx2name, dict)
+        self.idx2name = param_idx2name.copy()
+        self.sym = sym
+        self.set_lr_mult({})
+        self.set_wd_mult({})
+
+    def create_state(self, index, weight):
+        return None
+
+    def update(self, index, weight, grad, state):
+        raise NotImplementedError
+
+    def set_lr_mult(self, args_lr_mult):
+        """reference: optimizer.py set_lr_mult — reads __lr_mult__ attrs."""
+        self.lr_mult = {}
+        if self.sym is not None:
+            attr = self.sym.attr_dict()
+            for name in self.sym.list_arguments():
+                if name in attr and "__lr_mult__" in attr[name]:
+                    self.lr_mult[name] = float(attr[name]["__lr_mult__"])
+        self.lr_mult.update(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult):
+        """bias/gamma/beta default to wd_mult=0. reference: optimizer.py."""
+        self.wd_mult = {}
+        for n in self.idx2name.values():
+            if not (n.endswith("_weight") or n.endswith("_gamma")):
+                self.wd_mult[n] = 0.0
+        if self.sym is not None:
+            attr = self.sym.attr_dict()
+            for name in self.sym.list_arguments():
+                if name in attr and "__wd_mult__" in attr[name]:
+                    self.wd_mult[name] = float(attr[name]["__wd_mult__"])
+        self.wd_mult.update(args_wd_mult)
+
+    def _update_count(self, index):
+        if index not in self._index_update_count:
+            self._index_update_count[index] = self.begin_num_update
+        self._index_update_count[index] += 1
+        self.num_update = max(self._index_update_count[index],
+                              self.num_update)
+
+    def _get_lr(self, index):
+        if self.lr_scheduler is not None:
+            lr = self.lr_scheduler(self.num_update)
+        else:
+            lr = self.lr
+        if index in self.lr_mult:
+            lr *= self.lr_mult[index]
+        elif index in self.idx2name:
+            lr *= self.lr_mult.get(self.idx2name[index], 1.0)
+        return lr
+
+    def _get_wd(self, index):
+        wd = self.wd
+        if index in self.wd_mult:
+            wd *= self.wd_mult[index]
+        elif index in self.idx2name:
+            wd *= self.wd_mult.get(self.idx2name[index], 1.0)
+        return wd
+
+
+register = Optimizer.register
+create = Optimizer.create_optimizer
+
+
+def _state_like(weight):
+    """Zeroed state with the SAME device placement/sharding as the weight.
+
+    Critical for mesh-sharded training: a replicated weight needs replicated
+    optimizer state or the fused update op sees incompatible devices.
+    """
+    import jax
+    import jax.numpy as jnp
+    arr = weight.asjax()
+    return NDArray(jax.device_put(jnp.zeros(arr.shape, arr.dtype),
+                                  arr.sharding))
+
+
+def _clip(arr, bound):
+    if bound is None or bound <= 0:
+        return arr
+    return nd.clip(arr, a_min=-bound, a_max=bound)
+
+
+@register
+class SGD(Optimizer):
+    """SGD with momentum via the fused ops. reference: optimizer.py:279."""
+
+    def __init__(self, momentum=0.0, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return _state_like(weight)
+
+    def update(self, index, weight, grad, state):
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        self._update_count(index)
+        kwargs = dict(lr=lr, wd=wd, rescale_grad=self.rescale_grad,
+                      clip_gradient=self.clip_gradient
+                      if self.clip_gradient else -1.0)
+        if state is not None:
+            imperative_invoke("sgd_mom_update", weight, grad, state,
+                              momentum=self.momentum, **kwargs)
+        else:
+            imperative_invoke("sgd_update", weight, grad, **kwargs)
+
+
+@register
+class DCASGD(Optimizer):
+    """Delay-compensated async SGD. reference: optimizer.py:325."""
+
+    def __init__(self, momentum=0.0, lamda=0.04, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.weight_previous = {}
+        self.lamda = lamda
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return (None, weight.copy())
+        return (_state_like(weight),
+                weight.copy())
+
+    def update(self, index, weight, grad, state):
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        self._update_count(index)
+        grad = _clip(grad * self.rescale_grad, self.clip_gradient)
+        mom, previous_weight = state
+        delta = -lr * (grad + wd * weight + self.lamda * grad * grad *
+                       (weight - previous_weight))
+        if mom is not None:
+            mom *= self.momentum
+            mom += delta
+            delta = mom
+        previous_weight._set(weight.asjax())
+        weight += delta
+
+
+@register
+class NAG(SGD):
+    """Nesterov accelerated SGD. reference: optimizer.py:380."""
+
+    def update(self, index, weight, grad, state):
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        self._update_count(index)
+        grad = _clip(grad * self.rescale_grad, self.clip_gradient)
+        if state is not None:
+            mom = state
+            mom *= self.momentum
+            grad = grad + wd * weight
+            mom += grad
+            grad = grad + self.momentum * mom
+            weight += -lr * grad
+        else:
+            weight += -lr * (grad + wd * weight)
+
+
+@register
+class SGLD(Optimizer):
+    """Stochastic gradient Langevin dynamics. reference: optimizer.py:416."""
+
+    def update(self, index, weight, grad, state):
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        self._update_count(index)
+        grad = _clip(grad * self.rescale_grad, self.clip_gradient)
+        noise = nd.random_normal(shape=weight.shape,
+                                 scale=math.sqrt(lr),
+                                 dtype=str(weight.dtype))
+        weight += -lr / 2 * (grad + wd * weight) + noise
+
+
+@register
+class ccSGD(SGD):
+    """Compat alias of SGD (the reference's C++-side SGD).
+    reference: optimizer.py:445."""
+
+
+@register
+class Adam(Optimizer):
+    """reference: optimizer.py:451 (Kingma & Ba, with bias correction)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (_state_like(weight),
+                _state_like(weight))
+
+    def update(self, index, weight, grad, state):
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        self._update_count(index)
+        t = self._index_update_count[index]
+        coef1 = 1.0 - self.beta1 ** t
+        coef2 = 1.0 - self.beta2 ** t
+        lr *= math.sqrt(coef2) / coef1
+        mean, var = state
+        imperative_invoke("adam_update", weight, grad, mean, var,
+                          lr=lr, wd=wd, beta1=self.beta1, beta2=self.beta2,
+                          epsilon=self.epsilon,
+                          rescale_grad=self.rescale_grad,
+                          clip_gradient=self.clip_gradient
+                          if self.clip_gradient else -1.0)
+
+
+@register
+class AdaGrad(Optimizer):
+    """reference: optimizer.py:499."""
+
+    def __init__(self, eps=1e-7, **kwargs):
+        super().__init__(**kwargs)
+        self.float_stable_eps = eps
+
+    def create_state(self, index, weight):
+        return _state_like(weight)
+
+    def update(self, index, weight, grad, state):
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        self._update_count(index)
+        grad = _clip(grad * self.rescale_grad, self.clip_gradient)
+        history = state
+        history += grad * grad
+        weight += -lr * (grad / nd.sqrt(history + self.float_stable_eps) +
+                         wd * weight)
+
+
+@register
+class RMSProp(Optimizer):
+    """reference: optimizer.py:536 (Tieleman or Graves variant)."""
+
+    def __init__(self, learning_rate=0.001, gamma1=0.9, gamma2=0.9,
+                 epsilon=1e-8, centered=False, clip_weights=None, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.gamma1 = gamma1
+        self.gamma2 = gamma2
+        self.centered = centered
+        self.epsilon = epsilon
+        self.clip_weights = clip_weights
+
+    def create_state(self, index, weight):
+        if self.centered:
+            return (_state_like(weight),
+                    _state_like(weight),
+                    _state_like(weight))
+        return (_state_like(weight),)
+
+    def update(self, index, weight, grad, state):
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        self._update_count(index)
+        kwargs = dict(lr=lr, wd=wd, rescale_grad=self.rescale_grad,
+                      gamma1=self.gamma1, epsilon=self.epsilon,
+                      clip_gradient=self.clip_gradient
+                      if self.clip_gradient else -1.0,
+                      clip_weights=self.clip_weights
+                      if self.clip_weights else -1.0)
+        if not self.centered:
+            (n,) = state
+            imperative_invoke("rmsprop_update", weight, grad, n, **kwargs)
+        else:
+            n, g, delta = state
+            imperative_invoke("rmspropalex_update", weight, grad, n, g,
+                              delta, gamma2=self.gamma2, **kwargs)
+
+
+@register
+class AdaDelta(Optimizer):
+    """reference: optimizer.py:605 (Zeiler 2012)."""
+
+    def __init__(self, rho=0.90, epsilon=1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.rho = rho
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (_state_like(weight),
+                _state_like(weight))
+
+    def update(self, index, weight, grad, state):
+        wd = self._get_wd(index)
+        self._update_count(index)
+        grad = _clip(grad * self.rescale_grad, self.clip_gradient)
+        acc_g, acc_delta = state
+        acc_g._set((self.rho * acc_g + (1.0 - self.rho) * grad * grad)
+                   .asjax())
+        current_delta = (nd.sqrt(acc_delta + self.epsilon) /
+                         nd.sqrt(acc_g + self.epsilon)) * grad
+        acc_delta._set((self.rho * acc_delta + (1.0 - self.rho) *
+                        current_delta * current_delta).asjax())
+        weight._set((weight - current_delta - wd * weight).asjax())
+
+
+@register
+class Ftrl(Optimizer):
+    """reference: optimizer.py:654 (McMahan et al.)."""
+
+    def __init__(self, lamda1=0.01, learning_rate=0.1, beta=1, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.lamda1 = lamda1
+        self.beta = beta
+
+    def create_state(self, index, weight):
+        return (_state_like(weight),
+                _state_like(weight))
+
+    def update(self, index, weight, grad, state):
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        self._update_count(index)
+        grad = _clip(grad * self.rescale_grad, self.clip_gradient)
+        z, n = state
+        sigma = -nd.sqrt(n)
+        n += grad * grad
+        denom = nd.sqrt(n)
+        sigma += denom
+        sigma /= lr
+        z += grad - sigma * weight
+        # update weight
+        d = (self.beta + denom) / lr + wd
+        sign_z = nd.sign(z)
+        new_w = (sign_z * self.lamda1 - z) / d * \
+            (nd.abs(z) > self.lamda1)
+        weight._set(new_w.asjax())
+
+
+@register
+class Test(Optimizer):
+    """Mock optimizer for kvstore tests. reference: optimizer.py:706."""
+
+    def create_state(self, index, weight):
+        return _state_like(weight)
+
+    def update(self, index, weight, grad, state):
+        weight += grad * self.rescale_grad
+        state._set(weight.asjax())
+
+
+def get_updater(optimizer):
+    """Closure for the KVStore local-update path. reference: optimizer.py
+    get_updater — states created lazily per key."""
+    states = {}
+
+    def updater(index, grad, weight):
+        if index not in states:
+            states[index] = optimizer.create_state(index, weight)
+        optimizer.update(index, weight, grad, states[index])
+
+    updater.optimizer = optimizer
+    updater.states = states
+    return updater

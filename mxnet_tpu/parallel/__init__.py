@@ -1,0 +1,13 @@
+"""Parallelism layer: device meshes, sharding, collectives.
+
+The reference's parallelism is DP over a parameter server + manual layer
+placement (SURVEY.md §2.4); this framework is mesh-native: every form of
+parallelism is a sharding of one jitted program over a
+``jax.sharding.Mesh`` — data (dp), tensor (tp), sequence (sp), pipeline
+(pp stages as mesh axis), expert (ep) — with XLA inserting the collectives
+over ICI/DCN (psum/all_gather/reduce_scatter/ppermute).
+"""
+from .mesh import (MeshConfig, build_mesh, current_mesh, mesh_scope,
+                   data_sharding, replicated, shard, DEFAULT_AXES)
+from .collectives import (all_reduce, all_gather, reduce_scatter, ppermute,
+                          barrier)

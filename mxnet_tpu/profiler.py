@@ -1,0 +1,46 @@
+"""Profiler (reference: python/mxnet/profiler.py + src/engine/profiler.cc).
+
+The reference collects per-op exec records into chrome://tracing JSON.
+TPU-native: delegate to the JAX/XLA profiler (xplane traces, viewable in
+TensorBoard/Perfetto — strictly richer than the reference's records: includes
+fusion boundaries, HBM traffic, MXU utilization). API kept: profiler_set_config,
+profiler_set_state, dump_profile.
+"""
+from __future__ import annotations
+
+import logging
+
+import jax
+
+_STATE = {"mode": "symbolic", "filename": "profile.json", "running": False,
+          "trace_dir": None}
+
+
+def profiler_set_config(mode="symbolic", filename="profile.json"):
+    """reference: profiler.py profiler_set_config."""
+    _STATE["mode"] = mode
+    _STATE["filename"] = filename
+
+
+def profiler_set_state(state="stop"):
+    """'run' starts a jax profiler trace; 'stop' ends it.
+    reference: profiler.py profiler_set_state."""
+    if state == "run" and not _STATE["running"]:
+        import os
+        trace_dir = os.path.splitext(_STATE["filename"])[0] + "_trace"
+        _STATE["trace_dir"] = trace_dir
+        jax.profiler.start_trace(trace_dir)
+        _STATE["running"] = True
+    elif state == "stop" and _STATE["running"]:
+        jax.profiler.stop_trace()
+        _STATE["running"] = False
+        logging.info("profiler trace written to %s", _STATE["trace_dir"])
+    elif state not in ("run", "stop"):
+        raise ValueError("state must be 'run' or 'stop'")
+
+
+def dump_profile():
+    """reference: MXDumpProfile — here the trace is already on disk."""
+    if _STATE["running"]:
+        profiler_set_state("stop")
+    return _STATE["trace_dir"]

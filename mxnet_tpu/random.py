@@ -1,0 +1,25 @@
+"""Global RNG state.
+
+The reference seeds per-device mshadow random streams via ``mx.random.seed``
+(reference: python/mxnet/random.py, src/resource.cc kRandom). JAX randomness
+is functional (explicit keys), so this module keeps ONE host-side key that is
+split on demand: imperative sampling ops and executors draw fresh subkeys via
+``next_key()``; jitted training steps thread a key through the step function.
+Seeding is deterministic and device-independent.
+"""
+from __future__ import annotations
+
+import jax
+
+_STATE = {"key": jax.random.PRNGKey(0)}
+
+
+def seed(seed_state):
+    """Seed the global generator. reference: python/mxnet/random.py seed()."""
+    _STATE["key"] = jax.random.PRNGKey(int(seed_state))
+
+
+def next_key():
+    """Split and return a fresh subkey (host-side, stateful)."""
+    _STATE["key"], sub = jax.random.split(_STATE["key"])
+    return sub

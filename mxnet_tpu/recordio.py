@@ -1,0 +1,223 @@
+"""RecordIO container format (reference: python/mxnet/recordio.py, 269 LoC;
+framing from dmlc-core recordio.h).
+
+Byte-compatible with the reference's RecordIO: records framed as
+``[kMagic:4][lrec:4][data][pad to 4]`` where lrec packs cflag (3 bits) and
+length (29 bits). ``IRHeader`` packing matches mx.recordio.pack so existing
+``.rec`` datasets and ``im2rec`` output load unchanged.
+"""
+from __future__ import annotations
+
+import os
+import struct
+import numbers
+from collections import namedtuple
+
+import numpy as np
+
+from .base import MXNetError
+
+__all__ = ["MXRecordIO", "MXIndexedRecordIO", "IRHeader", "pack", "unpack",
+           "pack_img", "unpack_img"]
+
+_K_MAGIC = 0xced7230a
+
+
+def _encode_lrec(cflag, length):
+    return (cflag << 29) | length
+
+
+def _decode_lrec(lrec):
+    return lrec >> 29, lrec & ((1 << 29) - 1)
+
+
+class MXRecordIO:
+    """Sequential RecordIO reader/writer. reference: recordio.py:15-90."""
+
+    def __init__(self, uri, flag):
+        self.uri = uri
+        self.flag = flag
+        self.handle = None
+        self.is_open = False
+        self.open()
+
+    def open(self):
+        if self.flag == "w":
+            self.handle = open(self.uri, "wb")
+            self.writable = True
+        elif self.flag == "r":
+            self.handle = open(self.uri, "rb")
+            self.writable = False
+        else:
+            raise ValueError("Invalid flag %s" % self.flag)
+        self.is_open = True
+
+    def close(self):
+        if not self.is_open:
+            return
+        self.handle.close()
+        self.is_open = False
+
+    def __del__(self):
+        self.close()
+
+    def reset(self):
+        self.close()
+        self.open()
+
+    def write(self, buf):
+        assert self.writable
+        data = bytes(buf)
+        # single-record framing (no multi-part splitting needed host-side)
+        self.handle.write(struct.pack("<II", _K_MAGIC,
+                                      _encode_lrec(0, len(data))))
+        self.handle.write(data)
+        pad = (4 - len(data) % 4) % 4
+        if pad:
+            self.handle.write(b"\x00" * pad)
+
+    def read(self):
+        assert not self.writable
+        header = self.handle.read(8)
+        if len(header) < 8:
+            return None
+        magic, lrec = struct.unpack("<II", header)
+        if magic != _K_MAGIC:
+            raise MXNetError("invalid RecordIO magic")
+        _, length = _decode_lrec(lrec)
+        data = self.handle.read(length)
+        pad = (4 - length % 4) % 4
+        if pad:
+            self.handle.read(pad)
+        return data
+
+    def tell(self):
+        return self.handle.tell()
+
+
+class MXIndexedRecordIO(MXRecordIO):
+    """RecordIO with a .idx sidecar for random access.
+    reference: recordio.py:92-160."""
+
+    def __init__(self, idx_path, uri, flag, key_type=int):
+        self.idx_path = idx_path
+        self.idx = {}
+        self.keys = []
+        self.key_type = key_type
+        super().__init__(uri, flag)
+
+    def open(self):
+        super().open()
+        self.idx = {}
+        self.keys = []
+        if self.flag == "r" and os.path.isfile(self.idx_path):
+            with open(self.idx_path) as fin:
+                for line in fin:
+                    line = line.strip().split("\t")
+                    key = self.key_type(line[0])
+                    self.idx[key] = int(line[1])
+                    self.keys.append(key)
+
+    def close(self):
+        if not self.is_open:
+            return
+        if self.writable:
+            with open(self.idx_path, "w") as fout:
+                for k in self.keys:
+                    fout.write(f"{k}\t{self.idx[k]}\n")
+        super().close()
+
+    def seek(self, idx):
+        assert not self.writable
+        self.handle.seek(self.idx[idx])
+
+    def read_idx(self, idx):
+        self.seek(idx)
+        return self.read()
+
+    def write_idx(self, idx, buf):
+        key = self.key_type(idx)
+        self.idx[key] = self.tell()
+        self.keys.append(key)
+        self.write(buf)
+
+
+IRHeader = namedtuple("HEADER", ["flag", "label", "id", "id2"])
+_IR_FORMAT = "IfQQ"
+_IR_SIZE = struct.calcsize(_IR_FORMAT)
+
+
+def pack(header, s):
+    """Pack a header + raw bytes. reference: recordio.py:180 (IRHeader)."""
+    header = IRHeader(*header)
+    if isinstance(header.label, numbers.Number):
+        header = header._replace(flag=0)
+    else:
+        label = np.asarray(header.label, dtype=np.float32)
+        header = header._replace(flag=label.size, label=0)
+        s = label.tobytes() + s
+    s = struct.pack(_IR_FORMAT, *header) + s
+    return s
+
+
+def unpack(s):
+    """Unpack to (IRHeader, payload). reference: recordio.py:200."""
+    header = IRHeader(*struct.unpack(_IR_FORMAT, s[:_IR_SIZE]))
+    s = s[_IR_SIZE:]
+    if header.flag > 0:
+        label = np.frombuffer(s[:header.flag * 4], dtype=np.float32)
+        header = header._replace(label=label)
+        s = s[header.flag * 4:]
+    return header, s
+
+
+def pack_img(header, img, quality=95, img_fmt=".jpg"):
+    """Pack header + encoded image. Requires cv2 or PIL (gated)."""
+    encoded = _encode_img(img, quality, img_fmt)
+    return pack(header, encoded)
+
+
+def unpack_img(s, iscolor=-1):
+    """Unpack to (IRHeader, decoded image ndarray)."""
+    header, s = unpack(s)
+    img = _decode_img(s, iscolor)
+    return header, img
+
+
+def _encode_img(img, quality, img_fmt):
+    try:
+        import cv2
+        ret, buf = cv2.imencode(
+            img_fmt, img, [cv2.IMWRITE_JPEG_QUALITY, quality]
+            if img_fmt in (".jpg", ".jpeg") else [])
+        assert ret
+        return buf.tobytes()
+    except ImportError:
+        pass
+    try:
+        from PIL import Image
+        import io as _io
+        bio = _io.BytesIO()
+        Image.fromarray(img[..., ::-1] if img.ndim == 3 else img).save(
+            bio, format="JPEG" if img_fmt in (".jpg", ".jpeg") else "PNG",
+            quality=quality)
+        return bio.getvalue()
+    except ImportError:
+        raise MXNetError("pack_img requires cv2 or PIL")
+
+
+def _decode_img(s, iscolor):
+    try:
+        import cv2
+        return cv2.imdecode(np.frombuffer(s, dtype=np.uint8), iscolor)
+    except ImportError:
+        pass
+    try:
+        from PIL import Image
+        import io as _io
+        img = np.asarray(Image.open(_io.BytesIO(s)))
+        if img.ndim == 3:
+            img = img[..., ::-1]  # RGB -> BGR to match cv2 convention
+        return img
+    except ImportError:
+        raise MXNetError("unpack_img requires cv2 or PIL")

@@ -1,0 +1,680 @@
+"""RNN cell library: symbolic-unrolling recurrent cells.
+
+reference: python/mxnet/rnn/rnn_cell.py (962 LoC): RNNCell/LSTMCell/GRUCell
+compose Symbol graphs per time step; ``FusedRNNCell`` wraps the cuDNN fused
+RNN op with a packed parameter blob; pack/unpack converts between fused and
+unfused layouts for checkpoint compatibility (rnn-inl.h:30-67 layout).
+
+TPU-native notes: unrolled cells compile to one XLA program where matmuls
+batch onto the MXU; ``FusedRNNCell`` here unrolls the same math (XLA fuses
+across steps — on TPU there is no cuDNN kernel to call, and `lax.scan`
+lowering is used by the imperative RNN op in ops/rnn_op.py) while keeping
+the packed-parameter layout contract so checkpoints interoperate.
+"""
+from __future__ import annotations
+
+from .. import symbol
+from .. import ndarray as nd
+from ..ndarray import NDArray, concatenate
+from ..base import MXNetError
+
+__all__ = ["RNNParams", "BaseRNNCell", "RNNCell", "LSTMCell", "GRUCell",
+           "FusedRNNCell", "SequentialRNNCell", "BidirectionalCell",
+           "DropoutCell", "ZoneoutCell", "ResidualCell",
+           "ModifierCell"]
+
+
+class RNNParams:
+    """Container for cell weights. reference: rnn_cell.py:21-60."""
+
+    def __init__(self, prefix=""):
+        self._prefix = prefix
+        self._params = {}
+
+    def get(self, name, **kwargs):
+        name = self._prefix + name
+        if name not in self._params:
+            self._params[name] = symbol.var(name, **kwargs)
+        return self._params[name]
+
+
+class BaseRNNCell:
+    """reference: rnn_cell.py:63-200."""
+
+    def __init__(self, prefix="", params=None):
+        if params is None:
+            params = RNNParams(prefix)
+            self._own_params = True
+        else:
+            self._own_params = False
+        self._prefix = prefix
+        self._params = params
+        self._modified = False
+        self.reset()
+
+    def reset(self):
+        self._init_counter = -1
+        self._counter = -1
+
+    def __call__(self, inputs, states):
+        raise NotImplementedError()
+
+    @property
+    def params(self):
+        self._own_params = False
+        return self._params
+
+    @property
+    def state_shape(self):
+        raise NotImplementedError()
+
+    @property
+    def state_info(self):
+        raise NotImplementedError()
+
+    @property
+    def _gate_names(self):
+        return ()
+
+    def begin_state(self, func=symbol.var, **kwargs):
+        """reference: rnn_cell.py begin_state."""
+        assert not self._modified, \
+            "After applying modifier cells the base cell cannot be called "\
+            "directly. Call the modifier cell instead."
+        states = []
+        for info in self.state_info:
+            self._init_counter += 1
+            state = func(name=f"{self._prefix}begin_state_"
+                         f"{self._init_counter}", **kwargs)
+            states.append(state)
+        return states
+
+    def unpack_weights(self, args):
+        """Split packed fused blob -> per-gate dict. Default: identity."""
+        return args.copy()
+
+    def pack_weights(self, args):
+        return args.copy()
+
+    def unroll(self, length, inputs=None, begin_state=None,
+               input_prefix="", layout="NTC", merge_outputs=None):
+        """Unroll over `length` steps. reference: rnn_cell.py:140-200."""
+        self.reset()
+        axis = layout.find("T")
+        if inputs is None:
+            inputs = [symbol.var(f"{input_prefix}t{i}_data")
+                      for i in range(length)]
+        elif isinstance(inputs, symbol.Symbol):
+            assert len(inputs) == 1, \
+                "unroll doesn't allow grouped symbol as input. Pass a list "\
+                "of symbols instead."
+            inputs = list(symbol.SliceChannel(
+                inputs, axis=axis, num_outputs=length, squeeze_axis=1))
+        else:
+            assert len(inputs) == length
+        if begin_state is None:
+            begin_state = self.begin_state()
+
+        states = begin_state
+        outputs = []
+        for i in range(length):
+            output, states = self(inputs[i], states)
+            outputs.append(output)
+        if merge_outputs:
+            outputs = [symbol.expand_dims(o, axis=axis) for o in outputs]
+            outputs = symbol.Concat(*outputs, dim=axis)
+        return outputs, states
+
+    # internal
+    def _get_activation(self, inputs, activation, **kwargs):
+        if isinstance(activation, str):
+            return symbol.Activation(inputs, act_type=activation, **kwargs)
+        return activation(inputs, **kwargs)
+
+
+class RNNCell(BaseRNNCell):
+    """Vanilla RNN cell. reference: rnn_cell.py:203-250."""
+
+    def __init__(self, num_hidden, activation="tanh", prefix="rnn_",
+                 params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._num_hidden = num_hidden
+        self._activation = activation
+        self._iW = self.params.get("i2h_weight")
+        self._iB = self.params.get("i2h_bias")
+        self._hW = self.params.get("h2h_weight")
+        self._hB = self.params.get("h2h_bias")
+
+    @property
+    def state_info(self):
+        return [{"shape": (0, self._num_hidden), "__layout__": "NC"}]
+
+    @property
+    def state_shape(self):
+        return [(0, self._num_hidden)]
+
+    @property
+    def _gate_names(self):
+        return ("",)
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        name = f"{self._prefix}t{self._counter}_"
+        i2h = symbol.FullyConnected(data=inputs, weight=self._iW,
+                                    bias=self._iB,
+                                    num_hidden=self._num_hidden,
+                                    name=f"{name}i2h")
+        h2h = symbol.FullyConnected(data=states[0], weight=self._hW,
+                                    bias=self._hB,
+                                    num_hidden=self._num_hidden,
+                                    name=f"{name}h2h")
+        output = self._get_activation(i2h + h2h, self._activation,
+                                      name=f"{name}out")
+        return output, [output]
+
+
+class LSTMCell(BaseRNNCell):
+    """LSTM cell. reference: rnn_cell.py:253-330. Gate order i,f,c,o
+    matches the fused layout (rnn-inl.h:30-67)."""
+
+    def __init__(self, num_hidden, prefix="lstm_", params=None,
+                 forget_bias=1.0):
+        super().__init__(prefix=prefix, params=params)
+        self._num_hidden = num_hidden
+        from ..initializer import LSTMBias
+        self._iW = self.params.get("i2h_weight")
+        self._hW = self.params.get("h2h_weight")
+        self._iB = self.params.get(
+            "i2h_bias", init=LSTMBias(forget_bias=forget_bias))
+        self._hB = self.params.get("h2h_bias")
+
+    @property
+    def state_info(self):
+        return [{"shape": (0, self._num_hidden), "__layout__": "NC"},
+                {"shape": (0, self._num_hidden), "__layout__": "NC"}]
+
+    @property
+    def state_shape(self):
+        return [(0, self._num_hidden), (0, self._num_hidden)]
+
+    @property
+    def _gate_names(self):
+        return ("_i", "_f", "_c", "_o")
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        name = f"{self._prefix}t{self._counter}_"
+        i2h = symbol.FullyConnected(data=inputs, weight=self._iW,
+                                    bias=self._iB,
+                                    num_hidden=self._num_hidden * 4,
+                                    name=f"{name}i2h")
+        h2h = symbol.FullyConnected(data=states[0], weight=self._hW,
+                                    bias=self._hB,
+                                    num_hidden=self._num_hidden * 4,
+                                    name=f"{name}h2h")
+        gates = i2h + h2h
+        slice_gates = symbol.SliceChannel(gates, num_outputs=4,
+                                          name=f"{name}slice")
+        in_gate = symbol.Activation(slice_gates[0], act_type="sigmoid",
+                                    name=f"{name}i")
+        forget_gate = symbol.Activation(slice_gates[1], act_type="sigmoid",
+                                        name=f"{name}f")
+        in_transform = symbol.Activation(slice_gates[2], act_type="tanh",
+                                         name=f"{name}c")
+        out_gate = symbol.Activation(slice_gates[3], act_type="sigmoid",
+                                     name=f"{name}o")
+        next_c = forget_gate * states[1] + in_gate * in_transform
+        next_h = out_gate * symbol.Activation(next_c, act_type="tanh",
+                                              name=f"{name}state")
+        return next_h, [next_h, next_c]
+
+
+class GRUCell(BaseRNNCell):
+    """GRU cell. reference: rnn_cell.py:333-400. Gate order r,z,o matches
+    the fused layout."""
+
+    def __init__(self, num_hidden, prefix="gru_", params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._num_hidden = num_hidden
+        self._iW = self.params.get("i2h_weight")
+        self._iB = self.params.get("i2h_bias")
+        self._hW = self.params.get("h2h_weight")
+        self._hB = self.params.get("h2h_bias")
+
+    @property
+    def state_info(self):
+        return [{"shape": (0, self._num_hidden), "__layout__": "NC"}]
+
+    @property
+    def state_shape(self):
+        return [(0, self._num_hidden)]
+
+    @property
+    def _gate_names(self):
+        return ("_r", "_z", "_o")
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        seq_idx = self._counter
+        name = f"{self._prefix}t{seq_idx}_"
+        prev_state_h = states[0]
+        i2h = symbol.FullyConnected(data=inputs, weight=self._iW,
+                                    bias=self._iB,
+                                    num_hidden=self._num_hidden * 3,
+                                    name=f"{name}i2h")
+        h2h = symbol.FullyConnected(data=prev_state_h, weight=self._hW,
+                                    bias=self._hB,
+                                    num_hidden=self._num_hidden * 3,
+                                    name=f"{name}h2h")
+        i2h_r, i2h_z, i2h = symbol.SliceChannel(
+            i2h, num_outputs=3, name=f"{name}i2h_slice")
+        h2h_r, h2h_z, h2h = symbol.SliceChannel(
+            h2h, num_outputs=3, name=f"{name}h2h_slice")
+        reset_gate = symbol.Activation(i2h_r + h2h_r, act_type="sigmoid",
+                                       name=f"{name}r_act")
+        update_gate = symbol.Activation(i2h_z + h2h_z, act_type="sigmoid",
+                                        name=f"{name}z_act")
+        next_h_tmp = symbol.Activation(i2h + reset_gate * h2h,
+                                       act_type="tanh", name=f"{name}h_act")
+        next_h = prev_state_h + update_gate * (next_h_tmp - prev_state_h)
+        return next_h, [next_h]
+
+
+class FusedRNNCell(BaseRNNCell):
+    """Fused multi-layer RNN with a packed parameter blob.
+
+    reference: rnn_cell.py:403-560 wrapping the cuDNN RNN op
+    (cudnn_rnn-inl.h). Here ``unroll`` expands to per-layer unfused cells
+    reading slices of the packed blob — numerically identical, and XLA
+    fuses the unrolled steps (the MXU-friendly path). The packed layout
+    (all i2h weights, then h2h, per layer/direction, then biases) follows
+    rnn-inl.h:30-67 for pack/unpack checkpoint compat.
+    """
+
+    def __init__(self, num_hidden, num_layers=1, mode="lstm",
+                 bidirectional=False, dropout=0.0, get_next_state=False,
+                 forget_bias=1.0, prefix=None, params=None):
+        if prefix is None:
+            prefix = f"{mode}_"
+        super().__init__(prefix=prefix, params=params)
+        self._num_hidden = num_hidden
+        self._num_layers = num_layers
+        self._mode = mode
+        self._bidirectional = bidirectional
+        self._dropout = dropout
+        self._get_next_state = get_next_state
+        self._forget_bias = forget_bias
+        self._directions = ["l", "r"] if bidirectional else ["l"]
+        self._parameter = self.params.get("parameters")
+
+    @property
+    def state_info(self):
+        b = self._bidirectional + 1
+        n = (self._mode == "lstm") + 1
+        return [{"shape": (b * self._num_layers, 0, self._num_hidden),
+                 "__layout__": "LNC"} for _ in range(n)]
+
+    @property
+    def state_shape(self):
+        b = self._bidirectional + 1
+        n = (self._mode == "lstm") + 1
+        return [(b * self._num_layers, 0, self._num_hidden)] * n
+
+    @property
+    def _gate_names(self):
+        return {"rnn_relu": [""], "rnn_tanh": [""],
+                "lstm": ["_i", "_f", "_c", "_o"],
+                "gru": ["_r", "_z", "_o"]}[self._mode]
+
+    @property
+    def _num_gates(self):
+        return len(self._gate_names)
+
+    def _slice_weights(self, arr, li, lh):
+        """Slice the packed blob into per-layer gate weights/biases.
+        reference: rnn_cell.py:470-520 (layout from rnn-inl.h:30-67)."""
+        args = {}
+        gate_names = self._gate_names
+        directions = self._directions
+        b = len(directions)
+        p = 0
+        for layer in range(self._num_layers):
+            for direction in directions:
+                for gate in gate_names:
+                    name = f"{self._prefix}{direction}{layer}_i2h{gate}_weight"
+                    if layer > 0:
+                        size = b * lh * lh
+                        args[name] = arr[p:p + size].reshape((lh, b * lh))
+                    else:
+                        size = li * lh
+                        args[name] = arr[p:p + size].reshape((lh, li))
+                    p += size
+                for gate in gate_names:
+                    name = f"{self._prefix}{direction}{layer}_h2h{gate}_weight"
+                    size = lh ** 2
+                    args[name] = arr[p:p + size].reshape((lh, lh))
+                    p += size
+        for layer in range(self._num_layers):
+            for direction in directions:
+                for gate in gate_names:
+                    name = f"{self._prefix}{direction}{layer}_i2h{gate}_bias"
+                    args[name] = arr[p:p + lh]
+                    p += lh
+                for gate in gate_names:
+                    name = f"{self._prefix}{direction}{layer}_h2h{gate}_bias"
+                    args[name] = arr[p:p + lh]
+                    p += lh
+        assert p == arr.size, "Invalid parameters size for FusedRNNCell"
+        return args
+
+    def unpack_weights(self, args):
+        args = args.copy()
+        arr = args.pop(self._parameter.name)
+        b = len(self._directions)
+        m = self._num_gates
+        h = self._num_hidden
+        num_input = int(arr.size // b // h // m -
+                        (self._num_layers - 1) * (h + b * h + 2) - h - 2)
+        nargs = self._slice_weights(arr, num_input, self._num_hidden)
+        args.update({name: nd_arr.copy() if isinstance(nd_arr, NDArray)
+                     else nd_arr for name, nd_arr in nargs.items()})
+        return args
+
+    def pack_weights(self, args):
+        args = args.copy()
+        w0 = args[f"{self._prefix}l0_i2h{self._gate_names[0]}_weight"]
+        num_input = w0.shape[1]
+        total = self._num_params(num_input)
+        arr = nd.zeros((total,), ctx=w0.context, dtype=w0.dtype)
+        nargs = self._slice_weights(arr, num_input, self._num_hidden)
+        for name, nd_arr in nargs.items():
+            x = args.pop(name)
+            nd_arr._set(x.asjax().reshape(-1) if isinstance(x, NDArray)
+                        else x.reshape(-1))
+        args[self._parameter.name] = arr
+        return args
+
+    def _num_params(self, num_input):
+        b = len(self._directions)
+        m = self._num_gates
+        h = self._num_hidden
+        size = b * h * m * (num_input + h + 2)
+        for _ in range(1, self._num_layers):
+            size += b * h * m * (b * h + h + 2)
+        return size
+
+    def unroll(self, length, inputs=None, begin_state=None,
+               input_prefix="", layout="NTC", merge_outputs=None):
+        """Expand to stacked (bi)directional unfused cells over the packed
+        blob slices."""
+        self.reset()
+        stack = self._to_unfused()
+        return stack.unroll(length, inputs=inputs, begin_state=begin_state,
+                            input_prefix=input_prefix, layout=layout,
+                            merge_outputs=merge_outputs)
+
+    def _to_unfused(self):
+        """Build the equivalent SequentialRNNCell of unfused cells sharing
+        this cell's params via name-compatible vars."""
+        stack = SequentialRNNCell()
+        get_cell = {
+            "rnn_relu": lambda p: RNNCell(self._num_hidden,
+                                          activation="relu", prefix=p),
+            "rnn_tanh": lambda p: RNNCell(self._num_hidden,
+                                          activation="tanh", prefix=p),
+            "lstm": lambda p: LSTMCell(self._num_hidden, prefix=p,
+                                       forget_bias=self._forget_bias),
+            "gru": lambda p: GRUCell(self._num_hidden, prefix=p),
+        }[self._mode]
+        for layer in range(self._num_layers):
+            if self._dropout > 0 and layer > 0:
+                stack.add(DropoutCell(self._dropout,
+                                      prefix=f"{self._prefix}_dropout{layer}_"))
+            if self._bidirectional:
+                stack.add(BidirectionalCell(
+                    get_cell(f"{self._prefix}l{layer}_"),
+                    get_cell(f"{self._prefix}r{layer}_"),
+                    output_prefix=f"{self._prefix}bi_{layer}_"))
+            else:
+                stack.add(get_cell(f"{self._prefix}l{layer}_"))
+        return stack
+
+
+class SequentialRNNCell(BaseRNNCell):
+    """Stack cells. reference: rnn_cell.py:563-640."""
+
+    def __init__(self, params=None):
+        super().__init__(prefix="", params=params)
+        self._override_cell_params = params is not None
+        self._cells = []
+
+    def add(self, cell):
+        self._cells.append(cell)
+        if self._override_cell_params:
+            assert cell._own_params, \
+                "Either specify params for SequentialRNNCell or child cells,"\
+                " not both."
+            cell.params._params.update(self.params._params)
+        self.params._params.update(cell.params._params)
+
+    @property
+    def state_info(self):
+        return sum([c.state_info for c in self._cells], [])
+
+    @property
+    def state_shape(self):
+        return sum([c.state_shape for c in self._cells], [])
+
+    def begin_state(self, **kwargs):
+        assert not self._modified
+        return sum([c.begin_state(**kwargs) for c in self._cells], [])
+
+    def unpack_weights(self, args):
+        for cell in self._cells:
+            args = cell.unpack_weights(args)
+        return args
+
+    def pack_weights(self, args):
+        for cell in self._cells:
+            args = cell.pack_weights(args)
+        return args
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        next_states = []
+        p = 0
+        for cell in self._cells:
+            n = len(cell.state_info)
+            state = states[p:p + n]
+            p += n
+            inputs, state = cell(inputs, state)
+            next_states.append(state)
+        return inputs, sum(next_states, [])
+
+    def reset(self):
+        super().reset()
+        for cell in getattr(self, "_cells", []):
+            cell.reset()
+
+
+class BidirectionalCell(BaseRNNCell):
+    """reference: rnn_cell.py:643-740."""
+
+    def __init__(self, l_cell, r_cell, params=None, output_prefix="bi_"):
+        super().__init__("", params=params)
+        self._output_prefix = output_prefix
+        self._override_cell_params = params is not None
+        if self._override_cell_params:
+            assert l_cell._own_params and r_cell._own_params
+            l_cell.params._params.update(self.params._params)
+            r_cell.params._params.update(self.params._params)
+        self.params._params.update(l_cell.params._params)
+        self.params._params.update(r_cell.params._params)
+        self._cells = [l_cell, r_cell]
+
+    def unpack_weights(self, args):
+        for cell in self._cells:
+            args = cell.unpack_weights(args)
+        return args
+
+    def pack_weights(self, args):
+        for cell in self._cells:
+            args = cell.pack_weights(args)
+        return args
+
+    def __call__(self, inputs, states):
+        raise NotImplementedError(
+            "Bidirectional cannot be stepped. Please use unroll")
+
+    @property
+    def state_info(self):
+        return sum([c.state_info for c in self._cells], [])
+
+    @property
+    def state_shape(self):
+        return sum([c.state_shape for c in self._cells], [])
+
+    def begin_state(self, **kwargs):
+        assert not self._modified
+        return sum([c.begin_state(**kwargs) for c in self._cells], [])
+
+    def unroll(self, length, inputs=None, begin_state=None,
+               input_prefix="", layout="NTC", merge_outputs=None):
+        self.reset()
+        axis = layout.find("T")
+        if inputs is None:
+            inputs = [symbol.var(f"{input_prefix}t{i}_data")
+                      for i in range(length)]
+        elif isinstance(inputs, symbol.Symbol):
+            assert len(inputs) == 1
+            inputs = list(symbol.SliceChannel(inputs, axis=axis,
+                                              num_outputs=length,
+                                              squeeze_axis=1))
+        else:
+            assert len(inputs) == length
+        if begin_state is None:
+            begin_state = self.begin_state()
+
+        l_cell, r_cell = self._cells
+        n_l = len(l_cell.state_info)
+        l_outputs, l_states = l_cell.unroll(
+            length, inputs=inputs, begin_state=begin_state[:n_l],
+            layout=layout, merge_outputs=False)
+        r_outputs, r_states = r_cell.unroll(
+            length, inputs=list(reversed(inputs)),
+            begin_state=begin_state[n_l:], layout=layout,
+            merge_outputs=False)
+        outputs = [symbol.Concat(l_o, r_o, dim=1,
+                                 name=f"{self._output_prefix}t{i}")
+                   for i, (l_o, r_o) in enumerate(
+                       zip(l_outputs, reversed(r_outputs)))]
+        if merge_outputs:
+            outputs = [symbol.expand_dims(o, axis=axis) for o in outputs]
+            outputs = symbol.Concat(*outputs, dim=axis)
+        states = l_states + r_states
+        return outputs, states
+
+
+class ModifierCell(BaseRNNCell):
+    """Base for cells wrapping another cell. reference: rnn_cell.py:743."""
+
+    def __init__(self, base_cell):
+        super().__init__()
+        base_cell._modified = True
+        self.base_cell = base_cell
+
+    @property
+    def params(self):
+        self._own_params = False
+        return self.base_cell.params
+
+    @property
+    def state_info(self):
+        return self.base_cell.state_info
+
+    @property
+    def state_shape(self):
+        return self.base_cell.state_shape
+
+    def begin_state(self, init_sym=symbol.var, **kwargs):
+        assert not self._modified
+        self.base_cell._modified = False
+        begin = self.base_cell.begin_state(init_sym, **kwargs)
+        self.base_cell._modified = True
+        return begin
+
+    def unpack_weights(self, args):
+        return self.base_cell.unpack_weights(args)
+
+    def pack_weights(self, args):
+        return self.base_cell.pack_weights(args)
+
+
+class DropoutCell(BaseRNNCell):
+    """reference: rnn_cell.py:790."""
+
+    def __init__(self, dropout, prefix="dropout_", params=None):
+        super().__init__(prefix, params)
+        self.dropout = dropout
+
+    @property
+    def state_info(self):
+        return []
+
+    @property
+    def state_shape(self):
+        return []
+
+    def __call__(self, inputs, states):
+        if self.dropout > 0:
+            inputs = symbol.Dropout(data=inputs, p=self.dropout)
+        return inputs, states
+
+
+class ZoneoutCell(ModifierCell):
+    """reference: rnn_cell.py:830."""
+
+    def __init__(self, base_cell, zoneout_outputs=0.0, zoneout_states=0.0):
+        assert not isinstance(base_cell, FusedRNNCell), \
+            "FusedRNNCell doesn't support zoneout. Please unfuse first."
+        assert not isinstance(base_cell, BidirectionalCell), \
+            "BidirectionalCell doesn't support zoneout since it doesn't "\
+            "support step. Please add ZoneoutCell to the cells underneath "\
+            "instead."
+        super().__init__(base_cell)
+        self.zoneout_outputs = zoneout_outputs
+        self.zoneout_states = zoneout_states
+        self.prev_output = None
+
+    def reset(self):
+        super().reset()
+        self.prev_output = None
+        if hasattr(self, "base_cell"):
+            self.base_cell.reset()
+
+    def __call__(self, inputs, states):
+        cell, p_outputs, p_states = (self.base_cell, self.zoneout_outputs,
+                                     self.zoneout_states)
+        next_output, next_states = cell(inputs, states)
+        mask = (lambda p, like: symbol.Dropout(
+            symbol.ones_like(like), p=p))
+
+        prev_output = self.prev_output if self.prev_output is not None \
+            else symbol.zeros_like(next_output)
+        output = (symbol.where(mask(p_outputs, next_output), next_output,
+                               prev_output)
+                  if p_outputs != 0.0 else next_output)
+        new_states = ([symbol.where(mask(p_states, new_s), new_s, old_s)
+                       for new_s, old_s in zip(next_states, states)]
+                      if p_states != 0.0 else next_states)
+        self.prev_output = output
+        return output, new_states
+
+
+class ResidualCell(ModifierCell):
+    """reference: rnn_cell.py:900."""
+
+    def __call__(self, inputs, states):
+        output, states = self.base_cell(inputs, states)
+        output = symbol.elemwise_add(output, inputs)
+        return output, states

@@ -1,0 +1,578 @@
+"""Symbol: declarative graph construction.
+
+The reference Symbol is a handle into the NNVM C++ graph IR
+(reference: python/mxnet/symbol.py:1-1756, nnvm submodule) — composition by
+``__call__``, bidirectional shape/type inference passes, JSON save/load,
+``simple_bind``/``bind`` into a GraphExecutor.
+
+TPU-native design: the graph IR lives in Python (Node/Symbol below) because
+its ONLY job is to produce a traced JAX function — XLA is the real graph
+compiler (memory planning, fusion, scheduling = PlanMemory/bulk-exec/engine
+of the reference). The IR therefore stays minimal: nodes with typed attrs,
+topological evaluation, and an MXNet-style JSON wire format for checkpoint
+parity. Gradient construction is NOT a graph pass: ``bind`` hands the traced
+function to ``jax.vjp`` (see executor.py).
+"""
+from __future__ import annotations
+
+import json
+
+import numpy as np
+
+from .base import MXNetError, attr_to_str, str_to_attr
+from .context import current_context
+from .ops.registry import OP_REGISTRY, get_op
+from . import attribute, name as _name_mod
+
+__all__ = ["Symbol", "var", "Variable", "Group", "load", "load_json",
+           "zeros", "ones", "arange"]
+
+
+class Node:
+    """One op instance (or variable) in the graph."""
+
+    __slots__ = ("op", "name", "attrs", "inputs", "_extra")
+
+    def __init__(self, op, name, attrs=None, inputs=None, extra=None):
+        self.op = op                  # op name, or None for variables
+        self.name = name
+        self.attrs = attrs or {}      # typed op params
+        self.inputs = inputs or []    # list of (Node, out_index)
+        self._extra = extra or {}     # user attrs (__lr_mult__, ctx_group...)
+
+    @property
+    def is_variable(self):
+        return self.op is None
+
+    def opdef(self):
+        return get_op(self.op)
+
+
+class Symbol:
+    """A set of output entries over the node graph."""
+
+    def __init__(self, outputs):
+        self._outputs = list(outputs)  # [(Node, int)]
+
+    # ------------------------------------------------------------- graph walk
+    def _topo_nodes(self):
+        seen, order = set(), []
+
+        def visit(node):
+            if id(node) in seen:
+                return
+            seen.add(id(node))
+            for inp, _ in node.inputs:
+                visit(inp)
+            order.append(node)
+
+        for node, _ in self._outputs:
+            visit(node)
+        return order
+
+    def _arg_nodes(self):
+        return [n for n in self._topo_nodes()
+                if n.is_variable and not n._extra.get("__is_aux__")]
+
+    def _aux_nodes(self):
+        return [n for n in self._topo_nodes()
+                if n.is_variable and n._extra.get("__is_aux__")]
+
+    # -------------------------------------------------------------- listings
+    def list_arguments(self):
+        return [n.name for n in self._arg_nodes()]
+
+    def list_auxiliary_states(self):
+        return [n.name for n in self._aux_nodes()]
+
+    def list_outputs(self):
+        names = []
+        for node, idx in self._outputs:
+            if node.is_variable:
+                names.append(node.name)
+                continue
+            onames = node.opdef().output_names(node.attrs)
+            names.append(f"{node.name}_{onames[idx]}")
+        return names
+
+    @property
+    def name(self):
+        if len(self._outputs) == 1:
+            return self._outputs[0][0].name
+        return None
+
+    # ------------------------------------------------------------ attributes
+    def attr(self, key):
+        node = self._outputs[0][0]
+        val = node._extra.get(key)
+        if val is None and key in node.attrs:
+            return attr_to_str(node.attrs[key])
+        return val
+
+    def attr_dict(self):
+        ret = {}
+        for node in self._topo_nodes():
+            d = {k: attr_to_str(v) for k, v in node.attrs.items()}
+            d.update({k: v for k, v in node._extra.items()
+                      if not k.startswith("__is_aux__")})
+            if d:
+                ret[node.name] = d
+        return ret
+
+    def _set_attr(self, **kwargs):
+        for k, v in kwargs.items():
+            self._outputs[0][0]._extra[k] = v
+
+    # ------------------------------------------------------------ composition
+    def __call__(self, *args, **kwargs):
+        """Compose: substitute this symbol's free variables.
+
+        reference: symbol.py __call__/_compose — positional args match
+        list_arguments order, kwargs match variable names. Returns a new
+        Symbol with the substitution applied (graphs are immutable).
+        """
+        arg_names = self.list_arguments()
+        mapping = {}
+        if args:
+            for nm, a in zip(arg_names, args):
+                mapping[nm] = a
+        for k, v in kwargs.items():
+            if k == "name":
+                continue
+            mapping[k] = v
+        for k, v in mapping.items():
+            if not isinstance(v, Symbol):
+                raise TypeError("compose expects Symbol arguments")
+        return self._substitute(mapping)
+
+    def _substitute(self, mapping):
+        memo = {}
+
+        def clone(node):
+            if id(node) in memo:
+                return memo[id(node)]
+            if node.is_variable and node.name in mapping:
+                sub = mapping[node.name]
+                result = sub._outputs[0]
+                memo[id(node)] = result
+                return result
+            new = Node(node.op, node.name, dict(node.attrs), [],
+                       dict(node._extra))
+            memo[id(node)] = (new, None)
+            new.inputs = [(clone(inp)[0], idx if clone(inp)[1] is None
+                           else clone(inp)[1])
+                          for inp, idx in node.inputs]
+            # fix: for substituted inputs the entry index comes from mapping
+            fixed = []
+            for (inp, idx) in node.inputs:
+                cn, ci = clone(inp)
+                fixed.append((cn, idx if ci is None else ci))
+            new.inputs = fixed
+            return (new, None)
+
+        outs = []
+        for node, idx in self._outputs:
+            cn, ci = clone(node)
+            outs.append((cn, idx if ci is None else ci))
+        return Symbol(outs)
+
+    # ------------------------------------------------------------- accessors
+    def __getitem__(self, index):
+        if isinstance(index, str):
+            names = self.list_outputs()
+            if index not in names:
+                raise ValueError(f"no output named {index!r}")
+            index = names.index(index)
+        return Symbol([self._outputs[index]])
+
+    def __len__(self):
+        return len(self._outputs)
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def get_internals(self):
+        """Symbol over every node output. reference: symbol.py internals."""
+        outs = []
+        for node in self._topo_nodes():
+            if node.is_variable:
+                outs.append((node, 0))
+            else:
+                for i in range(node.opdef().num_outputs(node.attrs)):
+                    outs.append((node, i))
+        return Symbol(outs)
+
+    def get_output(self, index):
+        return self[index]
+
+    # ------------------------------------------------------------ arithmetic
+    def _binary_op(self, other, opname, scalar_op, reverse=False):
+        if isinstance(other, Symbol):
+            return _create(opname, [self, other])
+        if isinstance(other, (int, float, np.generic)):
+            return _create(scalar_op, [self], scalar=float(other))
+        return NotImplemented
+
+    def __add__(self, o): return self._binary_op(o, "_plus", "_add_scalar")
+    __radd__ = __add__
+    def __sub__(self, o): return self._binary_op(o, "_minus", "_sub_scalar")
+
+    def __rsub__(self, o):
+        return _create("_rsub_scalar", [self], scalar=float(o))
+
+    def __mul__(self, o): return self._binary_op(o, "_mul", "_mul_scalar")
+    __rmul__ = __mul__
+    def __truediv__(self, o): return self._binary_op(o, "_div", "_div_scalar")
+    __div__ = __truediv__
+
+    def __rtruediv__(self, o):
+        return _create("_rdiv_scalar", [self], scalar=float(o))
+    __rdiv__ = __rtruediv__
+
+    def __pow__(self, o): return self._binary_op(o, "_power", "_power_scalar")
+    def __neg__(self): return _create("negative", [self])
+
+    def __copy__(self):
+        return Symbol(list(self._outputs))
+
+    def __repr__(self):
+        name = self.name
+        return f"<Symbol {name if name else 'Grouped'}>"
+
+    # -------------------------------------------------------------- inference
+    def infer_shape(self, *args, **kwargs):
+        """Bidirectional shape inference over the graph.
+
+        Forward-propagates known shapes node by node using each op's
+        infer_shape (which also fills weight/bias shapes — the reference's
+        InferShape pass, graph_executor.cc:425). Returns (arg_shapes,
+        out_shapes, aux_shapes) in listing order; None entries when unknown.
+        """
+        try:
+            return self._infer_shape_impl(False, *args, **kwargs)
+        except MXNetError:
+            raise
+
+    def infer_shape_partial(self, *args, **kwargs):
+        return self._infer_shape_impl(True, *args, **kwargs)
+
+    def _infer_shape_impl(self, partial, *args, **kwargs):
+        arg_names = self.list_arguments()
+        known = {}
+        if args:
+            for nm, s in zip(arg_names, args):
+                if s is not None:
+                    known[nm] = tuple(s)
+        for k, v in kwargs.items():
+            known[k] = tuple(v)
+
+        shapes = {}  # id(node) -> list of out shapes
+        for node in self._topo_nodes():
+            if node.is_variable:
+                shapes[id(node)] = [known.get(node.name)]
+                continue
+            opdef = node.opdef()
+            in_shapes = [shapes[id(inp)][idx] for inp, idx in node.inputs]
+            new_in, out_shapes, aux_shapes = _infer_node_shape(
+                opdef, node, in_shapes, partial)
+            # write back filled input shapes into their source entries
+            for (inp, idx), s in zip(node.inputs, new_in):
+                if s is not None and shapes[id(inp)][idx] is None:
+                    shapes[id(inp)][idx] = tuple(s)
+            shapes[id(node)] = [tuple(s) if s is not None else None
+                                for s in out_shapes]
+
+        arg_shapes = [shapes[id(n)][0] for n in self._arg_nodes()]
+        aux_shapes = [shapes[id(n)][0] for n in self._aux_nodes()]
+        out_shapes = [shapes[id(n)][i] for n, i in self._outputs]
+        if not partial and any(s is None for s in arg_shapes):
+            missing = [nm for nm, s in zip(arg_names, arg_shapes) if s is None]
+            raise MXNetError(f"cannot infer shapes for arguments {missing}; "
+                             "provide more input shapes")
+        return arg_shapes, out_shapes, aux_shapes
+
+    def infer_type(self, *args, **kwargs):
+        """Type inference: defaults to float32 propagation."""
+        arg_names = self.list_arguments()
+        known = {}
+        if args:
+            for nm, t in zip(arg_names, args):
+                if t is not None:
+                    known[nm] = np.dtype(t)
+        for k, v in kwargs.items():
+            known[k] = np.dtype(v)
+        default = next(iter(known.values())) if known else np.dtype("float32")
+        arg_types = [known.get(nm, default) for nm in arg_names]
+        out_types = [default] * len(self._outputs)
+        aux_types = [np.dtype("float32")] * len(self._aux_nodes())
+        return arg_types, out_types, aux_types
+
+    # ----------------------------------------------------------- serialization
+    def tojson(self):
+        """MXNet-style JSON graph (reference: nnvm SaveJSON,
+        c_api_symbolic.cc:330-361): nodes + arg_nodes + heads."""
+        nodes = self._topo_nodes()
+        node_ids = {id(n): i for i, n in enumerate(nodes)}
+        jnodes = []
+        for n in nodes:
+            jn = {
+                "op": "null" if n.is_variable else n.op,
+                "name": n.name,
+                "inputs": [[node_ids[id(inp)], idx, 0]
+                           for inp, idx in n.inputs],
+            }
+            attrs = {k: attr_to_str(v) for k, v in n.attrs.items()}
+            attrs.update({k: str(v) for k, v in n._extra.items()})
+            if attrs:
+                jn["attrs"] = attrs
+            jnodes.append(jn)
+        arg_nodes = [node_ids[id(n)] for n in nodes if n.is_variable]
+        heads = [[node_ids[id(n)], i, 0] for n, i in self._outputs]
+        return json.dumps({"nodes": jnodes, "arg_nodes": arg_nodes,
+                           "node_row_ptr": [], "heads": heads,
+                           "attrs": {"mxnet_version": ["int", 905]}},
+                          indent=2)
+
+    def save(self, fname):
+        with open(fname, "w") as f:
+            f.write(self.tojson())
+
+    # ----------------------------------------------------------------- binding
+    def simple_bind(self, ctx=None, grad_req="write", type_dict=None,
+                    group2ctx=None, **kwargs):
+        from .executor import Executor
+        return Executor._simple_bind(self, ctx or current_context(), grad_req,
+                                     type_dict, group2ctx, kwargs)
+
+    def bind(self, ctx=None, args=None, args_grad=None, grad_req="write",
+             aux_states=None, group2ctx=None, shared_exec=None):
+        from .executor import Executor
+        return Executor(self, ctx or current_context(), args, args_grad,
+                        grad_req, aux_states, group2ctx, shared_exec)
+
+    # ------------------------------------------------------------ eval helper
+    def eval(self, ctx=None, **kwargs):
+        shapes = {k: v.shape for k, v in kwargs.items()}
+        ex = self.simple_bind(ctx=ctx or current_context(), grad_req="null",
+                              **shapes)
+        return ex.forward(is_train=False, **kwargs)
+
+
+def _infer_node_shape(opdef, node, in_shapes, partial):
+    aux_count = len(opdef.aux_names(node.attrs))
+    regular = in_shapes[:len(in_shapes) - aux_count] if aux_count else in_shapes
+    if opdef.infer_shape is not None:
+        try:
+            new_in, outs, auxs = opdef.infer_shape(node.attrs, regular)
+        except (TypeError, KeyError, IndexError):
+            if partial:
+                n_out = opdef.num_outputs(node.attrs)
+                return in_shapes, [None] * n_out, []
+            raise
+        return list(new_in) + list(auxs), outs, auxs
+    # fallback: abstract evaluation requires complete input shapes
+    if any(s is None for s in in_shapes):
+        n_out = opdef.num_outputs(node.attrs)
+        return in_shapes, [None] * n_out, []
+    import jax
+    import jax.numpy as jnp
+
+    def run(*arrs):
+        reg = list(arrs[:len(arrs) - aux_count]) if aux_count else list(arrs)
+        aux = list(arrs[len(arrs) - aux_count:]) if aux_count else []
+        outs, _ = opdef.forward(node.attrs, reg, aux, False,
+                                jax.random.PRNGKey(0) if opdef.need_rng
+                                else None)
+        return outs
+
+    dummies = [jax.ShapeDtypeStruct(tuple(s), np.float32) for s in in_shapes]
+    try:
+        out_shapes = [tuple(o.shape) for o in jax.eval_shape(run, *dummies)]
+    except Exception as e:  # noqa: BLE001 — surface as inference failure
+        if partial:
+            n_out = opdef.num_outputs(node.attrs)
+            return in_shapes, [None] * n_out, []
+        raise MXNetError(
+            f"shape inference failed for op {node.op} ({node.name}): {e}")
+    aux_shapes = out_shapes[len(out_shapes):]
+    return in_shapes, out_shapes, aux_shapes
+
+
+# ------------------------------------------------------------------ factories
+def var(name, attr=None, shape=None, lr_mult=None, wd_mult=None, dtype=None,
+        init=None, **kwargs):
+    """Create a variable symbol. reference: symbol.py Variable."""
+    if not isinstance(name, str):
+        raise TypeError("Expect a string for variable name")
+    extra = attribute.current_attrs(attr)
+    extra = dict(extra) if extra else {}
+    if lr_mult is not None:
+        extra["__lr_mult__"] = str(lr_mult)
+    if wd_mult is not None:
+        extra["__wd_mult__"] = str(wd_mult)
+    if dtype is not None:
+        extra["__dtype__"] = str(np.dtype(dtype))
+    if shape is not None:
+        extra["__shape__"] = str(tuple(shape))
+    if init is not None:
+        extra["__init__"] = init if isinstance(init, str) else \
+            init.dumps() if hasattr(init, "dumps") else str(init)
+    extra.update({k: str(v) for k, v in kwargs.items()})
+    return Symbol([(Node(None, name, extra=extra), 0)])
+
+
+Variable = var
+
+
+def Group(symbols):
+    """Group symbols into one multi-output symbol. reference: sym.Group."""
+    outs = []
+    for s in symbols:
+        outs.extend(s._outputs)
+    return Symbol(outs)
+
+
+def load_json(json_str):
+    data = json.loads(json_str)
+    jnodes = data["nodes"]
+    built = []
+    for jn in jnodes:
+        attrs_raw = jn.get("attrs", jn.get("param", {})) or {}
+        op = jn["op"]
+        if op == "null":
+            node = Node(None, jn["name"],
+                        extra={k: v for k, v in attrs_raw.items()})
+            if attrs_raw.get("__is_aux__") == "True":
+                node._extra["__is_aux__"] = True
+        else:
+            opdef = get_op(op)
+            attrs = opdef.normalize_attrs(
+                {k: str_to_attr(v) for k, v in attrs_raw.items()
+                 if not k.startswith("__")})
+            extra = {k: v for k, v in attrs_raw.items() if k.startswith("__")}
+            node = Node(op, jn["name"], attrs, extra=extra)
+        node.inputs = [(built[i], oi) for i, oi, *_ in jn["inputs"]]
+        built.append(node)
+    heads = data.get("heads", [[len(built) - 1, 0, 0]])
+    # restore aux marking from op aux slots
+    for node in built:
+        if node.is_variable or node.op is None:
+            continue
+        opdef = get_op(node.op)
+        aux_n = len(opdef.aux_names(node.attrs))
+        if aux_n:
+            for inp, _ in node.inputs[len(node.inputs) - aux_n:]:
+                if inp.is_variable:
+                    inp._extra["__is_aux__"] = True
+    return Symbol([(built[i], oi) for i, oi, *_ in heads])
+
+
+def load(fname):
+    with open(fname) as f:
+        return load_json(f.read())
+
+
+# ---------------------------------------------------------------- op creation
+def _create(op_name, input_syms, name=None, attr=None, **params):
+    """Build a Symbol node for a registered op (the symbolic invoke path)."""
+    opdef = get_op(op_name)
+    attrs = opdef.normalize_attrs(params)
+    node_name = _name_mod.current().get(name, op_name.strip("_"))
+    extra = attribute.current_attrs(attr)
+    extra = dict(extra) if extra else {}
+
+    in_names = opdef.input_names(attrs)
+    aux_names = opdef.aux_names(attrs)
+    inputs = []
+    for i, inm in enumerate(in_names):
+        if i < len(input_syms) and input_syms[i] is not None:
+            s = input_syms[i]
+            if len(s._outputs) != 1:
+                raise MXNetError(
+                    f"op {op_name} input {inm} must be single-output")
+            inputs.append(s._outputs[0])
+        else:
+            # auto-create missing weight/bias variables (reference: compose
+            # auto-creates named vars per ListArguments)
+            vnode = Node(None, f"{node_name}_{inm}", extra=dict(extra))
+            inputs.append((vnode, 0))
+    for anm in aux_names:
+        vnode = Node(None, f"{node_name}_{anm}",
+                     extra={**extra, "__is_aux__": True})
+        inputs.append((vnode, 0))
+
+    node = Node(op_name, node_name, attrs, inputs, extra)
+    n_out = opdef.num_visible_outputs(attrs)
+    return Symbol([(node, i) for i in range(n_out)])
+
+
+def _make_symbol_function(op_name):
+    opdef = get_op(op_name)
+
+    def creator(*args, **kwargs):
+        name = kwargs.pop("name", None)
+        attr = kwargs.pop("attr", None)
+        opdef_local = opdef
+        in_names = opdef_local.input_names(
+            opdef_local.normalize_attrs(
+                {k: v for k, v in kwargs.items()
+                 if not isinstance(v, Symbol)}))
+        input_syms = list(args)
+        # keyword inputs (data=..., weight=...)
+        sym_kwargs = {k: v for k, v in kwargs.items() if isinstance(v, Symbol)}
+        params = {k: v for k, v in kwargs.items()
+                  if not isinstance(v, Symbol)}
+        if sym_kwargs:
+            by_name = [None] * len(in_names)
+            for i, s in enumerate(input_syms):
+                by_name[i] = s
+            for k, v in sym_kwargs.items():
+                if k in in_names:
+                    by_name[in_names.index(k)] = v
+                else:
+                    # variadic ops (Concat) accept arbitrary kw names
+                    try:
+                        slot = by_name.index(None)
+                        by_name[slot] = v
+                    except ValueError:
+                        by_name.append(v)
+            input_syms = by_name
+        # variadic ops: positional args beyond spec extend num_args
+        if opdef_local._inputs and callable(opdef_local._inputs):
+            if "num_args" in opdef_local.attr_spec and \
+                    "num_args" not in params:
+                params["num_args"] = len([s for s in input_syms
+                                          if s is not None]) or len(args)
+        return _create(op_name, input_syms, name=name, attr=attr, **params)
+
+    creator.__name__ = op_name
+    creator.__doc__ = opdef.doc or f"symbolic {op_name}"
+    return creator
+
+
+def _init_symbol_module(module_dict):
+    """Auto-generate mx.sym.<op> functions (reference: symbol.py:1585)."""
+    for op_name in list(OP_REGISTRY):
+        if op_name.startswith("_backward"):
+            continue
+        fn = _make_symbol_function(op_name)
+        module_dict[op_name] = fn
+        if op_name.startswith("_") and op_name[1:] not in module_dict:
+            pass
+
+
+def zeros(shape, dtype=None, name=None):
+    return _create("_zeros", [], name=name, shape=shape,
+                   dtype=str(np.dtype(dtype or "float32")))
+
+
+def ones(shape, dtype=None, name=None):
+    return _create("_ones", [], name=name, shape=shape,
+                   dtype=str(np.dtype(dtype or "float32")))
+
+
+def arange(start, stop=None, step=1.0, repeat=1, dtype=None, name=None):
+    return _create("_arange", [], name=name, start=start, stop=stop,
+                   step=step, repeat=repeat,
+                   dtype=str(np.dtype(dtype or "float32")))

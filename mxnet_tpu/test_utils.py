@@ -1,0 +1,383 @@
+"""Test fixtures (reference: python/mxnet/test_utils.py).
+
+The reference's check_* helpers make every op test cheap (SURVEY.md §4):
+``check_numeric_gradient`` (finite differences vs symbolic backward,
+test_utils.py:360), ``check_symbolic_forward/backward`` (:473, :526),
+``assert_almost_equal`` (:128), ``check_consistency`` (:676 — the CPU<->GPU
+parity harness, here CPU-jax vs TPU).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .context import Context, cpu, current_context
+from .ndarray import NDArray, array, zeros
+from . import ndarray as nd
+from .symbol import Symbol
+from . import random as _random
+
+
+def default_context():
+    return current_context()
+
+
+def set_default_context(ctx):
+    Context._local.stack = [ctx]
+
+
+def default_dtype():
+    return np.float32
+
+
+def rand_shape_2d(dim0=10, dim1=10):
+    return (np.random.randint(1, dim0 + 1), np.random.randint(1, dim1 + 1))
+
+
+def rand_shape_3d(dim0=10, dim1=10, dim2=10):
+    return (np.random.randint(1, dim0 + 1), np.random.randint(1, dim1 + 1),
+            np.random.randint(1, dim2 + 1))
+
+
+def rand_ndarray(shape, ctx=None, dtype=np.float32):
+    return array(np.random.uniform(-1, 1, shape).astype(dtype), ctx=ctx)
+
+
+def np_reduce(dat, axis, keepdims, numpy_reduce_func):
+    """reference: test_utils.py np_reduce."""
+    if isinstance(axis, int):
+        axis = [axis]
+    else:
+        axis = list(axis) if axis is not None else \
+            range(len(dat.shape))
+    ret = dat
+    for i in reversed(sorted(axis)):
+        ret = numpy_reduce_func(ret, axis=i)
+    if keepdims:
+        keepdims_shape = list(dat.shape)
+        for i in axis:
+            keepdims_shape[i] = 1
+        ret = ret.reshape(tuple(keepdims_shape))
+    return ret
+
+
+def _parse_location(sym, location, ctx):
+    """reference: test_utils.py _parse_location."""
+    assert isinstance(location, (dict, list, tuple))
+    if isinstance(location, dict):
+        if set(location.keys()) != set(sym.list_arguments()):
+            raise ValueError(
+                f"Symbol arguments and keys of the given location do not "
+                f"match. symbol args:{sym.list_arguments()}, "
+                f"location.keys():{list(location.keys())}")
+    else:
+        location = {k: v for k, v in zip(sym.list_arguments(), location)}
+    location = {k: array(v, ctx=ctx) if isinstance(v, np.ndarray)
+                else v for k, v in location.items()}
+    return location
+
+
+def _parse_aux_states(sym, aux_states, ctx):
+    if aux_states is not None:
+        if isinstance(aux_states, dict):
+            if set(aux_states.keys()) != set(sym.list_auxiliary_states()):
+                raise ValueError("Symbol aux_states names and given "
+                                 "aux_states do not match.")
+        elif isinstance(aux_states, (list, tuple)):
+            aux_names = sym.list_auxiliary_states()
+            aux_states = {k: v for k, v in zip(aux_names, aux_states)}
+        aux_states = {k: array(v, ctx=ctx) if isinstance(v, np.ndarray)
+                      else v for k, v in aux_states.items()}
+    return aux_states
+
+
+def assert_almost_equal(a, b, rtol=1e-5, atol=1e-20, names=("a", "b")):
+    """reference: test_utils.py:128."""
+    a = a.asnumpy() if isinstance(a, NDArray) else np.asarray(a)
+    b = b.asnumpy() if isinstance(b, NDArray) else np.asarray(b)
+    if atol is None:
+        atol = 1e-20
+    np.testing.assert_allclose(a, b, rtol=rtol, atol=atol,
+                               err_msg=f"{names[0]} vs {names[1]}")
+
+
+def almost_equal(a, b, rtol=1e-5, atol=1e-20):
+    a = a.asnumpy() if isinstance(a, NDArray) else np.asarray(a)
+    b = b.asnumpy() if isinstance(b, NDArray) else np.asarray(b)
+    return np.allclose(a, b, rtol=rtol, atol=atol)
+
+
+def same(a, b):
+    a = a.asnumpy() if isinstance(a, NDArray) else np.asarray(a)
+    b = b.asnumpy() if isinstance(b, NDArray) else np.asarray(b)
+    return np.array_equal(a, b)
+
+
+def check_numeric_gradient(sym, location, aux_states=None, numeric_eps=1e-3,
+                           rtol=1e-2, atol=None, grad_nodes=None,
+                           use_forward_train=True, ctx=None):
+    """Finite differences vs symbolic backward. reference:
+    test_utils.py:360."""
+    ctx = ctx or default_context()
+    location = _parse_location(sym, location, ctx)
+    location_npy = {k: v.asnumpy() for k, v in location.items()}
+    aux_states = _parse_aux_states(sym, aux_states, ctx)
+    if aux_states is not None:
+        aux_states_npy = {k: v.asnumpy() for k, v in aux_states.items()}
+    else:
+        aux_states_npy = None
+    if grad_nodes is None:
+        grad_nodes = sym.list_arguments()
+        grad_req = {k: "write" for k in grad_nodes}
+    elif isinstance(grad_nodes, (list, tuple)):
+        grad_nodes = list(grad_nodes)
+        grad_req = {k: "write" if k in grad_nodes else "null"
+                    for k in sym.list_arguments()}
+    elif isinstance(grad_nodes, dict):
+        grad_req = grad_nodes.copy()
+        grad_nodes = list(grad_nodes.keys())
+    else:
+        raise ValueError
+
+    input_shape = {k: v.shape for k, v in location.items()}
+    _, out_shape, _ = sym.infer_shape(**input_shape)
+    proj = Symbol.__new__(Symbol)  # random projection to scalar loss
+    from . import symbol as _sym
+    out = _sym.MakeLoss(_sym.sum(sym * _sym.var("__random_proj")))
+    location = dict(location)
+    proj_arr = np.random.uniform(-1.0, 1.0, size=out_shape[0])
+    location["__random_proj"] = array(proj_arr, ctx=ctx)
+    args_grad = {k: zeros(location[k].shape, ctx=ctx)
+                 for k in grad_nodes + ["__random_proj"]}
+    grad_req = dict(grad_req)
+    grad_req["__random_proj"] = "write"
+
+    executor = out.bind(ctx, args=location, args_grad=args_grad,
+                        grad_req=grad_req, aux_states=aux_states)
+    executor.forward(is_train=True)
+    executor.backward()
+    symbolic_grads = {k: executor.grad_dict[k].asnumpy()
+                      for k in grad_nodes}
+
+    # numeric gradient by central differences on the projected scalar;
+    # ONE executor bound outside the loop so the jitted program is reused
+    # for every perturbation (compile once, run 2*size times)
+    eval_args = {k: array(v, ctx=ctx) for k, v in location_npy.items()}
+    eval_args["__random_proj"] = array(proj_arr, ctx=ctx)
+    ex2 = out.bind(ctx, args=eval_args, grad_req="null",
+                   aux_states=_parse_aux_states(sym, aux_states_npy, ctx)
+                   if aux_states_npy else None)
+
+    def eval_loss(loc_npy):
+        for k, v in loc_npy.items():
+            eval_args[k]._set(__import__("jax").numpy.asarray(
+                v.astype(np.float32)))
+        ex2.forward(is_train=use_forward_train)
+        return float(np.sum(ex2.outputs[0].asnumpy()))
+
+    for name in grad_nodes:
+        base = {k: v.copy() for k, v in location_npy.items()}
+        grad_np = np.zeros(base[name].shape, dtype=np.float64)
+        flat = base[name].reshape(-1)
+        gflat = grad_np.reshape(-1)
+        for i in range(flat.size):
+            orig = flat[i]
+            flat[i] = orig + numeric_eps / 2
+            fp = eval_loss(base)
+            flat[i] = orig - numeric_eps / 2
+            fm = eval_loss(base)
+            flat[i] = orig
+            gflat[i] = (fp - fm) / numeric_eps
+        assert_almost_equal(grad_np, symbolic_grads[name], rtol=rtol,
+                            atol=atol if atol is not None else rtol * 1e-1,
+                            names=(f"numeric-{name}", f"symbolic-{name}"))
+
+
+def check_symbolic_forward(sym, location, expected, rtol=1e-5, atol=None,
+                           aux_states=None, ctx=None):
+    """reference: test_utils.py:473."""
+    ctx = ctx or default_context()
+    location = _parse_location(sym, location, ctx)
+    aux_states = _parse_aux_states(sym, aux_states, ctx)
+    if isinstance(expected, dict):
+        expected = [expected[k] for k in sym.list_outputs()]
+    args_grad_data = {k: zeros(v.shape, ctx=ctx)
+                      for k, v in location.items()}
+    executor = sym.bind(ctx, args=location, args_grad=args_grad_data,
+                        aux_states=aux_states)
+    executor.forward(is_train=False)
+    outputs = [x.asnumpy() for x in executor.outputs]
+    for output_name, expect, output in zip(sym.list_outputs(), expected,
+                                           outputs):
+        assert_almost_equal(expect, output, rtol, atol,
+                            (f"EXPECTED_{output_name}", output_name))
+    return executor.outputs
+
+
+def check_symbolic_backward(sym, location, out_grads, expected, rtol=1e-5,
+                            atol=None, aux_states=None, grad_req="write",
+                            ctx=None):
+    """reference: test_utils.py:526."""
+    ctx = ctx or default_context()
+    location = _parse_location(sym, location, ctx)
+    aux_states = _parse_aux_states(sym, aux_states, ctx)
+    if isinstance(expected, (list, tuple)):
+        expected = {k: v for k, v in zip(sym.list_arguments(), expected)}
+    args_grad_npy = {k: np.random.normal(size=v.shape)
+                     for k, v in expected.items()}
+    args_grad_data = {k: array(v, ctx=ctx)
+                      for k, v in args_grad_npy.items()}
+    if isinstance(grad_req, str):
+        grad_req = {k: grad_req for k in sym.list_arguments()}
+    elif isinstance(grad_req, (list, tuple)):
+        grad_req = {k: v for k, v in zip(sym.list_arguments(), grad_req)}
+    executor = sym.bind(ctx, args=location, args_grad=args_grad_data,
+                        aux_states=aux_states, grad_req=grad_req)
+    executor.forward(is_train=True)
+    if isinstance(out_grads, (tuple, list)):
+        out_grads = [array(v, ctx=ctx) if isinstance(v, np.ndarray) else v
+                     for v in out_grads]
+    elif isinstance(out_grads, (dict)):
+        out_grads = [array(out_grads[k], ctx=ctx)
+                     for k in sym.list_outputs()]
+    elif out_grads is None:
+        pass
+    else:
+        raise ValueError("out_grads must be dict, list or None")
+    executor.backward(out_grads)
+    grads = {k: v.asnumpy() for k, v in executor.grad_dict.items()
+             if v is not None}
+    for name in expected:
+        if grad_req[name] == "write":
+            assert_almost_equal(expected[name], grads[name], rtol, atol,
+                                (f"EXPECTED_{name}", name))
+        elif grad_req[name] == "add":
+            assert_almost_equal(expected[name],
+                                grads[name] - args_grad_npy[name],
+                                rtol, atol, (f"EXPECTED_{name}", name))
+        elif grad_req[name] == "null":
+            assert_almost_equal(args_grad_npy[name], grads[name],
+                                rtol, atol, (f"EXPECTED_{name}", name))
+    return executor.grad_dict
+
+
+def check_consistency(sym, ctx_list, scale=1.0, grad_req="write",
+                      arg_params=None, aux_params=None, tol=None,
+                      raise_on_err=True):
+    """Cross-device parity harness. reference: test_utils.py:676 — run the
+    same symbol under every (ctx, dtype) config and compare fwd/bwd
+    pairwise against the most precise one."""
+    if tol is None:
+        tol = {np.dtype(np.float16): 1e-1, np.dtype(np.float32): 1e-3,
+               np.dtype(np.float64): 1e-5, np.dtype(np.uint8): 0,
+               np.dtype(np.int32): 0}
+    elif isinstance(tol, float):
+        tol = {np.dtype(np.float16): tol, np.dtype(np.float32): tol,
+               np.dtype(np.float64): tol, np.dtype(np.uint8): tol,
+               np.dtype(np.int32): tol}
+    assert len(ctx_list) > 1
+    if isinstance(sym, Symbol):
+        sym = [sym] * len(ctx_list)
+    else:
+        assert len(sym) == len(ctx_list)
+    output_names = sym[0].list_outputs()
+    arg_names = sym[0].list_arguments()
+    exe_list = []
+    for s, ctx in zip(sym, ctx_list):
+        assert s.list_arguments() == arg_names
+        assert s.list_outputs() == output_names
+        exe_list.append(s.simple_bind(grad_req=grad_req, **ctx))
+    arg_dict = {}
+    for n, arr in exe_list[0].arg_dict.items():
+        arg_dict[n] = np.random.normal(size=arr.shape, scale=scale)
+        if arg_params is not None and n in arg_params:
+            arg_dict[n] = arg_params[n]
+    aux_dict = {}
+    for n, arr in exe_list[0].aux_dict.items():
+        aux_dict[n] = np.random.normal(size=arr.shape, scale=scale)
+        if aux_params is not None and n in aux_params:
+            aux_dict[n] = aux_params[n]
+    for exe in exe_list:
+        for name, arr in exe.arg_dict.items():
+            arr[:] = arg_dict[name]
+        for name, arr in exe.aux_dict.items():
+            arr[:] = aux_dict[name]
+    dtypes = [np.dtype(exe.outputs[0].dtype) if exe.outputs else
+              np.dtype(np.float32) for exe in exe_list]
+    # forward
+    for exe in exe_list:
+        exe.forward(is_train=False)
+    max_idx = int(np.argmax([d.itemsize for d in dtypes]))
+    gt = [o.asnumpy() for o in exe_list[max_idx].outputs]
+    for i, exe in enumerate(exe_list):
+        if i == max_idx:
+            continue
+        rtol = tol[dtypes[i]]
+        for name, arr, garr in zip(output_names, exe.outputs, gt):
+            assert_almost_equal(arr.asnumpy().astype(dtypes[max_idx]), garr,
+                                rtol=rtol, atol=rtol,
+                                names=(f"exe{i}-{name}",
+                                       f"exe{max_idx}-{name}"))
+    # train + backward
+    if grad_req != "null":
+        for exe in exe_list:
+            exe.forward(is_train=True)
+            exe.backward([NDArray(o.asjax()) for o in exe.outputs])
+        gt_g = {n: g.asnumpy() for n, g in
+                exe_list[max_idx].grad_dict.items() if g is not None}
+        for i, exe in enumerate(exe_list):
+            if i == max_idx:
+                continue
+            rtol = tol[dtypes[i]]
+            for name, arr in exe.grad_dict.items():
+                if arr is None:
+                    continue
+                assert_almost_equal(
+                    arr.asnumpy().astype(dtypes[max_idx]), gt_g[name],
+                    rtol=rtol, atol=rtol,
+                    names=(f"grad-exe{i}-{name}", f"grad-exe{max_idx}-{name}"))
+    return gt
+
+
+def check_speed(sym, location=None, ctx=None, N=20, grad_req=None,
+                typ="whole", **kwargs):
+    """Timing helper. reference: test_utils.py:602."""
+    import time
+    ctx = ctx or default_context()
+    if grad_req is None:
+        grad_req = "write"
+    if location is None:
+        exe = sym.simple_bind(grad_req=grad_req, ctx=ctx, **kwargs)
+        location = {k: np.random.normal(size=arr.shape, scale=1.0)
+                    for k, arr in exe.arg_dict.items()}
+    else:
+        assert isinstance(location, dict)
+        exe = sym.simple_bind(grad_req=grad_req, ctx=ctx,
+                              **{k: v.shape for k, v in location.items()})
+    for name, iarr in location.items():
+        exe.arg_dict[name][:] = iarr.astype(exe.arg_dict[name].dtype)
+
+    if typ == "whole":
+        exe.forward(is_train=True)
+        exe.backward()
+        for output in exe.outputs:
+            output.wait_to_read()
+        tic = time.time()
+        for _ in range(N):
+            exe.forward(is_train=True)
+            exe.backward()
+            for output in exe.outputs:
+                output.wait_to_read()
+        toc = time.time()
+        return (toc - tic) / N
+    if typ == "forward":
+        exe.forward(is_train=False)
+        for output in exe.outputs:
+            output.wait_to_read()
+        tic = time.time()
+        for _ in range(N):
+            exe.forward(is_train=False)
+            for output in exe.outputs:
+                output.wait_to_read()
+        toc = time.time()
+        return (toc - tic) / N
+    raise ValueError("typ can only be whole or forward")

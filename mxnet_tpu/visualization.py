@@ -1,0 +1,212 @@
+"""Network visualization (reference: python/mxnet/visualization.py)."""
+from __future__ import annotations
+
+import json
+
+from .symbol import Symbol
+
+__all__ = ["print_summary", "plot_network"]
+
+
+def print_summary(symbol, shape=None, line_length=120, positions=(.44, .64,
+                                                                  .74, 1.)):
+    """Layer-by-layer summary table. reference: visualization.py:21."""
+    show_shape = False
+    shape_dict = {}
+    if shape is not None:
+        show_shape = True
+        interals = symbol.get_internals()
+        _, out_shapes, _ = interals.infer_shape(**shape)
+        if out_shapes is None:
+            raise ValueError("Input shape is incomplete")
+        shape_dict = dict(zip(interals.list_outputs(), out_shapes))
+    conf = json.loads(symbol.tojson())
+    nodes = conf["nodes"]
+    if positions[-1] <= 1:
+        positions = [int(line_length * p) for p in positions]
+    to_display = ["Layer (type)", "Output Shape", "Param #",
+                  "Previous Layer"]
+
+    def print_row(fields, positions):
+        line = ""
+        for i, field in enumerate(fields):
+            line += str(field)
+            line = line[:positions[i]]
+            line += " " * (positions[i] - len(line))
+        print(line)
+
+    print("_" * line_length)
+    print_row(to_display, positions)
+    print("=" * line_length)
+
+    total_params = [0]
+
+    def print_layer_summary(node, out_shape):
+        op = node["op"]
+        pre_node = []
+        pre_filter = 0
+        if op != "null":
+            inputs = node["inputs"]
+            for item in inputs:
+                input_node = nodes[item[0]]
+                input_name = input_node["name"]
+                if input_node["op"] != "null" or item[0] in heads:
+                    pre_node.append(input_name)
+                    if show_shape:
+                        key = input_name + "_output" \
+                            if input_node["op"] != "null" else input_name
+                        if key in shape_dict:
+                            pre_filter = pre_filter + int(
+                                shape_dict[key][1]
+                                if len(shape_dict[key]) > 1 else 0)
+        cur_param = 0
+        attrs = node.get("attrs", {})
+        if op == "Convolution":
+            num_filter = int(attrs["num_filter"])
+            import ast
+            kernel = ast.literal_eval(attrs["kernel"])
+            num_group = int(attrs.get("num_group", "1"))
+            cur_param = pre_filter * num_filter // num_group
+            for k in kernel:
+                cur_param *= k
+            cur_param += num_filter
+        elif op == "FullyConnected":
+            if attrs.get("no_bias", "False") == "True":
+                cur_param = pre_filter * int(attrs["num_hidden"])
+            else:
+                cur_param = (pre_filter + 1) * int(attrs["num_hidden"])
+        elif op == "BatchNorm":
+            key = node["name"] + "_output"
+            if show_shape and key in shape_dict:
+                cur_param = int(shape_dict[key][1]) * 4
+        first_connection = "" if not pre_node else pre_node[0]
+        fields = [f"{node['name']}({op})",
+                  "x".join([str(x) for x in out_shape]),
+                  cur_param, first_connection]
+        print_row(fields, positions)
+        if len(pre_node) > 1:
+            for i in range(1, len(pre_node)):
+                fields = ["", "", "", pre_node[i]]
+                print_row(fields, positions)
+        return cur_param
+
+    heads = set(conf["arg_nodes"])
+    for i, node in enumerate(nodes):
+        out_shape = []
+        op = node["op"]
+        if op == "null" and i > 0:
+            continue
+        if op != "null" or i in heads:
+            if show_shape:
+                key = node["name"] + "_output" if op != "null" \
+                    else node["name"]
+                if key in shape_dict:
+                    out_shape = shape_dict[key][1:]
+        total_params[0] += print_layer_summary(node, out_shape)
+        if i == len(nodes) - 1:
+            print("=" * line_length)
+        else:
+            print("_" * line_length)
+    print(f"Total params: {total_params[0]}")
+    print("_" * line_length)
+
+
+def plot_network(symbol, title="plot", save_format="pdf", shape=None,
+                 node_attrs=None, hide_weights=True):
+    """Graphviz rendering. reference: visualization.py:150. Gated on the
+    graphviz package being available."""
+    try:
+        from graphviz import Digraph
+    except ImportError:
+        raise ImportError("Draw network requires graphviz library")
+    if not isinstance(symbol, Symbol):
+        raise TypeError("symbol must be a Symbol")
+    node_attrs = node_attrs or {}
+    draw_shape = False
+    shape_dict = {}
+    if shape is not None:
+        draw_shape = True
+        interals = symbol.get_internals()
+        _, out_shapes, _ = interals.infer_shape(**shape)
+        if out_shapes is None:
+            raise ValueError("Input shape is incomplete")
+        shape_dict = dict(zip(interals.list_outputs(), out_shapes))
+    conf = json.loads(symbol.tojson())
+    nodes = conf["nodes"]
+    node_attr = {"shape": "box", "fixedsize": "true", "width": "1.3",
+                 "height": "0.8034", "style": "filled"}
+    node_attr.update(node_attrs)
+    dot = Digraph(name=title, format=save_format)
+    cm = ("#8dd3c7", "#fb8072", "#ffffb3", "#bebada", "#80b1d3", "#fdb462",
+          "#b3de69", "#fccde5")
+
+    hidden_nodes = set()
+    for node in nodes:
+        op = node["op"]
+        name = node["name"]
+        attrs = {"shape": "box", "fixedsize": "false", "style": "filled"}
+        label = name
+        if op == "null":
+            if name.endswith("weight") or name.endswith("bias") or \
+                    name.endswith("gamma") or name.endswith("beta") or \
+                    name.endswith("moving_mean") or \
+                    name.endswith("moving_var"):
+                if hide_weights:
+                    hidden_nodes.add(name)
+                continue
+            attrs["fillcolor"] = cm[0]
+        elif op == "Convolution":
+            import ast
+            a = node.get("attrs", {})
+            label = "Convolution\n{kernel}/{stride}, {filter}".format(
+                kernel="x".join(map(str, ast.literal_eval(a["kernel"]))),
+                stride="x".join(map(str, ast.literal_eval(
+                    a.get("stride", "(1,1)")))),
+                filter=a["num_filter"])
+            attrs["fillcolor"] = cm[1]
+        elif op == "FullyConnected":
+            label = f"FullyConnected\n{node['attrs']['num_hidden']}"
+            attrs["fillcolor"] = cm[1]
+        elif op == "BatchNorm":
+            attrs["fillcolor"] = cm[3]
+        elif op == "Activation" or op == "LeakyReLU":
+            label = f"{op}\n{node.get('attrs', {}).get('act_type', '')}"
+            attrs["fillcolor"] = cm[2]
+        elif op == "Pooling":
+            import ast
+            a = node.get("attrs", {})
+            label = "Pooling\n{pooltype}, {kernel}/{stride}".format(
+                pooltype=a.get("pool_type", "max"),
+                kernel="x".join(map(str, ast.literal_eval(
+                    a.get("kernel", "(1,1)")))),
+                stride="x".join(map(str, ast.literal_eval(
+                    a.get("stride", "(1,1)")))))
+            attrs["fillcolor"] = cm[4]
+        elif op in ("Concat", "Flatten", "Reshape"):
+            attrs["fillcolor"] = cm[5]
+        elif op == "Softmax" or op == "SoftmaxOutput":
+            attrs["fillcolor"] = cm[6]
+        else:
+            attrs["fillcolor"] = cm[7]
+        dot.node(name=name, label=label, **attrs)
+
+    for node in nodes:
+        op = node["op"]
+        name = node["name"]
+        if op == "null":
+            continue
+        inputs = node["inputs"]
+        for item in inputs:
+            input_node = nodes[item[0]]
+            input_name = input_node["name"]
+            if input_name not in hidden_nodes:
+                attrs = {"dir": "back", "arrowtail": "open"}
+                if draw_shape:
+                    key = input_name + "_output" \
+                        if input_node["op"] != "null" else input_name
+                    if key in shape_dict:
+                        shape_ = shape_dict[key]
+                        label = "x".join([str(x) for x in shape_[1:]])
+                        attrs["label"] = label
+                dot.edge(tail_name=name, head_name=input_name, **attrs)
+    return dot

@@ -1,0 +1,130 @@
+"""Executor tests (mirrors reference tests/python/unittest/test_executor.py)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_bind_forward():
+    a = mx.sym.var("a")
+    b = mx.sym.var("b")
+    c = a + b
+    a_np = np.random.rand(4, 4).astype(np.float32)
+    b_np = np.random.rand(4, 4).astype(np.float32)
+    ex = c.bind(mx.cpu(), args={"a": mx.nd.array(a_np),
+                                "b": mx.nd.array(b_np)})
+    out = ex.forward()
+    assert_almost_equal(out[0], a_np + b_np)
+
+
+def test_bind_backward():
+    a = mx.sym.var("a")
+    b = mx.sym.var("b")
+    c = a * b
+    a_np = np.random.rand(3, 3).astype(np.float32)
+    b_np = np.random.rand(3, 3).astype(np.float32)
+    ga = mx.nd.zeros((3, 3))
+    gb = mx.nd.zeros((3, 3))
+    ex = c.bind(mx.cpu(), args={"a": mx.nd.array(a_np),
+                                "b": mx.nd.array(b_np)},
+                args_grad={"a": ga, "b": gb})
+    ex.forward(is_train=True)
+    head = np.random.rand(3, 3).astype(np.float32)
+    ex.backward([mx.nd.array(head)])
+    assert_almost_equal(ga, head * b_np, rtol=1e-5)
+    assert_almost_equal(gb, head * a_np, rtol=1e-5)
+
+
+def test_grad_req_add():
+    a = mx.sym.var("a")
+    c = a * 2
+    a_np = np.random.rand(3,).astype(np.float32)
+    ga = mx.nd.ones((3,))
+    ex = c.bind(mx.cpu(), args={"a": mx.nd.array(a_np)},
+                args_grad={"a": ga}, grad_req="add")
+    ex.forward(is_train=True)
+    ex.backward([mx.nd.ones((3,))])
+    assert_almost_equal(ga, np.ones(3) + 2)  # 1 (initial) + 2 (grad)
+
+
+def test_grad_req_null():
+    a = mx.sym.var("a")
+    b = mx.sym.var("b")
+    c = a * b
+    ex = c.bind(mx.cpu(), args={"a": mx.nd.ones((2,)),
+                                "b": mx.nd.ones((2,))},
+                args_grad={"a": mx.nd.zeros((2,))},
+                grad_req={"a": "write", "b": "null"})
+    ex.forward(is_train=True)
+    ex.backward([mx.nd.ones((2,))])
+    assert ex.grad_dict["b"] is None
+    assert_almost_equal(ex.grad_dict["a"], np.ones(2))
+
+
+def test_simple_bind():
+    net = mx.sym.FullyConnected(data=mx.sym.var("data"), num_hidden=4,
+                                name="fc")
+    ex = net.simple_bind(ctx=mx.cpu(), data=(2, 8))
+    assert ex.arg_dict["fc_weight"].shape == (4, 8)
+    assert ex.arg_dict["fc_bias"].shape == (4,)
+    ex.arg_dict["data"][:] = 1
+    ex.arg_dict["fc_weight"][:] = 1
+    ex.arg_dict["fc_bias"][:] = 0
+    out = ex.forward()
+    assert_almost_equal(out[0], np.full((2, 4), 8.0))
+
+
+def test_executor_arg_aliasing():
+    """Param mutation through the shared NDArray cell must be visible to
+    the executor (the aliasing property executor_group relies on)."""
+    net = mx.sym.FullyConnected(data=mx.sym.var("data"), num_hidden=2,
+                                name="fc", no_bias=True)
+    w = mx.nd.ones((2, 3))
+    ex = net.bind(mx.cpu(), args={"data": mx.nd.ones((1, 3)),
+                                  "fc_weight": w})
+    out1 = ex.forward()[0].asnumpy()
+    w *= 2  # in-place through the alias
+    out2 = ex.forward()[0].asnumpy()
+    assert_almost_equal(out2, out1 * 2)
+
+
+def test_loss_head_backward_no_outgrads():
+    net = mx.sym.SoftmaxOutput(mx.sym.var("data"), name="softmax")
+    data = np.random.rand(4, 5).astype(np.float32)
+    label = np.array([0, 1, 2, 3], dtype=np.float32)
+    ex = net.simple_bind(ctx=mx.cpu(), data=(4, 5))
+    ex.arg_dict["data"][:] = data
+    ex.arg_dict["softmax_label"][:] = label
+    ex.forward(is_train=True)
+    ex.backward()
+    prob = ex.outputs[0].asnumpy()
+    onehot = np.eye(5, dtype=np.float32)[label.astype(int)]
+    assert_almost_equal(ex.grad_dict["data"], prob - onehot, rtol=1e-5)
+
+
+def test_reshape_executor():
+    net = mx.sym.FullyConnected(data=mx.sym.var("data"), num_hidden=4,
+                                name="fc")
+    ex = net.simple_bind(ctx=mx.cpu(), data=(2, 8))
+    ex.arg_dict["fc_weight"][:] = 1
+    ex2 = ex.reshape(data=(5, 8))
+    assert ex2.arg_dict["data"].shape == (5, 8)
+    # params carried over (same shape -> same cells)
+    assert ex2.arg_dict["fc_weight"] is ex.arg_dict["fc_weight"]
+
+
+def test_forward_override_kwargs():
+    net = mx.sym.var("x") * 3
+    ex = net.simple_bind(ctx=mx.cpu(), grad_req="null", x=(2, 2))
+    out = ex.forward(x=mx.nd.ones((2, 2)))
+    assert_almost_equal(out[0], np.full((2, 2), 3.0))
+
+
+def test_multi_output_executor():
+    data = mx.sym.var("data")
+    parts = mx.sym.SliceChannel(data, num_outputs=3, axis=1, name="slice")
+    ex = parts.bind(mx.cpu(), args={"data": mx.nd.array(
+        np.arange(12).reshape(2, 6).astype(np.float32))})
+    outs = ex.forward()
+    assert len(outs) == 3
+    assert outs[0].shape == (2, 2)

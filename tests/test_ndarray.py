@@ -1,0 +1,174 @@
+"""NDArray tests (mirrors reference tests/python/unittest/test_ndarray.py)."""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import assert_almost_equal, same
+
+
+def test_ndarray_creation():
+    a = mx.nd.zeros((3, 4))
+    assert a.shape == (3, 4)
+    assert a.dtype == np.float32
+    assert a.asnumpy().sum() == 0
+    b = mx.nd.ones((2, 2), dtype=np.int32)
+    assert b.dtype == np.int32
+    assert b.asnumpy().sum() == 4
+    c = mx.nd.full((2, 3), 7.5)
+    assert c.asnumpy().max() == 7.5
+    d = mx.nd.array([[1, 2], [3, 4]])
+    assert same(d.asnumpy(), np.array([[1, 2], [3, 4]], dtype=np.float32))
+
+
+def test_ndarray_elementwise():
+    np.random.seed(0)
+    for _ in range(3):
+        shape = tuple(np.random.randint(1, 8, size=2))
+        a_np = np.random.rand(*shape).astype(np.float32)
+        b_np = np.random.rand(*shape).astype(np.float32) + 0.1
+        a, b = mx.nd.array(a_np), mx.nd.array(b_np)
+        assert_almost_equal(a + b, a_np + b_np)
+        assert_almost_equal(a - b, a_np - b_np)
+        assert_almost_equal(a * b, a_np * b_np)
+        assert_almost_equal(a / b, a_np / b_np, rtol=1e-5)
+        assert_almost_equal(a + 2, a_np + 2)
+        assert_almost_equal(2 - a, 2 - a_np)
+        assert_almost_equal(a ** 2, a_np ** 2, rtol=1e-5)
+        assert_almost_equal(-a, -a_np)
+
+
+def test_ndarray_inplace():
+    a = mx.nd.ones((2, 3))
+    alias = a
+    a += 1
+    assert alias.asnumpy().sum() == 12  # alias sees the mutation
+    a *= 3
+    assert_almost_equal(alias, np.full((2, 3), 6, dtype=np.float32))
+
+
+def test_ndarray_setitem():
+    a = mx.nd.zeros((3, 4))
+    a[:] = 2
+    assert a.asnumpy().sum() == 24
+    a[1] = 5
+    assert a.asnumpy()[1].sum() == 20
+    a[0:2] = 1
+    assert a.asnumpy()[0:2].sum() == 8
+    b = mx.nd.zeros((3,))
+    b[1] = 3.0
+    assert same(b.asnumpy(), np.array([0, 3, 0], dtype=np.float32))
+
+
+def test_ndarray_slicing():
+    a_np = np.arange(24).reshape(4, 6).astype(np.float32)
+    a = mx.nd.array(a_np)
+    assert same(a[1].asnumpy(), a_np[1])
+    assert same(a[1:3].asnumpy(), a_np[1:3])
+    assert same(a.T.asnumpy(), a_np.T)
+
+
+def test_ndarray_reshape():
+    a = mx.nd.array(np.arange(12).astype(np.float32))
+    b = a.reshape((3, 4))
+    assert b.shape == (3, 4)
+    c = b.reshape((-1, 2))
+    assert c.shape == (6, 2)
+    d = b.reshape((0, 2, 2))
+    assert d.shape == (3, 2, 2)
+
+
+def test_ndarray_copy():
+    a = mx.nd.array(np.random.rand(3, 3))
+    b = a.copy()
+    b += 1
+    assert not same(a.asnumpy(), b.asnumpy())
+    c = mx.nd.zeros((3, 3))
+    a.copyto(c)
+    assert same(a.asnumpy(), c.asnumpy())
+
+
+def test_ndarray_astype():
+    a = mx.nd.array([1.5, 2.5])
+    b = a.astype(np.int32)
+    assert b.dtype == np.int32
+    assert same(b.asnumpy(), np.array([1, 2], dtype=np.int32))
+
+
+def test_ndarray_saveload():
+    arrays = {"w": mx.nd.array(np.random.rand(3, 4)),
+              "b": mx.nd.array(np.random.rand(7))}
+    with tempfile.TemporaryDirectory() as d:
+        fname = os.path.join(d, "test.params")
+        mx.nd.save(fname, arrays)
+        loaded = mx.nd.load(fname)
+        assert set(loaded) == {"w", "b"}
+        for k in arrays:
+            assert_almost_equal(arrays[k], loaded[k])
+        # list form
+        mx.nd.save(fname, list(arrays.values()))
+        llist = mx.nd.load(fname)
+        assert isinstance(llist, list) and len(llist) == 2
+
+
+def test_ndarray_registry_ops():
+    a_np = np.random.rand(3, 4).astype(np.float32)
+    a = mx.nd.array(a_np)
+    assert_almost_equal(mx.nd.exp(a), np.exp(a_np), rtol=1e-5)
+    assert_almost_equal(mx.nd.sqrt(a), np.sqrt(a_np), rtol=1e-5)
+    assert_almost_equal(mx.nd.square(a), a_np ** 2, rtol=1e-5)
+    assert_almost_equal(mx.nd.sum(a), a_np.sum(), rtol=1e-5)
+    assert_almost_equal(mx.nd.sum(a, axis=1), a_np.sum(axis=1), rtol=1e-5)
+    assert_almost_equal(mx.nd.transpose(a), a_np.T)
+    assert_almost_equal(mx.nd.dot(a, mx.nd.array(a_np.T)),
+                        a_np.dot(a_np.T), rtol=1e-4)
+    assert_almost_equal(mx.nd.clip(a, a_min=0.2, a_max=0.8),
+                        np.clip(a_np, 0.2, 0.8))
+
+
+def test_ndarray_broadcast():
+    a = mx.nd.array(np.random.rand(3, 1).astype(np.float32))
+    b = mx.nd.array(np.random.rand(1, 4).astype(np.float32))
+    out = mx.nd.broadcast_add(a, b)
+    assert out.shape == (3, 4)
+    assert_almost_equal(out, a.asnumpy() + b.asnumpy())
+    c = mx.nd.broadcast_to(a, shape=(3, 5))
+    assert c.shape == (3, 5)
+
+
+def test_ndarray_concat_onehot_take():
+    a = mx.nd.array(np.arange(6).reshape(2, 3).astype(np.float32))
+    b = mx.nd.array(np.arange(6, 12).reshape(2, 3).astype(np.float32))
+    c = mx.nd.concatenate([a, b], axis=0)
+    assert c.shape == (4, 3)
+    idx = mx.nd.array([0, 2])
+    oh = mx.nd.one_hot(idx, depth=4)
+    assert same(oh.asnumpy(), np.eye(4, dtype=np.float32)[[0, 2]])
+    taken = mx.nd.take(a, mx.nd.array([1, 0]))
+    assert same(taken.asnumpy(), a.asnumpy()[[1, 0]])
+
+
+def test_ndarray_sort_topk():
+    a_np = np.random.rand(4, 5).astype(np.float32)
+    a = mx.nd.array(a_np)
+    assert_almost_equal(mx.nd.sort(a), np.sort(a_np, axis=-1))
+    top = mx.nd.topk(a, k=2, ret_typ="value")
+    expect = np.sort(a_np, axis=-1)[:, ::-1][:, :2]
+    assert_almost_equal(top, expect)
+
+
+def test_ndarray_wait_sync():
+    a = mx.nd.ones((100, 100))
+    b = a * 2
+    b.wait_to_read()
+    mx.nd.waitall()
+    assert b.asnumpy().sum() == 20000
+
+
+def test_ndarray_scalar_ops():
+    a = mx.nd.array([2.0])
+    assert float(a.asscalar()) == 2.0
+    assert bool(mx.nd.array([1.0]))
+    assert len(mx.nd.zeros((5, 2))) == 5

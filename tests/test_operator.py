@@ -1,0 +1,380 @@
+"""Operator tests (mirrors reference tests/python/unittest/test_operator.py
+— numeric forward checks + finite-difference gradient checks via the
+test_utils fixtures)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import (assert_almost_equal,
+                                  check_numeric_gradient,
+                                  check_symbolic_forward,
+                                  check_symbolic_backward)
+
+
+def test_elemwise_ops():
+    a = mx.sym.var("a")
+    b = mx.sym.var("b")
+    a_np = np.random.rand(3, 4).astype(np.float32) + 0.5
+    b_np = np.random.rand(3, 4).astype(np.float32) + 0.5
+    check_symbolic_forward(a + b, [a_np, b_np], [a_np + b_np])
+    check_symbolic_forward(a * b, [a_np, b_np], [a_np * b_np])
+    check_symbolic_forward(a / b, [a_np, b_np], [a_np / b_np])
+    g = np.ones((3, 4), dtype=np.float32)
+    check_symbolic_backward(a * b, [a_np, b_np], [g], [b_np, a_np])
+    check_symbolic_backward(a + b, [a_np, b_np], [g], [g, g])
+
+
+def test_unary_math_ops():
+    x = mx.sym.var("x")
+    x_np = np.random.rand(4, 3).astype(np.float32) * 0.8 + 0.1
+    cases = [
+        (mx.sym.exp(x), np.exp(x_np)),
+        (mx.sym.log(x), np.log(x_np)),
+        (mx.sym.sqrt(x), np.sqrt(x_np)),
+        (mx.sym.square(x), x_np ** 2),
+        (mx.sym.tanh(x), np.tanh(x_np)),
+        (mx.sym.sigmoid(x), 1 / (1 + np.exp(-x_np))),
+        (mx.sym.relu(x - 0.5), np.maximum(x_np - 0.5, 0)),
+        (mx.sym.abs(x - 0.5), np.abs(x_np - 0.5)),
+    ]
+    for sym, expect in cases:
+        check_symbolic_forward(sym, {"x": x_np}, [expect], rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_fullyconnected():
+    data = mx.sym.var("data")
+    fc = mx.sym.FullyConnected(data=data, num_hidden=4, name="fc")
+    d = np.random.rand(5, 3).astype(np.float32)
+    w = np.random.rand(4, 3).astype(np.float32)
+    b = np.random.rand(4).astype(np.float32)
+    check_symbolic_forward(fc, {"data": d, "fc_weight": w, "fc_bias": b},
+                           [d.dot(w.T) + b], rtol=1e-4)
+    check_numeric_gradient(fc, {"data": d, "fc_weight": w, "fc_bias": b},
+                           numeric_eps=1e-2, rtol=5e-2)
+
+
+def test_convolution_forward():
+    data = mx.sym.var("data")
+    conv = mx.sym.Convolution(data=data, kernel=(3, 3), num_filter=2,
+                              no_bias=True, name="conv")
+    d = np.random.rand(1, 1, 5, 5).astype(np.float32)
+    w = np.random.rand(2, 1, 3, 3).astype(np.float32)
+    # direct correlation
+    expect = np.zeros((1, 2, 3, 3), dtype=np.float32)
+    for f in range(2):
+        for i in range(3):
+            for j in range(3):
+                expect[0, f, i, j] = (d[0, 0, i:i + 3, j:j + 3] *
+                                      w[f, 0]).sum()
+    check_symbolic_forward(conv, {"data": d, "conv_weight": w}, [expect],
+                           rtol=1e-4)
+
+
+def test_convolution_grad():
+    data = mx.sym.var("data")
+    conv = mx.sym.Convolution(data=data, kernel=(2, 2), num_filter=2,
+                              stride=(1, 1), name="conv")
+    d = np.random.rand(2, 2, 4, 4).astype(np.float32)
+    w = np.random.rand(2, 2, 2, 2).astype(np.float32)
+    b = np.random.rand(2).astype(np.float32)
+    check_numeric_gradient(conv, {"data": d, "conv_weight": w,
+                                  "conv_bias": b},
+                           numeric_eps=1e-2, rtol=5e-2)
+
+
+def test_pooling():
+    data = mx.sym.var("data")
+    d = np.random.rand(1, 1, 4, 4).astype(np.float32)
+    pool = mx.sym.Pooling(data=data, kernel=(2, 2), stride=(2, 2),
+                          pool_type="max")
+    expect = d.reshape(1, 1, 2, 2, 2, 2).max(axis=(3, 5))
+    check_symbolic_forward(pool, {"data": d}, [expect])
+    avg = mx.sym.Pooling(data=data, kernel=(2, 2), stride=(2, 2),
+                         pool_type="avg")
+    expect_avg = d.reshape(1, 1, 2, 2, 2, 2).mean(axis=(3, 5))
+    check_symbolic_forward(avg, {"data": d}, [expect_avg], rtol=1e-5)
+    gpool = mx.sym.Pooling(data=data, global_pool=True, kernel=(2, 2),
+                           pool_type="avg")
+    check_symbolic_forward(gpool, {"data": d},
+                           [d.mean(axis=(2, 3), keepdims=True)], rtol=1e-5)
+
+
+def test_activation_grads():
+    data = mx.sym.var("data")
+    d = np.random.rand(3, 4).astype(np.float32) * 2 - 1
+    for act in ["relu", "sigmoid", "tanh", "softrelu"]:
+        sym = mx.sym.Activation(data=data, act_type=act)
+        check_numeric_gradient(sym, {"data": d + 2.0}, numeric_eps=1e-2,
+                               rtol=5e-2)
+
+
+def test_leaky_relu():
+    data = mx.sym.var("data")
+    d = np.array([[-1.0, 2.0], [-3.0, 0.5]], dtype=np.float32)
+    sym = mx.sym.LeakyReLU(data=data, act_type="leaky", slope=0.1)
+    expect = np.where(d > 0, d, 0.1 * d)
+    check_symbolic_forward(sym, {"data": d}, [expect])
+    elu = mx.sym.LeakyReLU(data=data, act_type="elu", slope=0.5)
+    expect_elu = np.where(d > 0, d, 0.5 * (np.exp(d) - 1))
+    check_symbolic_forward(elu, {"data": d}, [expect_elu], rtol=1e-5)
+
+
+def test_batchnorm_training_stats():
+    data = mx.sym.var("data")
+    bn = mx.sym.BatchNorm(data=data, fix_gamma=False, momentum=0.9,
+                          eps=1e-5, name="bn")
+    d = np.random.rand(8, 3, 4, 4).astype(np.float32) * 5
+    ex = bn.simple_bind(ctx=mx.cpu(), data=d.shape)
+    ex.arg_dict["data"][:] = d
+    ex.arg_dict["bn_gamma"][:] = 1
+    ex.arg_dict["bn_beta"][:] = 0
+    out = ex.forward(is_train=True)[0].asnumpy()
+    mean = d.mean(axis=(0, 2, 3))
+    var = d.var(axis=(0, 2, 3))
+    expect = (d - mean[None, :, None, None]) / \
+        np.sqrt(var[None, :, None, None] + 1e-5)
+    assert_almost_equal(out, expect, rtol=1e-3, atol=1e-4)
+    # moving stats updated: 0.9 * 0 + 0.1 * mean
+    assert_almost_equal(ex.aux_dict["bn_moving_mean"], 0.1 * mean,
+                        rtol=1e-3, atol=1e-5)
+    # inference path uses moving stats
+    ex.aux_dict["bn_moving_mean"][:] = mean
+    ex.aux_dict["bn_moving_var"][:] = var
+    out_inf = ex.forward(is_train=False)[0].asnumpy()
+    assert_almost_equal(out_inf, expect, rtol=1e-3, atol=1e-4)
+
+
+def test_dropout():
+    data = mx.sym.var("data")
+    do = mx.sym.Dropout(data=data, p=0.5, name="do")
+    d = np.ones((100, 100), dtype=np.float32)
+    ex = do.simple_bind(ctx=mx.cpu(), data=d.shape)
+    ex.arg_dict["data"][:] = d
+    out_inf = ex.forward(is_train=False)[0].asnumpy()
+    assert_almost_equal(out_inf, d)  # identity at inference
+    out_tr = ex.forward(is_train=True)[0].asnumpy()
+    frac = (out_tr == 0).mean()
+    assert 0.3 < frac < 0.7
+    # kept elements scaled by 1/keep
+    kept = out_tr[out_tr != 0]
+    assert_almost_equal(kept, np.full_like(kept, 2.0), rtol=1e-5)
+
+
+def test_softmax_output_grad():
+    data = mx.sym.var("data")
+    sm = mx.sym.SoftmaxOutput(data=data, name="softmax", grad_scale=2.0)
+    d = np.random.rand(4, 5).astype(np.float32)
+    label = np.array([1, 0, 4, 2], dtype=np.float32)
+    ex = sm.simple_bind(ctx=mx.cpu(), data=d.shape)
+    ex.arg_dict["data"][:] = d
+    ex.arg_dict["softmax_label"][:] = label
+    ex.forward(is_train=True)
+    ex.backward()
+    prob = ex.outputs[0].asnumpy()
+    onehot = np.eye(5, dtype=np.float32)[label.astype(int)]
+    assert_almost_equal(ex.grad_dict["data"], 2.0 * (prob - onehot),
+                        rtol=1e-5)
+
+
+def test_regression_outputs():
+    data = mx.sym.var("data")
+    label = mx.sym.var("label")
+    d = np.random.rand(4, 3).astype(np.float32)
+    l = np.random.rand(4, 3).astype(np.float32)
+    lin = mx.sym.LinearRegressionOutput(data=data, label=label)
+    ex = lin.bind(mx.cpu(), args={"data": mx.nd.array(d),
+                                  "label": mx.nd.array(l)},
+                  args_grad={"data": mx.nd.zeros(d.shape)},
+                  grad_req={"data": "write", "label": "null"})
+    ex.forward(is_train=True)
+    ex.backward()
+    assert_almost_equal(ex.outputs[0], d)
+    assert_almost_equal(ex.grad_dict["data"], (d - l) / 3, rtol=1e-5)
+    log = mx.sym.LogisticRegressionOutput(data=data, label=label)
+    out = log.bind(mx.cpu(), args={"data": mx.nd.array(d),
+                                   "label": mx.nd.array(l)}).forward()
+    assert_almost_equal(out[0], 1 / (1 + np.exp(-d)), rtol=1e-5)
+
+
+def test_blockgrad_makeloss():
+    data = mx.sym.var("data")
+    d = np.random.rand(3, 3).astype(np.float32)
+    bg = mx.sym.BlockGrad(data)
+    ex = bg.bind(mx.cpu(), args={"data": mx.nd.array(d)},
+                 args_grad={"data": mx.nd.ones(d.shape)})
+    ex.forward(is_train=True)
+    ex.backward([mx.nd.ones(d.shape)])
+    assert_almost_equal(ex.grad_dict["data"], np.zeros_like(d))
+    ml = mx.sym.MakeLoss(mx.sym.square(data), grad_scale=3.0)
+    ex2 = ml.bind(mx.cpu(), args={"data": mx.nd.array(d)},
+                  args_grad={"data": mx.nd.zeros(d.shape)})
+    ex2.forward(is_train=True)
+    ex2.backward()
+    assert_almost_equal(ex2.grad_dict["data"], 3.0 * 2 * d, rtol=1e-5)
+
+
+def test_concat_slicechannel():
+    a = mx.sym.var("a")
+    b = mx.sym.var("b")
+    cat = mx.sym.Concat(a, b, dim=1, name="cat")
+    a_np = np.random.rand(2, 3).astype(np.float32)
+    b_np = np.random.rand(2, 4).astype(np.float32)
+    check_symbolic_forward(cat, {"a": a_np, "b": b_np},
+                           [np.concatenate([a_np, b_np], axis=1)])
+    g = np.random.rand(2, 7).astype(np.float32)
+    check_symbolic_backward(cat, {"a": a_np, "b": b_np}, [g],
+                            {"a": g[:, :3], "b": g[:, 3:]})
+    data = mx.sym.var("data")
+    sl = mx.sym.SliceChannel(data, num_outputs=2, axis=1)
+    d = np.random.rand(2, 6).astype(np.float32)
+    check_symbolic_forward(sl, {"data": d}, [d[:, :3], d[:, 3:]])
+
+
+def test_reshape_transpose_ops():
+    data = mx.sym.var("data")
+    d = np.arange(24).reshape(2, 3, 4).astype(np.float32)
+    check_symbolic_forward(mx.sym.Reshape(data, shape=(2, 12)),
+                           {"data": d}, [d.reshape(2, 12)])
+    check_symbolic_forward(mx.sym.Reshape(data, shape=(0, -1)),
+                           {"data": d}, [d.reshape(2, 12)])
+    check_symbolic_forward(mx.sym.transpose(data, axes=(1, 0, 2)),
+                           {"data": d}, [d.transpose(1, 0, 2)])
+    check_symbolic_forward(mx.sym.Flatten(data), {"data": d},
+                           [d.reshape(2, 12)])
+    check_symbolic_forward(mx.sym.expand_dims(data, axis=1),
+                           {"data": d}, [d[:, None]])
+    check_symbolic_forward(mx.sym.slice_axis(data, axis=2, begin=1, end=3),
+                           {"data": d}, [d[:, :, 1:3]])
+
+
+def test_broadcast_reduce():
+    data = mx.sym.var("data")
+    d = np.random.rand(2, 3, 4).astype(np.float32)
+    check_symbolic_forward(mx.sym.sum(data, axis=1), {"data": d},
+                           [d.sum(axis=1)], rtol=1e-5)
+    check_symbolic_forward(mx.sym.mean(data, axis=(0, 2)), {"data": d},
+                           [d.mean(axis=(0, 2))], rtol=1e-5)
+    check_symbolic_forward(mx.sym.max(data, axis=2, keepdims=True),
+                           {"data": d}, [d.max(axis=2, keepdims=True)])
+    check_symbolic_forward(mx.sym.norm(data), {"data": d},
+                           [np.asarray(np.sqrt((d ** 2).sum()))], rtol=1e-4)
+    check_symbolic_forward(mx.sym.argmax(data, axis=1), {"data": d},
+                           [d.argmax(axis=1).astype(np.float32)])
+
+
+def test_embedding_take():
+    data = mx.sym.var("data")
+    emb = mx.sym.Embedding(data=data, input_dim=10, output_dim=4,
+                           name="emb")
+    idx = np.array([[1, 2], [3, 4]], dtype=np.float32)
+    w = np.random.rand(10, 4).astype(np.float32)
+    check_symbolic_forward(emb, {"data": idx, "emb_weight": w},
+                           [w[idx.astype(int)]])
+    arg_shapes, out_shapes, _ = emb.infer_shape(data=(2, 2))
+    assert out_shapes == [(2, 2, 4)]
+    assert dict(zip(emb.list_arguments(), arg_shapes))["emb_weight"] == \
+        (10, 4)
+
+
+def test_where_pick():
+    cond = mx.sym.var("cond")
+    x = mx.sym.var("x")
+    y = mx.sym.var("y")
+    w = mx.sym.where(cond, x, y)
+    c_np = np.array([[1, 0], [0, 1]], dtype=np.float32)
+    x_np = np.ones((2, 2), dtype=np.float32)
+    y_np = np.zeros((2, 2), dtype=np.float32)
+    check_symbolic_forward(w, {"cond": c_np, "x": x_np, "y": y_np}, [c_np])
+    data = mx.sym.var("data")
+    index = mx.sym.var("index")
+    p = mx.sym.pick(data, index, axis=1)
+    d = np.random.rand(3, 4).astype(np.float32)
+    i = np.array([0, 2, 1], dtype=np.float32)
+    check_symbolic_forward(p, {"data": d, "index": i},
+                           [d[np.arange(3), i.astype(int)]])
+
+
+def test_sequence_ops():
+    data = mx.sym.var("data")
+    d = np.random.rand(4, 2, 3).astype(np.float32)  # (T, N, C)
+    sl = mx.sym.SequenceLast(data)
+    check_symbolic_forward(sl, {"data": d}, [d[-1]])
+    sr = mx.sym.SequenceReverse(data)
+    check_symbolic_forward(sr, {"data": d}, [d[::-1]])
+    seq = mx.sym.var("sequence_length")
+    sm = mx.sym.SequenceMask(data, seq, use_sequence_length=True, value=0.0)
+    lens = np.array([2, 4], dtype=np.float32)
+    expect = d.copy()
+    expect[2:, 0] = 0
+    check_symbolic_forward(sm, {"data": d, "sequence_length": lens},
+                           [expect])
+
+
+def test_upsampling_nearest():
+    data = mx.sym.var("data")
+    up = mx.sym.UpSampling(data, scale=2, sample_type="nearest",
+                           num_args=1)
+    d = np.random.rand(1, 2, 3, 3).astype(np.float32)
+    expect = d.repeat(2, axis=2).repeat(2, axis=3)
+    check_symbolic_forward(up, {"data": d}, [expect])
+
+
+def test_swapaxis_pad():
+    data = mx.sym.var("data")
+    d = np.random.rand(2, 3, 4).astype(np.float32)
+    check_symbolic_forward(mx.sym.SwapAxis(data, dim1=0, dim2=2),
+                           {"data": d}, [d.transpose(2, 1, 0)])
+    d4 = np.random.rand(1, 1, 2, 2).astype(np.float32)
+    pad = mx.sym.Pad(mx.sym.var("x"), mode="constant",
+                     pad_width=(0, 0, 0, 0, 1, 1, 1, 1),
+                     constant_value=0.0)
+    expect = np.pad(d4, ((0, 0), (0, 0), (1, 1), (1, 1)))
+    check_symbolic_forward(pad, {"x": d4}, [expect])
+
+
+def test_l2_normalization_instancenorm():
+    data = mx.sym.var("data")
+    d = np.random.rand(2, 3, 4, 4).astype(np.float32) + 0.1
+    l2 = mx.sym.L2Normalization(data, mode="instance")
+    norm = np.sqrt((d.reshape(2, -1) ** 2).sum(axis=1) + 1e-10)
+    expect = d / norm[:, None, None, None]
+    check_symbolic_forward(l2, {"data": d}, [expect], rtol=1e-4)
+    inorm = mx.sym.InstanceNorm(mx.sym.var("data"), name="in")
+    gamma = np.ones(3, dtype=np.float32)
+    beta = np.zeros(3, dtype=np.float32)
+    mean = d.mean(axis=(2, 3), keepdims=True)
+    var = d.var(axis=(2, 3), keepdims=True)
+    expect_in = (d - mean) / np.sqrt(var + 1e-3)
+    check_symbolic_forward(inorm, {"data": d, "in_gamma": gamma,
+                                   "in_beta": beta}, [expect_in], rtol=1e-3,
+                           atol=1e-4)
+
+
+def test_optimizer_update_ops():
+    w = mx.nd.array(np.ones(4, dtype=np.float32))
+    g = mx.nd.array(np.full(4, 0.5, dtype=np.float32))
+    mx.nd.sgd_update(w, g, lr=0.1, wd=0.0)
+    assert_almost_equal(w, np.full(4, 0.95), rtol=1e-6)
+    mom = mx.nd.zeros((4,))
+    mx.nd.sgd_mom_update(w, g, mom, lr=0.1, momentum=0.9, wd=0.0)
+    assert_almost_equal(w, np.full(4, 0.90), rtol=1e-5)
+    assert_almost_equal(mom, np.full(4, -0.05), rtol=1e-5)
+
+
+def test_sampling_ops():
+    out = mx.nd.random_uniform(low=0, high=1, shape=(1000,))
+    arr = out.asnumpy()
+    assert arr.min() >= 0 and arr.max() <= 1
+    assert abs(arr.mean() - 0.5) < 0.05
+    n = mx.nd.random_normal(loc=2.0, scale=0.5, shape=(2000,)).asnumpy()
+    assert abs(n.mean() - 2.0) < 0.1
+    assert abs(n.std() - 0.5) < 0.1
+
+
+def test_smooth_l1():
+    data = mx.sym.var("data")
+    sl = mx.sym.smooth_l1(data, scalar=1.0)
+    d = np.array([-2.0, -0.5, 0.0, 0.5, 2.0], dtype=np.float32)
+    expect = np.where(np.abs(d) < 1, 0.5 * d * d, np.abs(d) - 0.5)
+    check_symbolic_forward(sl, {"data": d}, [expect])

@@ -1,0 +1,124 @@
+"""Symbol tests (mirrors reference tests/python/unittest/test_symbol.py)."""
+import json
+import os
+import tempfile
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def _mlp():
+    data = mx.sym.var("data")
+    net = mx.sym.FullyConnected(data=data, num_hidden=128, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu", name="relu1")
+    net = mx.sym.FullyConnected(data=net, num_hidden=10, name="fc2")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def test_symbol_compose():
+    data = mx.sym.var("data")
+    net1 = mx.sym.FullyConnected(data=data, name="fc1", num_hidden=10)
+    net1 = mx.sym.FullyConnected(data=net1, name="fc2", num_hidden=100)
+    assert net1.list_arguments() == ["data", "fc1_weight", "fc1_bias",
+                                     "fc2_weight", "fc2_bias"]
+    net2 = mx.sym.FullyConnected(data=mx.sym.var("data2"), name="fc3",
+                                 num_hidden=10)
+    net2 = mx.sym.Activation(net2, act_type="relu")
+    net2 = mx.sym.FullyConnected(data=net2, name="fc4", num_hidden=20)
+    composed = net2(data2=net1, name="composed")
+    multi_out = mx.sym.Group([composed, net1])
+    assert len(multi_out) == 2
+
+
+def test_symbol_internals():
+    data = mx.sym.var("data")
+    oldfc = mx.sym.FullyConnected(data=data, name="fc1", num_hidden=10)
+    net1 = mx.sym.FullyConnected(data=oldfc, name="fc2", num_hidden=100)
+    internals = net1.get_internals()
+    fc1 = internals["fc1_output"]
+    assert fc1.list_arguments() == oldfc.list_arguments()
+
+
+def test_symbol_outputs():
+    net = _mlp()
+    assert net.list_outputs() == ["softmax_output"]
+    assert "data" in net.list_arguments()
+    assert net.name == "softmax"
+
+
+def test_symbol_infer_shape():
+    net = _mlp()
+    arg_shapes, out_shapes, aux_shapes = net.infer_shape(data=(32, 100))
+    args = dict(zip(net.list_arguments(), arg_shapes))
+    assert args["fc1_weight"] == (128, 100)
+    assert args["fc1_bias"] == (128,)
+    assert args["fc2_weight"] == (10, 128)
+    assert out_shapes == [(32, 10)]
+
+
+def test_symbol_infer_shape_partial():
+    data = mx.sym.var("data")
+    prev = mx.sym.var("prev")
+    fc1 = mx.sym.FullyConnected(data=data, name="fc1", num_hidden=64)
+    fc2 = mx.sym.FullyConnected(data=prev, name="fc2", num_hidden=64)
+    out = fc1 + fc2
+    arg_shapes, out_shapes, _ = out.infer_shape_partial(data=(32, 100))
+    args = dict(zip(out.list_arguments(), arg_shapes))
+    assert args["fc1_weight"] == (64, 100)
+    # fc2 side unknown without prev shape
+    assert args["fc2_weight"] is None or args["fc2_weight"] == (64, 100)
+
+
+def test_symbol_json_roundtrip():
+    net = _mlp()
+    js = net.tojson()
+    data = json.loads(js)
+    assert "nodes" in data and "heads" in data
+    net2 = mx.sym.load_json(js)
+    assert net2.list_arguments() == net.list_arguments()
+    assert net2.list_outputs() == net.list_outputs()
+    # shapes still infer identically
+    s1 = net.infer_shape(data=(8, 50))
+    s2 = net2.infer_shape(data=(8, 50))
+    assert s1 == s2
+    with tempfile.TemporaryDirectory() as d:
+        fname = os.path.join(d, "sym.json")
+        net.save(fname)
+        net3 = mx.sym.load(fname)
+        assert net3.list_arguments() == net.list_arguments()
+
+
+def test_symbol_arithmetic():
+    a = mx.sym.var("a")
+    b = mx.sym.var("b")
+    c = a + b * 2 - 1
+    ex = c.bind(mx.cpu(), args={"a": mx.nd.ones((2, 2)),
+                                "b": mx.nd.ones((2, 2)) * 3})
+    out = ex.forward()
+    np.testing.assert_allclose(out[0].asnumpy(), np.full((2, 2), 6.0))
+
+
+def test_symbol_attr():
+    data = mx.sym.var("data", attr={"mood": "angry"})
+    op = mx.sym.Convolution(data=data, name="conv", kernel=(1, 1),
+                            num_filter=1, attr={"__mood__": "so so"})
+    assert data.attr("mood") == "angry"
+    assert op.attr("__mood__") == "so so"
+
+
+def test_symbol_grouped():
+    a = mx.sym.var("a")
+    b = mx.sym.var("b")
+    g = mx.sym.Group([a + b, a * b])
+    assert len(g.list_outputs()) == 2
+    outs = g.bind(mx.cpu(), args={"a": mx.nd.ones((2,)) * 2,
+                                  "b": mx.nd.ones((2,)) * 3}).forward()
+    np.testing.assert_allclose(outs[0].asnumpy(), [5, 5])
+    np.testing.assert_allclose(outs[1].asnumpy(), [6, 6])
+
+
+def test_symbol_zeros_ones():
+    z = mx.sym.zeros((2, 3)) + mx.sym.ones((2, 3))
+    out = z.bind(mx.cpu(), args={}).forward()
+    np.testing.assert_allclose(out[0].asnumpy(), np.ones((2, 3)))
