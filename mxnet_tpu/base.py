@@ -54,6 +54,33 @@ def str_to_attr(s):
         return s
 
 
+def merge_shape(a, b):
+    """Merge two partial shapes (None = unknown, 0 = unknown dim).
+
+    The reference's shape convention (nnvm InferShape): dims merge
+    pointwise, 0 yields to a known dim; conflicting known dims raise.
+    """
+    if a is None:
+        return tuple(b) if b is not None else None
+    if b is None:
+        return tuple(a)
+    if len(a) != len(b):
+        raise MXNetError(f"incompatible shapes {a} vs {b}")
+    out = []
+    for x, y in zip(a, b):
+        if x == 0:
+            out.append(y)
+        elif y == 0 or x == y:
+            out.append(x)
+        else:
+            raise MXNetError(f"incompatible shapes {a} vs {b}")
+    return tuple(out)
+
+
+def shape_is_known(s):
+    return s is not None and 0 not in s
+
+
 def parse_tuple(val, length=None, name="param"):
     """Coerce ints / strings / sequences into an int tuple."""
     if val is None:

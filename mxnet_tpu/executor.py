@@ -43,16 +43,22 @@ from . import random as _random
 __all__ = ["Executor"]
 
 
-def _build_graph_runner(symbol):
+def _build_graph_runner(symbol, shape_overrides=None):
     """Close the symbol graph into run(arg_vals, aux_vals, is_train, rng).
 
     Returns (runner, arg_names, aux_names, loss_mask). The runner is pure:
     dict-of-arrays in, (outputs, new_aux_dict) out — directly jittable.
+
+    ``shape_overrides`` maps id(node) -> concrete shape for init-style ops
+    whose declared shape had unknown (0) dims — e.g. RNN begin_state
+    ``sym.zeros(shape=(0, H))`` resolved to the bound batch size (the
+    reference resolves these in PlanMemory; here at runner-build time).
     """
     nodes = symbol._topo_nodes()
     node_index = {id(n): i for i, n in enumerate(nodes)}
     arg_names = symbol.list_arguments()
     aux_names = symbol.list_auxiliary_states()
+    shape_overrides = shape_overrides or {}
     loss_mask = []
     for node, _ in symbol._outputs:
         loss_mask.append(bool(not node.is_variable and
@@ -69,14 +75,17 @@ def _build_graph_runner(symbol):
                     vals[id(node)] = [arg_vals[node.name]]
                 continue
             opdef = node.opdef()
-            aux_n = len(opdef.aux_names(node.attrs))
+            attrs = node.attrs
+            if id(node) in shape_overrides:
+                attrs = {**attrs, "shape": shape_overrides[id(node)]}
+            aux_n = len(opdef.aux_names(attrs))
             in_entries = [vals[id(inp)][idx] for inp, idx in node.inputs]
             regular = in_entries[:len(in_entries) - aux_n] if aux_n \
                 else in_entries
             aux = in_entries[len(in_entries) - aux_n:] if aux_n else []
             krng = jax.random.fold_in(rng, node_index[id(node)]) \
                 if opdef.need_rng else None
-            outs, aux_out = opdef.forward(node.attrs, regular, aux,
+            outs, aux_out = opdef.forward(attrs, regular, aux,
                                           is_train, krng)
             vals[id(node)] = outs
             if aux_n and is_train:
@@ -98,13 +107,34 @@ class Executor:
         self._ctx = ctx
         self._group2ctx = group2ctx or {}
         self._monitor_callback = None
-
-        self._runner, self.arg_names, self.aux_names, self._loss_mask = \
-            _build_graph_runner(symbol)
         self.output_names = symbol.list_outputs()
 
         # ---- normalize arg arrays -------------------------------------
-        self.arg_arrays = self._normalize_args(args, self.arg_names, "args")
+        arg_names_all = symbol.list_arguments()
+        self.arg_arrays = self._normalize_args(args, arg_names_all, "args")
+
+        # resolve init-op nodes declared with unknown (0) dims — e.g. RNN
+        # begin_state zeros(shape=(0, H)) — against the bound arg shapes
+        shape_overrides = {}
+        try:
+            known = {nm: tuple(a.shape)
+                     for nm, a in zip(arg_names_all, self.arg_arrays)
+                     if a is not None}
+            needs = [n for n in symbol._topo_nodes()
+                     if not n.is_variable and not n.inputs
+                     and isinstance(n.attrs.get("shape"), tuple)
+                     and 0 in n.attrs["shape"]]
+            if needs:
+                entry_shapes = symbol._infer_entry_shapes(known)
+                for n in needs:
+                    s = entry_shapes[id(n)][0]
+                    if s is not None and 0 not in s:
+                        shape_overrides[id(n)] = tuple(s)
+        except MXNetError:
+            pass
+
+        self._runner, self.arg_names, self.aux_names, self._loss_mask = \
+            _build_graph_runner(symbol, shape_overrides)
         self.aux_arrays = self._normalize_args(aux_states, self.aux_names,
                                                "aux_states", allow_none=True)
         self.grad_req = self._normalize_req(grad_req)

@@ -138,7 +138,7 @@ class DataParallelExecutorGroup:
         for name, shape in zip(self.arg_names, arg_shapes):
             kind = "data" if (name in self.data_names or
                               name in self.label_names) else "param"
-            if name in shared_params:
+            if name in shared_params and kind == "param":
                 args[name] = shared_params[name]  # shared NDArray cell
             else:
                 dtype = arg_types.get(name, np.float32)

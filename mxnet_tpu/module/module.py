@@ -144,8 +144,12 @@ class Module(BaseModule):
     @property
     def output_shapes(self):
         assert self.binded
-        outs = self._exec_group.executor.outputs
-        return list(zip(self._output_names, [o.shape for o in outs]))
+        shapes = {d.name: d.shape for d in self._exec_group.data_shapes}
+        if self._exec_group.label_shapes:
+            shapes.update({l.name: l.shape
+                           for l in self._exec_group.label_shapes})
+        _, out_shapes, _ = self._symbol.infer_shape(**shapes)
+        return list(zip(self._output_names, out_shapes))
 
     # -------------------------------------------------------------- params
     def get_params(self):

@@ -4,3 +4,4 @@ from . import tensor  # noqa: F401 — registers tensor ops
 from . import nn  # noqa: F401 — registers layer ops
 from . import loss  # noqa: F401 — registers loss heads
 from . import optimizer_op  # noqa: F401 — registers fused updates
+from . import rnn_op  # noqa: F401 — registers the fused RNN

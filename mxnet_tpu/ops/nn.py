@@ -23,7 +23,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ..base import parse_tuple, parse_bool, parse_int, parse_float
+from ..base import (parse_tuple, parse_bool, parse_int, parse_float,
+                    merge_shape, shape_is_known)
 from .registry import register, alias
 
 
@@ -45,16 +46,25 @@ def _fc_inputs(attrs):
     return ["data", "weight", "bias"]
 
 
-def _fc_infer(attrs, in_shapes):
+def _fc_infer(attrs, in_shapes, out_known=None):
     num_hidden = parse_int(attrs["num_hidden"])
     no_bias = parse_bool(attrs.get("no_bias", False))
     data_s = in_shapes[0]
-    out_s = None
+    out_s = (0, num_hidden)
     w_s = in_shapes[1] if len(in_shapes) > 1 else None
+    if out_known and out_known[0] is not None:
+        out_s = merge_shape(out_s, out_known[0])
     if data_s is not None:
-        in_dim = int(np.prod(data_s[1:], dtype=np.int64))
-        w_s = (num_hidden, in_dim)
-        out_s = (data_s[0], num_hidden)
+        if all(d > 0 for d in data_s[1:]):
+            in_dim = int(np.prod(data_s[1:], dtype=np.int64))
+            w_s = merge_shape(w_s, (num_hidden, in_dim))
+        out_s = merge_shape(out_s, (data_s[0], num_hidden))
+        # back-fill batch dim from a known output (bidirectional pass)
+        data_s = merge_shape(data_s, (out_s[0],) + tuple(data_s[1:]))
+    elif out_s is not None and w_s is not None and shape_is_known(w_s):
+        # fully-unknown data: batch from output, feature dim from weight
+        # (valid when data is 2-d, the dominant case for h2h matmuls)
+        data_s = (out_s[0], w_s[1])
     new_in = [data_s, w_s] + ([] if no_bias else [(num_hidden,)])
     return new_in, [out_s], []
 
@@ -277,7 +287,10 @@ alias("Pooling_v1", "Pooling")
 # --------------------------------------------------------------------------
 # Activation family (reference: activation-inl.h, leaky_relu-inl.h)
 # --------------------------------------------------------------------------
-_ID_INFER = lambda attrs, s: (s, [s[0]], [])
+def _ID_INFER(attrs, in_shapes, out_known=None):
+    merged = merge_shape(in_shapes[0],
+                         out_known[0] if out_known else None)
+    return [merged] + list(in_shapes[1:]), [merged], []
 
 
 @register("Activation", inputs=("data",), attr_spec={"act_type": (None, "relu")},

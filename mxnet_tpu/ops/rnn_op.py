@@ -1,0 +1,243 @@
+"""Fused RNN op.
+
+The reference's RNN op is cuDNN-only (reference: src/operator/rnn-inl.h:103-120
+CPU stubs "TODO", cudnn_rnn-inl.h is the real impl). TPU-native: the fused
+multi-layer (bi)directional RNN is a ``lax.scan`` over time per layer —
+XLA pipelines the gate matmuls onto the MXU and the scan keeps compile time
+O(1) in sequence length (vs the unrolled cell library which specializes per
+length).
+
+Packed parameter layout matches rnn/rnn_cell.py FusedRNNCell._slice_weights
+(itself following the reference's packed blob contract, rnn-inl.h:30-67):
+for each layer then direction: all i2h gate weights, then all h2h gate
+weights; after all weights, biases in the same order. Gate order: LSTM
+i,f,c,o; GRU r,z,o (identical to the unfused cells, so pack/unpack
+checkpoints interoperate).
+
+Inputs: data (T, N, C), parameters (flat), state (L*D, N, H)
+[, state_cell (L*D, N, H) for lstm]. Outputs: output (T, N, D*H)
+[, state_out, state_cell_out when state_outputs=True].
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import parse_bool, parse_int, parse_float
+from .registry import register
+
+_GATES = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}
+
+
+def _rnn_inputs(attrs):
+    names = ["data", "parameters", "state"]
+    if attrs.get("mode", "lstm") == "lstm":
+        names.append("state_cell")
+    return names
+
+
+def _rnn_num_outputs(attrs):
+    n = 1
+    if parse_bool(attrs.get("state_outputs", False)):
+        n += 1
+        if attrs.get("mode", "lstm") == "lstm":
+            n += 1
+    return n
+
+
+def _rnn_output_names(attrs):
+    names = ["output"]
+    if parse_bool(attrs.get("state_outputs", False)):
+        names.append("state")
+        if attrs.get("mode", "lstm") == "lstm":
+            names.append("state_cell")
+    return names
+
+
+def _param_offsets(input_size, H, L, D, m):
+    """Compute (layer, dir) -> weight/bias slice offsets in the flat blob.
+
+    Mirrors FusedRNNCell._slice_weights traversal order exactly.
+    """
+    offsets = []
+    p = 0
+    for layer in range(L):
+        for d in range(D):
+            in_dim = input_size if layer == 0 else D * H
+            wi_size = m * H * in_dim
+            wh_size = m * H * H
+            offsets.append({"wi": (p, m * H, in_dim)})
+            p += wi_size
+            offsets[-1]["wh"] = (p, m * H, H)
+            p += wh_size
+    for layer in range(L):
+        for d in range(D):
+            i = layer * D + d
+            offsets[i]["bi"] = p
+            p += m * H
+            offsets[i]["bh"] = p
+            p += m * H
+    return offsets, p
+
+
+def _cell_step(mode, H):
+    if mode == "lstm":
+        def step(carry, gates):
+            h, c = carry
+            i = jax.nn.sigmoid(gates[:, 0 * H:1 * H])
+            f = jax.nn.sigmoid(gates[:, 1 * H:2 * H])
+            g = jnp.tanh(gates[:, 2 * H:3 * H])
+            o = jax.nn.sigmoid(gates[:, 3 * H:4 * H])
+            new_c = f * c + i * g
+            new_h = o * jnp.tanh(new_c)
+            return (new_h, new_c)
+        return step
+    if mode == "gru":
+        # gru needs the split i2h/h2h (reset gate multiplies h2h term);
+        # handled in the scan body below, not here
+        return None
+    act = jnp.tanh if mode == "rnn_tanh" else (lambda x: jnp.maximum(x, 0))
+
+    def step(carry, gates):
+        (h,) = carry
+        return (act(gates),)
+    return step
+
+
+def _run_layer(mode, x, wi, wh, bi, bh, h0, c0, H, reverse):
+    """Scan one direction of one layer. x (T, N, in), returns (T, N, H)."""
+    # hoist the input projection out of the scan: one big MXU matmul
+    xw = jnp.einsum("tni,gi->tng", x, wi) + bi  # (T, N, m*H)
+    if reverse:
+        xw = jnp.flip(xw, axis=0)
+
+    if mode == "gru":
+        def body(carry, xg):
+            (h,) = carry
+            hg = jnp.dot(h, wh.T) + bh
+            r = jax.nn.sigmoid(xg[:, 0 * H:1 * H] + hg[:, 0 * H:1 * H])
+            z = jax.nn.sigmoid(xg[:, 1 * H:2 * H] + hg[:, 1 * H:2 * H])
+            n = jnp.tanh(xg[:, 2 * H:3 * H] + r * hg[:, 2 * H:3 * H])
+            new_h = h + z * (n - h)
+            return ((new_h,), new_h)
+        (hT,), out = lax.scan(body, (h0,), xw)
+        cT = None
+    elif mode == "lstm":
+        step = _cell_step(mode, H)
+
+        def body(carry, xg):
+            h, c = carry
+            gates = xg + jnp.dot(h, wh.T) + bh
+            new_h, new_c = step((h, c), gates)
+            return ((new_h, new_c), new_h)
+        (hT, cT), out = lax.scan(body, (h0, c0), xw)
+    else:
+        step = _cell_step(mode, H)
+
+        def body(carry, xg):
+            (h,) = carry
+            gates = xg + jnp.dot(h, wh.T) + bh
+            (new_h,) = step((h,), gates)
+            return ((new_h,), new_h)
+        (hT,), out = lax.scan(body, (h0,), xw)
+        cT = None
+    if reverse:
+        out = jnp.flip(out, axis=0)
+    return out, hT, cT
+
+
+def _rnn_fwd(attrs, inputs, aux, is_train, rng):
+    mode = attrs.get("mode", "lstm")
+    H = parse_int(attrs["state_size"])
+    L = parse_int(attrs["num_layers"])
+    D = 2 if parse_bool(attrs.get("bidirectional", False)) else 1
+    p_drop = parse_float(attrs.get("p", 0.0))
+    m = _GATES[mode]
+
+    data = inputs[0]
+    params = inputs[1]
+    state0 = inputs[2]
+    cell0 = inputs[3] if mode == "lstm" else None
+    T, N, input_size = data.shape
+
+    offsets, total = _param_offsets(input_size, H, L, D, m)
+
+    x = data
+    h_finals = []
+    c_finals = []
+    for layer in range(L):
+        outs = []
+        for d in range(D):
+            idx = layer * D + d
+            off = offsets[idx]
+            pwi, rows, cols = off["wi"]
+            wi = lax.dynamic_slice(params, (pwi,),
+                                   (rows * cols,)).reshape(rows, cols)
+            pwh, rows_h, cols_h = off["wh"]
+            wh = lax.dynamic_slice(params, (pwh,),
+                                   (rows_h * cols_h,)).reshape(rows_h,
+                                                               cols_h)
+            bi = lax.dynamic_slice(params, (off["bi"],), (m * H,))
+            bh = lax.dynamic_slice(params, (off["bh"],), (m * H,))
+            h0 = state0[idx]
+            c0 = cell0[idx] if cell0 is not None else None
+            out, hT, cT = _run_layer(mode, x, wi, wh, bi, bh, h0, c0, H,
+                                     reverse=(d == 1))
+            outs.append(out)
+            h_finals.append(hT)
+            if cT is not None:
+                c_finals.append(cT)
+        x = outs[0] if D == 1 else jnp.concatenate(outs, axis=-1)
+        if is_train and p_drop > 0 and layer < L - 1 and rng is not None:
+            keep = 1.0 - p_drop
+            mask = jax.random.bernoulli(
+                jax.random.fold_in(rng, layer), keep,
+                x.shape).astype(x.dtype) / keep
+            x = x * mask
+
+    outputs = [x]
+    if parse_bool(attrs.get("state_outputs", False)):
+        outputs.append(jnp.stack(h_finals, axis=0))
+        if mode == "lstm":
+            outputs.append(jnp.stack(c_finals, axis=0))
+    return outputs, []
+
+
+def _rnn_infer(attrs, in_shapes):
+    mode = attrs.get("mode", "lstm")
+    H = parse_int(attrs["state_size"])
+    L = parse_int(attrs["num_layers"])
+    D = 2 if parse_bool(attrs.get("bidirectional", False)) else 1
+    m = _GATES[mode]
+    data_s = in_shapes[0]
+    if data_s is None:
+        n_out = _rnn_num_outputs(attrs)
+        return in_shapes, [None] * n_out, []
+    T, N, input_size = data_s
+    _, total = _param_offsets(input_size, H, L, D, m)
+    state_s = (L * D, N, H)
+    new_in = [data_s, (total,), state_s]
+    if mode == "lstm":
+        new_in.append(state_s)
+    outs = [(T, N, D * H)]
+    if parse_bool(attrs.get("state_outputs", False)):
+        outs.append(state_s)
+        if mode == "lstm":
+            outs.append(state_s)
+    return new_in, outs, []
+
+
+register("RNN", inputs=_rnn_inputs, full=_rnn_fwd, need_rng=True,
+         num_outputs=_rnn_num_outputs, output_names=_rnn_output_names,
+         num_visible=_rnn_num_outputs,
+         attr_spec={"state_size": (parse_int, None),
+                    "num_layers": (parse_int, None),
+                    "mode": (None, "lstm"),
+                    "bidirectional": (parse_bool, False),
+                    "p": (parse_float, 0.0),
+                    "state_outputs": (parse_bool, False),
+                    "lstm_state_clip_min": (None, None),
+                    "lstm_state_clip_max": (None, None)},
+         infer_shape=_rnn_infer)

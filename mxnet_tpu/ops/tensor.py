@@ -19,7 +19,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ..base import parse_tuple, parse_bool, parse_int, parse_float, str_to_attr
+from ..base import (parse_tuple, parse_bool, parse_int, parse_float,
+                    str_to_attr, merge_shape)
 from .registry import register, alias
 
 # --------------------------------------------------------------------------
@@ -53,15 +54,14 @@ _REDUCE_ATTRS = {"axis": (_axis_param, None), "keepdims": (parse_bool, False),
                  "exclude": (parse_bool, False)}
 
 
-def _infer_elemwise(attrs, in_shapes):
-    """Identity-shape inference with bidirectional fill across inputs."""
-    known = None
-    for s in in_shapes:
-        if s is not None and 0 not in s:
-            known = s
-    filled = [known if (s is None or 0 in (s or (0,))) else s
-              for s in in_shapes]
-    return filled, [known], []
+def _infer_elemwise(attrs, in_shapes, out_known=None):
+    """Identity-shape inference: merge partials across inputs AND outputs
+    (bidirectional fill — the mechanism that back-propagates batch dims
+    into RNN begin_state vars)."""
+    merged = None
+    for s in list(in_shapes) + list(out_known or []):
+        merged = merge_shape(merged, s)
+    return [merged] * len(in_shapes), [merged], []
 
 
 # --------------------------------------------------------------------------

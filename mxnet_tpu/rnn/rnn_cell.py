@@ -13,6 +13,8 @@ the packed-parameter layout contract so checkpoints interoperate.
 """
 from __future__ import annotations
 
+import numpy as np
+
 from .. import symbol
 from .. import ndarray as nd
 from ..ndarray import NDArray, concatenate
@@ -76,16 +78,24 @@ class BaseRNNCell:
     def _gate_names(self):
         return ()
 
-    def begin_state(self, func=symbol.var, **kwargs):
-        """reference: rnn_cell.py begin_state."""
+    def begin_state(self, func=symbol.zeros, **kwargs):
+        """reference: rnn_cell.py:159 — default initial states are ZERO
+        symbols (not arguments) with partial shape (0, H); the unknown
+        batch dim resolves during the fixpoint InferShape pass and the
+        executor bakes the concrete shape at bind."""
         assert not self._modified, \
             "After applying modifier cells the base cell cannot be called "\
             "directly. Call the modifier cell instead."
         states = []
         for info in self.state_info:
             self._init_counter += 1
+            kw = dict(kwargs)
+            # declare the partial state shape (0 = unknown batch) so the
+            # fixpoint InferShape pass can fill it (reference convention)
+            if info and "shape" in info and "shape" not in kw:
+                kw["shape"] = info["shape"]
             state = func(name=f"{self._prefix}begin_state_"
-                         f"{self._init_counter}", **kwargs)
+                         f"{self._init_counter}", **kw)
             states.append(state)
         return states
 
@@ -330,40 +340,39 @@ class FusedRNNCell(BaseRNNCell):
     def _num_gates(self):
         return len(self._gate_names)
 
+    def _weight_layout(self, li):
+        """Traversal order of (name, shape) blocks in the packed blob.
+
+        Gate blocks are contiguous within each i2h/h2h matrix, so the blob
+        slices directly into the FUSED per-layer weights the unfused cells
+        consume (lstm_l0_i2h_weight of (m*H, in) etc.) — layout per
+        reference rnn-inl.h:30-67: all weights (layer-major, i2h then h2h),
+        then all biases in the same order.
+        """
+        lh = self._num_hidden
+        m = self._num_gates
+        b = len(self._directions)
+        blocks = []
+        for layer in range(self._num_layers):
+            for direction in self._directions:
+                in_dim = li if layer == 0 else b * lh
+                base = f"{self._prefix}{direction}{layer}"
+                blocks.append((f"{base}_i2h_weight", (m * lh, in_dim)))
+                blocks.append((f"{base}_h2h_weight", (m * lh, lh)))
+        for layer in range(self._num_layers):
+            for direction in self._directions:
+                base = f"{self._prefix}{direction}{layer}"
+                blocks.append((f"{base}_i2h_bias", (m * lh,)))
+                blocks.append((f"{base}_h2h_bias", (m * lh,)))
+        return blocks
+
     def _slice_weights(self, arr, li, lh):
-        """Slice the packed blob into per-layer gate weights/biases.
-        reference: rnn_cell.py:470-520 (layout from rnn-inl.h:30-67)."""
         args = {}
-        gate_names = self._gate_names
-        directions = self._directions
-        b = len(directions)
         p = 0
-        for layer in range(self._num_layers):
-            for direction in directions:
-                for gate in gate_names:
-                    name = f"{self._prefix}{direction}{layer}_i2h{gate}_weight"
-                    if layer > 0:
-                        size = b * lh * lh
-                        args[name] = arr[p:p + size].reshape((lh, b * lh))
-                    else:
-                        size = li * lh
-                        args[name] = arr[p:p + size].reshape((lh, li))
-                    p += size
-                for gate in gate_names:
-                    name = f"{self._prefix}{direction}{layer}_h2h{gate}_weight"
-                    size = lh ** 2
-                    args[name] = arr[p:p + size].reshape((lh, lh))
-                    p += size
-        for layer in range(self._num_layers):
-            for direction in directions:
-                for gate in gate_names:
-                    name = f"{self._prefix}{direction}{layer}_i2h{gate}_bias"
-                    args[name] = arr[p:p + lh]
-                    p += lh
-                for gate in gate_names:
-                    name = f"{self._prefix}{direction}{layer}_h2h{gate}_bias"
-                    args[name] = arr[p:p + lh]
-                    p += lh
+        for name, shape in self._weight_layout(li):
+            size = int(np.prod(shape))
+            args[name] = arr[p:p + size].reshape(shape)
+            p += size
         assert p == arr.size, "Invalid parameters size for FusedRNNCell"
         return args
 
@@ -382,16 +391,18 @@ class FusedRNNCell(BaseRNNCell):
 
     def pack_weights(self, args):
         args = args.copy()
-        w0 = args[f"{self._prefix}l0_i2h{self._gate_names[0]}_weight"]
+        first_dir = self._directions[0]
+        w0 = args[f"{self._prefix}{first_dir}0_i2h_weight"]
         num_input = w0.shape[1]
-        total = self._num_params(num_input)
-        arr = nd.zeros((total,), ctx=w0.context, dtype=w0.dtype)
-        nargs = self._slice_weights(arr, num_input, self._num_hidden)
-        for name, nd_arr in nargs.items():
+        pieces = []
+        for name, shape in self._weight_layout(num_input):
             x = args.pop(name)
-            nd_arr._set(x.asjax().reshape(-1) if isinstance(x, NDArray)
-                        else x.reshape(-1))
-        args[self._parameter.name] = arr
+            flat = x.asjax().reshape(-1) if isinstance(x, NDArray) else \
+                np.asarray(x).reshape(-1)
+            pieces.append(flat)
+        import jax.numpy as jnp
+        args[self._parameter.name] = NDArray(jnp.concatenate(
+            [jnp.asarray(p) for p in pieces]))
         return args
 
     def _num_params(self, num_input):

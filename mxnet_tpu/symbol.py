@@ -19,7 +19,10 @@ import json
 
 import numpy as np
 
-from .base import MXNetError, attr_to_str, str_to_attr
+from .base import (MXNetError, attr_to_str, str_to_attr, merge_shape,
+                   shape_is_known)
+
+_merge_shape = merge_shape
 from .context import current_context
 from .ops.registry import OP_REGISTRY, get_op
 from . import attribute, name as _name_mod
@@ -258,6 +261,14 @@ class Symbol:
         return self._infer_shape_impl(True, *args, **kwargs)
 
     def _infer_shape_impl(self, partial, *args, **kwargs):
+        """Fixpoint shape inference with partial shapes.
+
+        A shape may contain 0 for an unknown dim (the reference's
+        convention, e.g. RNN begin_state declared (0, H)). Passes run
+        repeatedly, merging information forward and backward through
+        per-op infer functions, until nothing changes — the analog of
+        NNVM's iterative InferShape pass.
+        """
         arg_names = self.list_arguments()
         known = {}
         if args:
@@ -266,31 +277,65 @@ class Symbol:
                     known[nm] = tuple(s)
         for k, v in kwargs.items():
             known[k] = tuple(v)
+        shapes = self._infer_entry_shapes(known)
 
-        shapes = {}  # id(node) -> list of out shapes
-        for node in self._topo_nodes():
-            if node.is_variable:
-                shapes[id(node)] = [known.get(node.name)]
-                continue
-            opdef = node.opdef()
-            in_shapes = [shapes[id(inp)][idx] for inp, idx in node.inputs]
-            new_in, out_shapes, aux_shapes = _infer_node_shape(
-                opdef, node, in_shapes, partial)
-            # write back filled input shapes into their source entries
-            for (inp, idx), s in zip(node.inputs, new_in):
-                if s is not None and shapes[id(inp)][idx] is None:
-                    shapes[id(inp)][idx] = tuple(s)
-            shapes[id(node)] = [tuple(s) if s is not None else None
-                                for s in out_shapes]
+        def _final(s):
+            if s is None or 0 in s:
+                return None if not partial else s
+            return s
 
-        arg_shapes = [shapes[id(n)][0] for n in self._arg_nodes()]
-        aux_shapes = [shapes[id(n)][0] for n in self._aux_nodes()]
-        out_shapes = [shapes[id(n)][i] for n, i in self._outputs]
+        arg_shapes = [_final(shapes[id(n)][0]) for n in self._arg_nodes()]
+        aux_shapes = [_final(shapes[id(n)][0]) for n in self._aux_nodes()]
+        out_shapes = [_final(shapes[id(n)][i]) for n, i in self._outputs]
         if not partial and any(s is None for s in arg_shapes):
-            missing = [nm for nm, s in zip(arg_names, arg_shapes) if s is None]
+            missing = [nm for nm, s in zip(arg_names, arg_shapes)
+                       if s is None]
             raise MXNetError(f"cannot infer shapes for arguments {missing}; "
                              "provide more input shapes")
         return arg_shapes, out_shapes, aux_shapes
+
+    def _infer_entry_shapes(self, known):
+        """Fixpoint pass core: returns {id(node): [partial out shapes]}."""
+        nodes = self._topo_nodes()
+        shapes = {}  # id(node) -> list of partial shapes (None | tuple)
+        for node in nodes:
+            if node.is_variable:
+                seed = known.get(node.name)
+                if seed is None and "__shape__" in node._extra:
+                    hint = str_to_attr(node._extra["__shape__"])
+                    if isinstance(hint, (tuple, list)):
+                        seed = tuple(int(d) for d in hint)
+                shapes[id(node)] = [seed]
+            else:
+                n_out = node.opdef().num_outputs(node.attrs)
+                shapes[id(node)] = [None] * n_out
+
+        for _ in range(4):  # fixpoint iterations
+            changed = False
+            for node in nodes:
+                if node.is_variable:
+                    continue
+                opdef = node.opdef()
+                in_entries = [(shapes[id(inp)], idx)
+                              for inp, idx in node.inputs]
+                in_shapes = [store[idx] for store, idx in in_entries]
+                new_in, out_shapes, _aux = _infer_node_shape(
+                    opdef, node, in_shapes, True,
+                    out_known=list(shapes[id(node)]))
+                for (store, idx), s in zip(in_entries, new_in):
+                    merged = _merge_shape(store[idx], s)
+                    if merged != store[idx]:
+                        store[idx] = merged
+                        changed = True
+                store = shapes[id(node)]
+                for i, s in enumerate(out_shapes[:len(store)]):
+                    merged = _merge_shape(store[i], s)
+                    if merged != store[i]:
+                        store[i] = merged
+                        changed = True
+            if not changed:
+                break
+        return shapes
 
     def infer_type(self, *args, **kwargs):
         """Type inference: defaults to float32 propagation."""
@@ -359,20 +404,33 @@ class Symbol:
         return ex.forward(is_train=False, **kwargs)
 
 
-def _infer_node_shape(opdef, node, in_shapes, partial):
+def _infer_node_shape(opdef, node, in_shapes, partial, out_known=None):
     aux_count = len(opdef.aux_names(node.attrs))
     regular = in_shapes[:len(in_shapes) - aux_count] if aux_count else in_shapes
     if opdef.infer_shape is not None:
+        accepts_out = getattr(opdef, "_infer_accepts_out", None)
+        if accepts_out is None:
+            import inspect
+            try:
+                accepts_out = len(inspect.signature(
+                    opdef.infer_shape).parameters) >= 3
+            except (ValueError, TypeError):
+                accepts_out = False
+            opdef._infer_accepts_out = accepts_out
         try:
-            new_in, outs, auxs = opdef.infer_shape(node.attrs, regular)
-        except (TypeError, KeyError, IndexError):
+            if accepts_out:
+                new_in, outs, auxs = opdef.infer_shape(
+                    node.attrs, regular, out_known)
+            else:
+                new_in, outs, auxs = opdef.infer_shape(node.attrs, regular)
+        except (KeyError, IndexError, TypeError):
             if partial:
                 n_out = opdef.num_outputs(node.attrs)
                 return in_shapes, [None] * n_out, []
             raise
         return list(new_in) + list(auxs), outs, auxs
     # fallback: abstract evaluation requires complete input shapes
-    if any(s is None for s in in_shapes):
+    if any(not shape_is_known(s) for s in in_shapes):
         n_out = opdef.num_outputs(node.attrs)
         return in_shapes, [None] * n_out, []
     import jax

@@ -1,0 +1,187 @@
+"""Initializer, metric, attribute-scope tests (mirrors reference
+test_init.py, metric tests, test_attr.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+# ------------------------------------------------------------ initializers
+def test_basic_initializers():
+    for init, check in [
+        (mx.initializer.Zero(), lambda a: (a == 0).all()),
+        (mx.initializer.One(), lambda a: (a == 1).all()),
+        (mx.initializer.Constant(3.0), lambda a: (a == 3).all()),
+        (mx.initializer.Uniform(0.1), lambda a: (np.abs(a) <= 0.1).all()),
+        (mx.initializer.Normal(0.1), lambda a: np.abs(a).std() < 0.5),
+        (mx.initializer.Xavier(), lambda a: np.isfinite(a).all()),
+        (mx.initializer.MSRAPrelu(), lambda a: np.isfinite(a).all()),
+    ]:
+        arr = mx.nd.zeros((20, 10))
+        init("fc_weight", arr)
+        assert check(arr.asnumpy()), type(init).__name__
+
+
+def test_name_pattern_dispatch():
+    init = mx.initializer.Uniform(0.1)
+    bias = mx.nd.ones((5,))
+    init("fc_bias", bias)
+    assert (bias.asnumpy() == 0).all()
+    gamma = mx.nd.zeros((5,))
+    init("bn_gamma", gamma)
+    assert (gamma.asnumpy() == 1).all()
+    mean = mx.nd.ones((5,))
+    init("bn_moving_mean", mean)
+    assert (mean.asnumpy() == 0).all()
+    var = mx.nd.zeros((5,))
+    init("bn_moving_var", var)
+    assert (var.asnumpy() == 1).all()
+
+
+def test_orthogonal_init():
+    init = mx.initializer.Orthogonal(scale=1.0)
+    arr = mx.nd.zeros((10, 10))
+    init("q_weight", arr)
+    a = arr.asnumpy()
+    np.testing.assert_allclose(a.dot(a.T), np.eye(10), atol=1e-4)
+
+
+def test_lstm_bias_init():
+    init = mx.initializer.LSTMBias(forget_bias=1.0)
+    arr = mx.nd.ones((20,))  # 4 gates x 5 hidden
+    init("lstm_i2h_bias", arr)
+    a = arr.asnumpy()
+    assert (a[5:10] == 1.0).all()  # forget gate
+    assert (a[:5] == 0.0).all()
+
+
+def test_mixed_initializer():
+    # reference semantics: first matching pattern wins; name-suffix routing
+    # still applies inside each initializer (bias -> _init_bias)
+    init = mx.initializer.Mixed(
+        [".*special_weight", ".*"],
+        [mx.initializer.Constant(7), mx.initializer.Zero()])
+    w = mx.nd.zeros((3,))
+    init("fc_special_weight", w)
+    assert (w.asnumpy() == 7).all()
+    w2 = mx.nd.ones((3,))
+    init("fc_weight", w2)
+    assert (w2.asnumpy() == 0).all()
+
+
+def test_load_initializer():
+    params = {"arg:w": mx.nd.ones((2, 2)) * 5}
+    init = mx.initializer.Load({"w": mx.nd.ones((2, 2)) * 5},
+                               default_init=mx.initializer.Zero())
+    w = mx.nd.zeros((2, 2))
+    init("w", w)
+    assert (w.asnumpy() == 5).all()
+    other = mx.nd.ones((3,))
+    init("other", other)
+    assert (other.asnumpy() == 0).all()
+
+
+# ----------------------------------------------------------------- metrics
+def test_accuracy_metric():
+    m = mx.metric.Accuracy()
+    pred = mx.nd.array([[0.1, 0.9], [0.8, 0.2], [0.3, 0.7]])
+    label = mx.nd.array([1, 0, 0])
+    m.update([label], [pred])
+    assert abs(m.get()[1] - 2.0 / 3) < 1e-6
+    m.reset()
+    assert np.isnan(m.get()[1])
+
+
+def test_topk_metric():
+    m = mx.metric.TopKAccuracy(top_k=2)
+    pred = mx.nd.array([[0.1, 0.5, 0.4], [0.7, 0.2, 0.1]])
+    label = mx.nd.array([2, 1])
+    m.update([label], [pred])
+    assert abs(m.get()[1] - 1.0) < 1e-6  # both in top-2
+
+
+def test_mse_mae_rmse():
+    pred = mx.nd.array([[1.0], [2.0]])
+    label = mx.nd.array([0.0, 4.0])
+    mse = mx.metric.MSE()
+    mse.update([label], [pred])
+    assert abs(mse.get()[1] - (1 + 4) / 2) < 1e-6
+    mae = mx.metric.MAE()
+    mae.update([label], [pred])
+    assert abs(mae.get()[1] - 1.5) < 1e-6
+
+
+def test_f1_crossentropy_perplexity():
+    pred = mx.nd.array([[0.9, 0.1], [0.3, 0.7], [0.8, 0.2]])
+    label = mx.nd.array([0, 1, 1])
+    f1 = mx.metric.F1()
+    f1.update([label], [pred])
+    assert 0 < f1.get()[1] <= 1
+    ce = mx.metric.CrossEntropy()
+    ce.update([label], [pred])
+    expect = -(np.log(0.9) + np.log(0.7) + np.log(0.2)) / 3
+    assert abs(ce.get()[1] - expect) < 1e-4
+    pp = mx.metric.Perplexity(ignore_label=None)
+    pp.update([label], [pred])
+    assert pp.get()[1] > 1
+
+
+def test_custom_and_composite_metric():
+    def feval(label, pred):
+        return float(np.sum(label))
+    m = mx.metric.CustomMetric(feval, name="mysum")
+    m.update([mx.nd.array([1, 2, 3])], [mx.nd.array([0, 0, 0])])
+    assert m.get()[1] == 6.0
+    comp = mx.metric.create(["acc", "mse"])
+    assert isinstance(comp, mx.metric.CompositeEvalMetric)
+    names, _ = comp.get()
+    assert len(names) == 2
+
+
+def test_np_metric_wrapper():
+    @mx.metric.np
+    def custom_error(label, pred):
+        return 0.5
+    # decorator-less usage
+    m = mx.metric.np(lambda l, p: 1.0, name="one")
+    m.update([mx.nd.array([0])], [mx.nd.array([0])])
+    assert m.get()[1] == 1.0
+
+
+# ------------------------------------------------------------ attr scoping
+def test_attr_scope():
+    with mx.AttrScope(group="4", data="great"):
+        data = mx.sym.var("data", attr={"dtype": "data", "group": "1"})
+        gdata = mx.sym.var("data2")
+    assert gdata.attr("group") == "4"
+    assert data.attr("group") == "1"
+
+
+def test_attr_scope_nesting():
+    with mx.AttrScope(x="1"):
+        with mx.AttrScope(y="2"):
+            v = mx.sym.var("v")
+        v2 = mx.sym.var("v2")
+    assert v.attr("x") == "1" and v.attr("y") == "2"
+    assert v2.attr("x") == "1" and v2.attr("y") is None
+
+
+def test_ctx_group_attr():
+    with mx.AttrScope(ctx_group="dev1"):
+        fc = mx.sym.FullyConnected(mx.sym.var("data"), num_hidden=2,
+                                   name="fc")
+    assert fc.attr("ctx_group") == "dev1"
+    # attr survives JSON round trip
+    js = mx.sym.load_json(fc.tojson())
+    assert js.attr_dict()["fc"]["ctx_group"] == "dev1"
+
+
+def test_name_manager():
+    with mx.NameManager():
+        s1 = mx.sym.FullyConnected(mx.sym.var("d"), num_hidden=1)
+        s2 = mx.sym.FullyConnected(mx.sym.var("d"), num_hidden=1)
+    assert s1.name != s2.name
+    with mx.Prefix("pre_"):
+        s3 = mx.sym.FullyConnected(mx.sym.var("d"), num_hidden=1)
+    assert s3.name.startswith("pre_")

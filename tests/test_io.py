@@ -1,0 +1,112 @@
+"""IO tests (mirrors reference tests/python/unittest/test_io.py)."""
+import os
+import tempfile
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_ndarray_iter_basic():
+    data = np.arange(100).reshape(25, 4).astype(np.float32)
+    labels = np.arange(25).astype(np.float32)
+    it = mx.io.NDArrayIter(data, labels, batch_size=5)
+    batches = list(it)
+    assert len(batches) == 5
+    assert batches[0].data[0].shape == (5, 4)
+    assert batches[0].label[0].shape == (5,)
+    assert_almost_equal(batches[0].data[0], data[:5])
+    it.reset()
+    assert len(list(it)) == 5
+
+
+def test_ndarray_iter_pad():
+    data = np.arange(22 * 3).reshape(22, 3).astype(np.float32)
+    it = mx.io.NDArrayIter(data, np.zeros(22, dtype=np.float32),
+                           batch_size=5, last_batch_handle="pad")
+    batches = list(it)
+    assert len(batches) == 5
+    assert batches[-1].pad == 3
+    it2 = mx.io.NDArrayIter(data, np.zeros(22, dtype=np.float32),
+                            batch_size=5, last_batch_handle="discard")
+    assert len(list(it2)) == 4
+
+
+def test_ndarray_iter_dict_data():
+    it = mx.io.NDArrayIter({"a": np.ones((10, 2), dtype=np.float32),
+                            "b": np.zeros((10, 3), dtype=np.float32)},
+                           batch_size=5)
+    names = sorted(d.name for d in it.provide_data)
+    assert names == ["a", "b"]
+
+
+def test_resize_iter():
+    data = np.zeros((12, 2), dtype=np.float32)
+    base = mx.io.NDArrayIter(data, np.zeros(12, dtype=np.float32),
+                             batch_size=4)
+    r = mx.io.ResizeIter(base, 10)
+    assert len(list(r)) == 10
+
+
+def test_prefetching_iter():
+    data = np.random.rand(20, 3).astype(np.float32)
+    base = mx.io.NDArrayIter(data, np.zeros(20, dtype=np.float32),
+                             batch_size=5)
+    pf = mx.io.PrefetchingIter(base)
+    batches = list(pf)
+    assert len(batches) == 4
+    pf.reset()
+    batches2 = list(pf)
+    assert len(batches2) == 4
+    assert_almost_equal(batches[0].data[0], batches2[0].data[0])
+
+
+def test_csv_iter():
+    with tempfile.TemporaryDirectory() as d:
+        data_path = os.path.join(d, "data.csv")
+        label_path = os.path.join(d, "label.csv")
+        data = np.random.rand(30, 4).astype(np.float32)
+        labels = np.arange(30).astype(np.float32)
+        np.savetxt(data_path, data, delimiter=",")
+        np.savetxt(label_path, labels, delimiter=",")
+        it = mx.io.CSVIter(data_csv=data_path, data_shape=(4,),
+                           label_csv=label_path, batch_size=10)
+        batches = list(it)
+        assert len(batches) == 3
+        assert_almost_equal(batches[0].data[0], data[:10], rtol=1e-5)
+
+
+def test_mnist_iter():
+    """Write a tiny idx-format file pair and read it back."""
+    import struct
+    with tempfile.TemporaryDirectory() as d:
+        img_path = os.path.join(d, "images-idx3-ubyte")
+        lab_path = os.path.join(d, "labels-idx1-ubyte")
+        n = 20
+        imgs = (np.random.rand(n, 28, 28) * 255).astype(np.uint8)
+        labs = (np.arange(n) % 10).astype(np.uint8)
+        with open(img_path, "wb") as f:
+            f.write(struct.pack(">IIII", 2051, n, 28, 28))
+            f.write(imgs.tobytes())
+        with open(lab_path, "wb") as f:
+            f.write(struct.pack(">II", 2049, n))
+            f.write(labs.tobytes())
+        it = mx.io.MNISTIter(image=img_path, label=lab_path, batch_size=5,
+                             shuffle=False)
+        batch = next(iter(it))
+        assert batch.data[0].shape == (5, 1, 28, 28)
+        assert batch.data[0].asnumpy().max() <= 1.0
+        assert_almost_equal(batch.label[0],
+                            labs[:5].astype(np.float32))
+        flat_it = mx.io.MNISTIter(image=img_path, label=lab_path,
+                                  batch_size=5, flat=True, shuffle=False)
+        assert next(iter(flat_it)).data[0].shape == (5, 784)
+
+
+def test_data_desc():
+    d = mx.io.DataDesc("data", (32, 3, 224, 224))
+    assert d.name == "data"
+    assert d.shape == (32, 3, 224, 224)
+    assert mx.io.DataDesc.get_batch_axis("NCHW") == 0
+    assert mx.io.DataDesc.get_batch_axis("TNC") == 1

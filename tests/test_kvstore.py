@@ -1,0 +1,120 @@
+"""KVStore tests (mirrors reference tests/python/unittest/test_kvstore.py)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import assert_almost_equal
+
+SHAPE = (4, 4)
+KEYS = [5, 7, 11]
+
+
+def init_kv(kind="local"):
+    kv = mx.kv.create(kind)
+    kv.init(3, mx.nd.zeros(SHAPE))
+    kv.init(KEYS, [mx.nd.zeros(SHAPE)] * len(KEYS))
+    return kv
+
+
+def check_diff_to_scalar(A, x):
+    assert (A.asnumpy() == x).all(), A.asnumpy()
+
+
+def test_single_kv_pair():
+    kv = init_kv()
+    kv.push(3, mx.nd.ones(SHAPE))
+    val = mx.nd.empty(SHAPE)
+    kv.pull(3, out=val)
+    check_diff_to_scalar(val, 1)
+
+
+def test_list_kv_pair():
+    kv = init_kv()
+    kv.push(KEYS, [mx.nd.ones(SHAPE) * 4] * len(KEYS))
+    val = [mx.nd.empty(SHAPE)] * len(KEYS)
+    kv.pull(KEYS, out=val)
+    for v in val:
+        check_diff_to_scalar(v, 4)
+
+
+def test_aggregator():
+    """Push a list of per-device values -> reduced sum. reference:
+    test_kvstore.py test_aggregator (4 'devices')."""
+    kv = init_kv("device")
+    num_devs = 4
+    devs = [mx.cpu(0)] * num_devs
+    vals = [mx.nd.ones(SHAPE, d) for d in devs]
+    kv.push(3, vals)
+    out = [mx.nd.empty(SHAPE, d) for d in devs]
+    kv.pull(3, out=out)
+    for v in out:
+        check_diff_to_scalar(v, num_devs)
+    # list of keys, 4 devices each
+    kv.push(KEYS, [[mx.nd.ones(SHAPE) * 2.0] * num_devs] * len(KEYS))
+    outs = [[mx.nd.empty(SHAPE) for _ in range(num_devs)]
+            for _ in KEYS]
+    kv.pull(KEYS, out=outs)
+    for olist in outs:
+        for o in olist:
+            check_diff_to_scalar(o, num_devs * 2.0)
+
+
+def test_updater():
+    """reference: test_kvstore.py test_updater — custom updater does +=."""
+    kv = init_kv()
+
+    def updater(key, recv, local):
+        local += recv
+
+    kv._set_updater(updater)
+    kv.push(3, mx.nd.ones(SHAPE))
+    val = mx.nd.empty(SHAPE)
+    kv.pull(3, out=val)
+    check_diff_to_scalar(val, 1)
+    num_push = 4
+    for _ in range(num_push):
+        kv.push(3, mx.nd.ones(SHAPE))
+    kv.pull(3, out=val)
+    check_diff_to_scalar(val, 1 + num_push)
+
+
+def test_set_optimizer_semantics():
+    """The dist_sync arithmetic invariant (reference:
+    tests/nightly/dist_sync_kvstore.py:30-45): with the Test optimizer
+    (w += rescale*g), after nrepeat pushes of ones the pulled value is
+    nrepeat * rate + init."""
+    kv = mx.kv.create("local")
+    kv.init(9, mx.nd.ones(SHAPE))
+    opt = mx.optimizer.Test(rescale_grad=0.5)
+    kv.set_optimizer(opt)
+    nrepeat = 3
+    for _ in range(nrepeat):
+        kv.push(9, mx.nd.ones(SHAPE) * 2)
+    val = mx.nd.empty(SHAPE)
+    kv.pull(9, out=val)
+    check_diff_to_scalar(val, 1 + nrepeat * 0.5 * 2)
+
+
+def test_str_keys():
+    kv = mx.kv.create("local")
+    kv.init("w0", mx.nd.zeros(SHAPE))
+    kv.push("w0", mx.nd.ones(SHAPE))
+    val = mx.nd.empty(SHAPE)
+    kv.pull("w0", out=val)
+    check_diff_to_scalar(val, 1)
+
+
+def test_dist_async_unsupported():
+    import pytest
+    with pytest.raises(mx.MXNetError):
+        mx.kv.create("dist_async")
+
+
+def test_dist_sync_single_process():
+    kv = mx.kv.create("dist_sync")
+    assert kv.rank == 0
+    assert kv.num_workers == 1
+    kv.init(3, mx.nd.zeros(SHAPE))
+    kv.push(3, mx.nd.ones(SHAPE))
+    val = mx.nd.empty(SHAPE)
+    kv.pull(3, out=val)
+    check_diff_to_scalar(val, 1)

@@ -1,0 +1,215 @@
+"""Module tests (mirrors reference tests/python/unittest/test_module.py)."""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def _softmax_net(num_hidden=4, num_classes=3):
+    data = mx.sym.var("data")
+    fc = mx.sym.FullyConnected(data=data, num_hidden=num_hidden, name="fc1")
+    act = mx.sym.Activation(fc, act_type="relu")
+    fc2 = mx.sym.FullyConnected(act, num_hidden=num_classes, name="fc2")
+    return mx.sym.SoftmaxOutput(fc2, name="softmax")
+
+
+def _toy_iter(n=120, dim=6, classes=3, batch=20, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, dim).astype(np.float32)
+    w = rng.randn(dim, classes).astype(np.float32)
+    y = X.dot(w).argmax(axis=1).astype(np.float32)
+    return mx.io.NDArrayIter(X, y, batch_size=batch, shuffle=True)
+
+
+def test_module_input_names():
+    data = mx.sym.var("data")
+    out = mx.sym.FullyConnected(data, num_hidden=2, name="fc")
+    with pytest.raises(ValueError):
+        mx.mod.Module(out, data_names=["wrong_name"], label_names=[])
+
+
+def test_module_fit_and_score():
+    it = _toy_iter()
+    mod = mx.mod.Module(_softmax_net(), context=mx.cpu())
+    mod.fit(it, num_epoch=15, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.5})
+    acc = mod.score(it, "acc")[0][1]
+    assert acc > 0.9, f"accuracy {acc}"
+
+
+def test_module_predict_shapes():
+    it = _toy_iter()
+    mod = mx.mod.Module(_softmax_net(), context=mx.cpu())
+    mod.bind(it.provide_data, it.provide_label, for_training=False)
+    mod.init_params()
+    out = mod.predict(it)
+    assert out.shape == (120, 3)
+    np.testing.assert_allclose(out.asnumpy().sum(axis=1),
+                               np.ones(120), rtol=1e-4)
+
+
+def test_module_get_set_params():
+    it = _toy_iter()
+    mod = mx.mod.Module(_softmax_net(), context=mx.cpu())
+    mod.bind(it.provide_data, it.provide_label)
+    mod.init_params()
+    args, auxs = mod.get_params()
+    assert "fc1_weight" in args
+    mod2 = mx.mod.Module(_softmax_net(), context=mx.cpu())
+    mod2.bind(it.provide_data, it.provide_label)
+    mod2.set_params(args, auxs)
+    a1, _ = mod.get_params()
+    a2, _ = mod2.get_params()
+    for k in a1:
+        assert_almost_equal(a1[k], a2[k])
+
+
+def test_module_checkpoint_roundtrip():
+    it = _toy_iter()
+    mod = mx.mod.Module(_softmax_net(), context=mx.cpu())
+    mod.fit(it, num_epoch=2, optimizer_params={"learning_rate": 0.1})
+    with tempfile.TemporaryDirectory() as d:
+        prefix = os.path.join(d, "model")
+        mod.save_checkpoint(prefix, 2, save_optimizer_states=True)
+        assert os.path.exists(f"{prefix}-symbol.json")
+        assert os.path.exists(f"{prefix}-0002.params")
+        assert os.path.exists(f"{prefix}-0002.states")
+        mod2 = mx.mod.Module.load(prefix, 2)
+        mod2.bind(it.provide_data, it.provide_label, for_training=False)
+        it.reset()
+        p1 = mod.predict(it, num_batch=1).asnumpy()
+        it.reset()
+        p2 = mod2.predict(it, num_batch=1).asnumpy()
+        assert_almost_equal(p1, p2, rtol=1e-5)
+
+
+def test_module_fixed_params():
+    it = _toy_iter()
+    mod = mx.mod.Module(_softmax_net(), context=mx.cpu(),
+                        fixed_param_names=["fc1_weight"])
+    mod.bind(it.provide_data, it.provide_label)
+    mod.init_params()
+    mod.init_optimizer(optimizer_params={"learning_rate": 1.0})
+    before = mod._exec_group.executor.arg_dict["fc1_weight"].asnumpy().copy()
+    batch = next(iter(it))
+    mod.forward_backward(batch)
+    mod.update()
+    after = mod._exec_group.executor.arg_dict["fc1_weight"].asnumpy()
+    assert_almost_equal(before, after)  # frozen
+    # non-fixed params did move
+    fc2b = mod._exec_group.executor.arg_dict["fc2_weight"].asnumpy()
+    assert not np.allclose(
+        fc2b, mod._arg_params["fc2_weight"].asnumpy())
+
+
+def test_module_input_grads():
+    data = mx.sym.var("data")
+    loss = mx.sym.LinearRegressionOutput(
+        data=mx.sym.FullyConnected(data, num_hidden=1, name="fc"),
+        name="lin")
+    mod = mx.mod.Module(loss, label_names=["lin_label"], context=mx.cpu())
+    mod.bind([("data", (4, 3))], [("lin_label", (4, 1))],
+             inputs_need_grad=True)
+    mod.init_params()
+    batch = mx.io.DataBatch(data=[mx.nd.ones((4, 3))],
+                            label=[mx.nd.zeros((4, 1))])
+    mod.forward_backward(batch)
+    grads = mod.get_input_grads()
+    assert grads[0].shape == (4, 3)
+    assert np.abs(grads[0].asnumpy()).sum() > 0
+
+
+def test_bucketing_module():
+    def sym_gen(seq_len):
+        # params must be seq-length independent (shared across buckets)
+        data = mx.sym.var("data")
+        emb = mx.sym.Embedding(data, input_dim=20, output_dim=4, name="emb")
+        pooled = mx.sym.sum(emb, axis=1)
+        fc = mx.sym.FullyConnected(pooled, num_hidden=3, name="fc")
+        out = mx.sym.SoftmaxOutput(fc, name="softmax")
+        return out, ["data"], ["softmax_label"]
+
+    mod = mx.mod.BucketingModule(sym_gen, default_bucket_key=10,
+                                 context=mx.cpu())
+    mod.bind([("data", (8, 10))], [("softmax_label", (8,))])
+    mod.init_params()
+    mod.init_optimizer(optimizer_params={"learning_rate": 0.1})
+    rng = np.random.RandomState(0)
+    for key in [10, 6, 10, 6]:
+        batch = mx.io.DataBatch(
+            data=[mx.nd.array(rng.randint(0, 20, (8, key))
+                              .astype(np.float32))],
+            label=[mx.nd.array(rng.randint(0, 3, 8).astype(np.float32))],
+            bucket_key=key,
+            provide_data=[mx.io.DataDesc("data", (8, key))],
+            provide_label=[mx.io.DataDesc("softmax_label", (8,))])
+        mod.forward_backward(batch)
+        mod.update()
+    assert set(mod._buckets) == {10, 6}
+    # params shared across buckets (identity of the cells)
+    e10 = mod._buckets[10]._exec_group.executor
+    e6 = mod._buckets[6]._exec_group.executor
+    assert e10.arg_dict["fc_bias"] is e6.arg_dict["fc_bias"]
+
+
+def test_sequential_module():
+    net1 = mx.sym.FullyConnected(mx.sym.var("data"), num_hidden=4,
+                                 name="fc1")
+    net2 = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(mx.sym.var("data"), num_hidden=3, name="fc2"),
+        name="softmax")
+    mod = mx.mod.SequentialModule()
+    mod.add(mx.mod.Module(net1, label_names=[], context=mx.cpu()))
+    mod.add(mx.mod.Module(net2, context=mx.cpu()), take_labels=True,
+            auto_wiring=True)
+    it = _toy_iter(dim=6)
+    mod.bind(it.provide_data, it.provide_label)
+    mod.init_params()
+    mod.init_optimizer(optimizer_params={"learning_rate": 0.5})
+    metric = mx.metric.create("acc")
+    for _ in range(10):
+        it.reset()
+        for batch in it:
+            mod.forward_backward(batch)
+            mod.update()
+            mod.update_metric(metric, batch.label)
+    assert metric.get()[1] > 0.5
+
+
+def test_module_multi_device_matches_single():
+    """DP over 4 virtual devices must match single-device numerics
+    bit-for-bit (up to f32 reduction order): same init -> same params
+    after an epoch."""
+    def make_iter():
+        rng = np.random.RandomState(3)
+        X = rng.randn(120, 6).astype(np.float32)
+        w = rng.randn(6, 3).astype(np.float32)
+        y = X.dot(w).argmax(axis=1).astype(np.float32)
+        return mx.io.NDArrayIter(X, y, batch_size=24, shuffle=False)
+
+    args = None
+    params_out = []
+    for ctxs in [[mx.cpu(0)], [mx.cpu(i) for i in range(4)]]:
+        it = make_iter()
+        mod = mx.mod.Module(_softmax_net(), context=ctxs)
+        mod.bind(it.provide_data, it.provide_label)
+        if args is None:
+            mx.random.seed(7)
+            mod.init_params(mx.initializer.Xavier())
+            a, _ = mod.get_params()
+            args = {k: v.asnumpy() for k, v in a.items()}
+        else:
+            mod.init_params(
+                arg_params={k: mx.nd.array(v) for k, v in args.items()},
+                aux_params={})
+        mod.init_optimizer(optimizer_params={"learning_rate": 0.5})
+        for batch in it:
+            mod.forward_backward(batch)
+            mod.update()
+        p, _ = mod.get_params()
+        params_out.append(p["fc2_weight"].asnumpy())
+    assert np.abs(params_out[0] - params_out[1]).max() < 1e-4

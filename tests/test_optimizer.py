@@ -1,0 +1,135 @@
+"""Optimizer tests (mirrors reference test_optimizer.py — python reference
+implementation vs fused-op consistency)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def _run_steps(opt, w0, grads, index=0):
+    w = mx.nd.array(w0.copy())
+    state = opt.create_state(index, w)
+    for g in grads:
+        opt.update(index, w, mx.nd.array(g), state)
+    return w.asnumpy()
+
+
+def test_sgd_matches_numpy():
+    rng = np.random.RandomState(0)
+    w0 = rng.randn(10).astype(np.float32)
+    grads = [rng.randn(10).astype(np.float32) for _ in range(5)]
+    opt = mx.optimizer.SGD(learning_rate=0.1, wd=0.01, rescale_grad=0.5)
+    out = _run_steps(opt, w0, grads)
+    # numpy reference
+    w = w0.copy()
+    for g in grads:
+        gg = 0.5 * g + 0.01 * w
+        w = w - 0.1 * gg
+    assert_almost_equal(out, w, rtol=1e-5)
+
+
+def test_sgd_momentum_matches_numpy():
+    rng = np.random.RandomState(1)
+    w0 = rng.randn(8).astype(np.float32)
+    grads = [rng.randn(8).astype(np.float32) for _ in range(5)]
+    opt = mx.optimizer.SGD(learning_rate=0.2, momentum=0.9)
+    out = _run_steps(opt, w0, grads)
+    w = w0.copy()
+    mom = np.zeros_like(w)
+    for g in grads:
+        mom = 0.9 * mom - 0.2 * g
+        w = w + mom
+    assert_almost_equal(out, w, rtol=1e-5)
+
+
+def test_adam_matches_numpy():
+    rng = np.random.RandomState(2)
+    w0 = rng.randn(6).astype(np.float32)
+    grads = [rng.randn(6).astype(np.float32) for _ in range(4)]
+    opt = mx.optimizer.Adam(learning_rate=0.01)
+    out = _run_steps(opt, w0, grads)
+    w = w0.astype(np.float64).copy()
+    m = np.zeros_like(w)
+    v = np.zeros_like(w)
+    b1, b2, eps = 0.9, 0.999, 1e-8
+    for t, g in enumerate(grads, 1):
+        lr = 0.01 * np.sqrt(1 - b2 ** t) / (1 - b1 ** t)
+        m = b1 * m + (1 - b1) * g
+        v = b2 * v + (1 - b2) * g * g
+        w = w - lr * m / (np.sqrt(v) + eps)
+    assert_almost_equal(out, w.astype(np.float32), rtol=1e-4)
+
+
+def test_rmsprop_runs():
+    rng = np.random.RandomState(3)
+    w0 = rng.randn(6).astype(np.float32)
+    grads = [rng.randn(6).astype(np.float32) for _ in range(4)]
+    out = _run_steps(mx.optimizer.RMSProp(learning_rate=0.01), w0, grads)
+    assert not np.allclose(out, w0)
+    out_c = _run_steps(mx.optimizer.RMSProp(learning_rate=0.01,
+                                            centered=True), w0, grads)
+    assert not np.allclose(out_c, w0)
+
+
+def test_adagrad_adadelta_ftrl_nag():
+    rng = np.random.RandomState(4)
+    w0 = rng.randn(6).astype(np.float32)
+    grads = [rng.randn(6).astype(np.float32) for _ in range(4)]
+    for opt in [mx.optimizer.AdaGrad(learning_rate=0.1),
+                mx.optimizer.AdaDelta(),
+                mx.optimizer.Ftrl(),
+                mx.optimizer.NAG(learning_rate=0.1, momentum=0.9),
+                mx.optimizer.SGLD(learning_rate=0.01),
+                mx.optimizer.DCASGD(learning_rate=0.1, momentum=0.9)]:
+        out = _run_steps(opt, w0, grads)
+        assert out.shape == w0.shape
+        assert np.isfinite(out).all()
+        assert not np.allclose(out, w0), type(opt).__name__
+
+
+def test_clip_gradient():
+    w0 = np.zeros(3, dtype=np.float32)
+    grads = [np.array([100.0, -100.0, 0.1], dtype=np.float32)]
+    opt = mx.optimizer.SGD(learning_rate=1.0, clip_gradient=1.0)
+    out = _run_steps(opt, w0, grads)
+    assert_almost_equal(out, np.array([-1.0, 1.0, -0.1]), rtol=1e-5)
+
+
+def test_lr_scheduler():
+    sched = mx.lr_scheduler.FactorScheduler(step=10, factor=0.5)
+    opt = mx.optimizer.SGD(learning_rate=1.0, lr_scheduler=sched)
+    w = mx.nd.array(np.zeros(1, dtype=np.float32))
+    state = opt.create_state(0, w)
+    for _ in range(25):
+        opt.update(0, w, mx.nd.array(np.ones(1, dtype=np.float32)), state)
+    assert sched.base_lr < 1.0
+    multi = mx.lr_scheduler.MultiFactorScheduler(step=[5, 15], factor=0.1)
+    multi.base_lr = 1.0
+    assert multi(20) < 1.0
+
+
+def test_lr_wd_mult_from_symbol():
+    data = mx.sym.var("data")
+    w = mx.sym.var("fc_weight", lr_mult=0.0)
+    fc = mx.sym.FullyConnected(data, weight=w, num_hidden=2, name="fc")
+    out = mx.sym.SoftmaxOutput(fc, name="softmax")
+    opt = mx.optimizer.SGD(learning_rate=1.0, sym=out,
+                           param_idx2name={0: "fc_weight", 1: "fc_bias"})
+    assert opt._get_lr(0) == 0.0  # lr_mult from symbol attr kills updates
+    assert opt._get_lr(1) == 1.0
+
+
+def test_get_updater():
+    opt = mx.optimizer.SGD(learning_rate=0.1)
+    updater = mx.optimizer.get_updater(opt)
+    w = mx.nd.ones((4,))
+    g = mx.nd.ones((4,))
+    updater(0, g, w)
+    assert_almost_equal(w, np.full(4, 0.9), rtol=1e-6)
+
+
+def test_create_by_name():
+    opt = mx.optimizer.create("adam", learning_rate=0.1)
+    assert isinstance(opt, mx.optimizer.Adam)
+    opt2 = mx.optimizer.create("ccsgd", learning_rate=0.1)
+    assert isinstance(opt2, mx.optimizer.ccSGD)

@@ -1,0 +1,54 @@
+"""RecordIO tests (mirrors reference tests/python/unittest/test_recordio.py)."""
+import os
+import tempfile
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import recordio
+
+
+def test_recordio_roundtrip():
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "test.rec")
+        w = recordio.MXRecordIO(path, "w")
+        for i in range(5):
+            w.write(f"record{i}".encode() * (i + 1))
+        w.close()
+        r = recordio.MXRecordIO(path, "r")
+        for i in range(5):
+            item = r.read()
+            assert item == f"record{i}".encode() * (i + 1)
+        assert r.read() is None
+        r.reset()
+        assert r.read() == b"record0"
+
+
+def test_indexed_recordio():
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "test.rec")
+        idx_path = os.path.join(d, "test.idx")
+        w = recordio.MXIndexedRecordIO(idx_path, path, "w")
+        for i in range(5):
+            w.write_idx(i, f"record{i}".encode())
+        w.close()
+        r = recordio.MXIndexedRecordIO(idx_path, path, "r")
+        assert r.keys == list(range(5))
+        assert r.read_idx(3) == b"record3"
+        assert r.read_idx(0) == b"record0"
+
+
+def test_irheader_pack_unpack():
+    header = recordio.IRHeader(0, 5.0, 123, 0)
+    packed = recordio.pack(header, b"payload")
+    h2, payload = recordio.unpack(packed)
+    assert h2.label == 5.0
+    assert h2.id == 123
+    assert payload == b"payload"
+    # multi-label
+    header = recordio.IRHeader(0, np.array([1.0, 2.0, 3.0],
+                                           dtype=np.float32), 7, 0)
+    packed = recordio.pack(header, b"xyz")
+    h3, payload3 = recordio.unpack(packed)
+    np.testing.assert_allclose(h3.label, [1.0, 2.0, 3.0])
+    assert payload3 == b"xyz"

@@ -1,0 +1,170 @@
+"""RNN tests (mirrors reference tests/python/unittest/test_rnn.py —
+cell unroll shapes + fused/unfused equivalence)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_rnn_cell_unroll_shapes():
+    cell = mx.rnn.RNNCell(10, prefix="rnn_")
+    outputs, states = cell.unroll(3, input_prefix="rnn_")
+    outputs = mx.sym.Group(outputs)
+    assert sorted(cell.params._params.keys()) == [
+        "rnn_h2h_bias", "rnn_h2h_weight", "rnn_i2h_bias", "rnn_i2h_weight"]
+    args, outs, _ = outputs.infer_shape(rnn_t0_data=(10, 50),
+                                        rnn_t1_data=(10, 50),
+                                        rnn_t2_data=(10, 50))
+    assert outs == [(10, 10)] * 3
+
+
+def test_lstm_cell_unroll():
+    cell = mx.rnn.LSTMCell(10, prefix="lstm_")
+    outputs, states = cell.unroll(3, input_prefix="lstm_")
+    assert len(states) == 2
+    outputs = mx.sym.Group(outputs)
+    args, outs, _ = outputs.infer_shape(lstm_t0_data=(8, 20),
+                                        lstm_t1_data=(8, 20),
+                                        lstm_t2_data=(8, 20))
+    assert outs == [(8, 10)] * 3
+    named = dict(zip(outputs.list_arguments(), args))
+    assert named["lstm_i2h_weight"] == (40, 20)
+    assert named["lstm_h2h_weight"] == (40, 10)
+
+
+def test_gru_cell_unroll():
+    cell = mx.rnn.GRUCell(10, prefix="gru_")
+    outputs, _ = cell.unroll(3, input_prefix="gru_")
+    outputs = mx.sym.Group(outputs)
+    _, outs, _ = outputs.infer_shape(gru_t0_data=(4, 7),
+                                     gru_t1_data=(4, 7),
+                                     gru_t2_data=(4, 7))
+    assert outs == [(4, 10)] * 3
+
+
+def test_stacked_and_bidirectional():
+    stack = mx.rnn.SequentialRNNCell()
+    stack.add(mx.rnn.LSTMCell(8, prefix="l0_"))
+    stack.add(mx.rnn.LSTMCell(8, prefix="l1_"))
+    outputs, states = stack.unroll(2, input_prefix="s_")
+    assert len(states) == 4
+    bi = mx.rnn.BidirectionalCell(mx.rnn.LSTMCell(5, prefix="l_"),
+                                  mx.rnn.LSTMCell(5, prefix="r_"))
+    outputs, states = bi.unroll(3, input_prefix="b_")
+    out = mx.sym.Group(outputs)
+    _, outs, _ = out.infer_shape(b_t0_data=(2, 4), b_t1_data=(2, 4),
+                                 b_t2_data=(2, 4))
+    assert outs == [(2, 10)] * 3  # concat of both directions
+
+
+def test_fused_rnn_op_shapes():
+    data = mx.sym.var("data")
+    rnn = mx.sym.RNN(data=data, state_size=6, num_layers=2, mode="lstm",
+                     state_outputs=True, name="rnn")
+    args, outs, _ = rnn.infer_shape(data=(5, 3, 4))
+    named = dict(zip(rnn.list_arguments(), args))
+    assert outs[0] == (5, 3, 12) or outs[0] == (5, 3, 6)
+    # lstm: 4 gates; layer0: 4*6*(4+6+2)... exact total from pack math
+    assert named["rnn_state"] == (2, 3, 6)
+    ex = rnn.simple_bind(ctx=mx.cpu(), data=(5, 3, 4))
+    ex.arg_dict["data"][:] = np.random.rand(5, 3, 4).astype(np.float32)
+    outs = ex.forward()
+    assert outs[0].shape == (5, 3, 6)
+    assert outs[1].shape == (2, 3, 6)
+    assert outs[2].shape == (2, 3, 6)
+
+
+def test_fused_vs_unfused_lstm():
+    """Fused RNN op == unrolled LSTMCell stack on the same packed weights
+    (the reference's weight pack/unpack equivalence contract)."""
+    T, N, C, H = 4, 2, 3, 5
+    fused = mx.rnn.FusedRNNCell(H, num_layers=1, mode="lstm",
+                                prefix="lstm_")
+    # fused op graph
+    data = mx.sym.var("data")
+    rnn = mx.sym.RNN(data=data, parameters=mx.sym.var("lstm_parameters"),
+                     state=mx.sym.var("lstm_state"),
+                     state_cell=mx.sym.var("lstm_state_cell"),
+                     state_size=H, num_layers=1, mode="lstm", name="rnn")
+    ex = rnn.simple_bind(ctx=mx.cpu(), data=(T, N, C))
+    rng = np.random.RandomState(0)
+    x_np = rng.randn(T, N, C).astype(np.float32)
+    params_np = rng.randn(*ex.arg_dict["lstm_parameters"].shape) \
+        .astype(np.float32) * 0.3
+    ex.arg_dict["data"][:] = x_np
+    ex.arg_dict["lstm_parameters"][:] = params_np
+    fused_out = ex.forward()[0].asnumpy()
+
+    # unfused: unpack the same blob into cell weights, unroll
+    args = fused.unpack_weights(
+        {"lstm_parameters": mx.nd.array(params_np)})
+    cell = mx.rnn.LSTMCell(H, prefix="lstm_l0_")
+    outputs, _ = cell.unroll(
+        T, inputs=[mx.sym.var(f"t{i}") for i in range(T)])
+    group = mx.sym.Group(outputs)
+    feed = {f"t{i}": mx.nd.array(x_np[i]) for i in range(T)}
+    feed.update({k: v for k, v in args.items()})
+    feed.update({f"lstm_l0_begin_state_{i}": mx.nd.zeros((N, H))
+                 for i in range(2)})
+    ex2 = group.bind(mx.cpu(), args=feed)
+    unfused_outs = np.stack([o.asnumpy() for o in ex2.forward()])
+    assert_almost_equal(fused_out, unfused_outs, rtol=1e-4, atol=1e-5)
+
+
+def test_fused_vs_unfused_gru():
+    T, N, C, H = 3, 2, 4, 5
+    fused = mx.rnn.FusedRNNCell(H, num_layers=1, mode="gru", prefix="gru_")
+    data = mx.sym.var("data")
+    rnn = mx.sym.RNN(data=data, parameters=mx.sym.var("gru_parameters"),
+                     state=mx.sym.var("gru_state"),
+                     state_size=H, num_layers=1, mode="gru", name="rnn")
+    ex = rnn.simple_bind(ctx=mx.cpu(), data=(T, N, C))
+    rng = np.random.RandomState(1)
+    x_np = rng.randn(T, N, C).astype(np.float32)
+    params_np = rng.randn(*ex.arg_dict["gru_parameters"].shape) \
+        .astype(np.float32) * 0.3
+    ex.arg_dict["data"][:] = x_np
+    ex.arg_dict["gru_parameters"][:] = params_np
+    fused_out = ex.forward()[0].asnumpy()
+
+    args = fused.unpack_weights({"gru_parameters": mx.nd.array(params_np)})
+    cell = mx.rnn.GRUCell(H, prefix="gru_l0_")
+    outputs, _ = cell.unroll(
+        T, inputs=[mx.sym.var(f"t{i}") for i in range(T)])
+    group = mx.sym.Group(outputs)
+    feed = {f"t{i}": mx.nd.array(x_np[i]) for i in range(T)}
+    feed.update(args)
+    feed.update({"gru_l0_begin_state_0": mx.nd.zeros((N, H))})
+    ex2 = group.bind(mx.cpu(), args=feed)
+    unfused_outs = np.stack([o.asnumpy() for o in ex2.forward()])
+    assert_almost_equal(fused_out, unfused_outs, rtol=1e-4, atol=1e-5)
+
+
+def test_pack_unpack_roundtrip():
+    fused = mx.rnn.FusedRNNCell(6, num_layers=2, mode="lstm",
+                                bidirectional=True, prefix="f_")
+    total = fused._num_params(8)
+    blob = mx.nd.array(np.random.rand(total).astype(np.float32))
+    args = fused.unpack_weights({"f_parameters": blob})
+    packed = fused.pack_weights(args)
+    assert_almost_equal(packed["f_parameters"], blob)
+
+
+def test_dropout_residual_zoneout_cells():
+    base = mx.rnn.RNNCell(4, prefix="b_")
+    res = mx.rnn.ResidualCell(mx.rnn.RNNCell(4, prefix="r_"))
+    outputs, _ = res.unroll(2, inputs=[mx.sym.var("x0"), mx.sym.var("x1")])
+    out = mx.sym.Group(outputs)
+    _, outs, _ = out.infer_shape(x0=(2, 4), x1=(2, 4))
+    assert outs == [(2, 4)] * 2
+    dc = mx.rnn.DropoutCell(0.5)
+    assert dc.state_info == []
+
+
+def test_bucket_sentence_iter():
+    sentences = [[1, 2, 3], [2, 3], [1, 2, 3, 4, 5], [3, 4], [1, 2]] * 4
+    it = mx.rnn.BucketSentenceIter(sentences, batch_size=4,
+                                   buckets=[3, 6], invalid_label=0)
+    batch = next(iter(it))
+    assert batch.data[0].shape[0] == 4
+    assert batch.bucket_key in (3, 6)
