@@ -31,13 +31,18 @@ def attention(q, k, v, causal=False, scale=None):
     """Plain softmax attention. q,k,v: (B, H, T, D)."""
     if scale is None:
         scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(q.dtype)
-    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                        precision=lax.Precision.HIGHEST,
+                        preferred_element_type=jnp.float32) * scale
     if causal:
         tq, tk = logits.shape[-2], logits.shape[-1]
         mask = jnp.tril(jnp.ones((tq, tk), dtype=bool), tk - tq)
         logits = jnp.where(mask, logits, -jnp.inf)
-    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
-    return jnp.einsum("bhqk,bhkd->bhqd", probs.astype(q.dtype), v)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, v.astype(jnp.float32),
+                     precision=lax.Precision.HIGHEST,
+                     preferred_element_type=jnp.float32)
+    return out.astype(q.dtype)
 
 
 def _block_attn_update(q, k, v, m, l, acc, scale, mask=None):
@@ -46,7 +51,9 @@ def _block_attn_update(q, k, v, m, l, acc, scale, mask=None):
     q (B,H,Tq,D), k/v (B,H,Tk,D); m,l (B,H,Tq) float32 running max and
     normalizer; acc (B,H,Tq,D) float32 unnormalized accumulator.
     """
-    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                        precision=lax.Precision.HIGHEST,
+                        preferred_element_type=jnp.float32) * scale
     if mask is not None:
         logits = jnp.where(mask, logits, -jnp.inf)
     m_block = jnp.max(logits, axis=-1)
@@ -60,7 +67,8 @@ def _block_attn_update(q, k, v, m, l, acc, scale, mask=None):
     correction = jnp.where(jnp.isfinite(correction), correction, 0.0)
     l_new = l * correction + jnp.sum(p, axis=-1)
     acc_new = acc * correction[..., None] + \
-        jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+        jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32),
+                   precision=lax.Precision.HIGHEST)
     return m_new, l_new, acc_new
 
 

@@ -6,8 +6,25 @@ tests exercise real SPMD partitioning over 8 XLA CPU devices (SURVEY.md §4:
 """
 import os
 
-os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force, don't setdefault: the outer environment may carry JAX_PLATFORMS=tpu
+# (or another accelerator), and the suite's numerics are written for f32 CPU
+# execution on the virtual 8-device mesh.
+_xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        _xla_flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+# A site hook may have already registered an accelerator plugin and pinned
+# jax_platforms via jax.config.update(), which takes precedence over the
+# env var — override the config itself too.
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+assert jax.devices()[0].platform == "cpu", (
+    "test suite must run on the virtual CPU mesh, got "
+    f"{jax.devices()[0].platform}")
+assert jax.device_count() >= 8, "expected 8 virtual CPU devices"
 
 import numpy as np
 import pytest
