@@ -319,19 +319,30 @@ def concatenate(arrays, axis=0, always_copy=True):
 
 
 # ------------------------------------------------------------- save / load
-# Binary format: magic + per-array records (names + shape + dtype + raw data),
-# functionally equivalent to the reference's dmlc::Stream dict format
-# (reference: ndarray.h:178-184 Save/Load, c_api.h:272-299). Not byte-
-# compatible with 2017 MXNet files; converters can be layered if needed.
+# Byte-compatible with the reference's .params container so checkpoints are
+# interchangeable (reference: ndarray.cc:605-695 NDArray::Save/Load over
+# dmlc::Stream; c_api.h:272-299). Layout, little-endian:
+#   uint64 magic=0x112, uint64 reserved=0
+#   uint64 narr; per array:
+#     uint32 ndim, uint32[ndim] shape          (mshadow TShape::Save)
+#     [if ndim>0] int32 dev_type, int32 dev_id (Context::Save)
+#                 int32 type_flag, raw bytes   (mshadow type codes)
+#   uint64 nkeys; per key: uint64 len, bytes
 _MAGIC = 0x112
+# mshadow type flags (mshadow/base.h): kFloat32..kInt64
 _DTYPE_CODE = {np.dtype(d): i for i, d in enumerate(
-    ["float32", "float64", "float16", "uint8", "int32", "int8", "int64",
-     "bfloat16"])}
+    ["float32", "float64", "float16", "uint8", "int32", "int8", "int64"])}
 _CODE_DTYPE = {v: k for k, v in _DTYPE_CODE.items()}
 
 
 def save(fname, data):
-    """Save a list or str->NDArray dict. reference: mx.nd.save."""
+    """Save a list or str->NDArray dict. reference: mx.nd.save.
+
+    The on-disk container matches the reference's dmlc::Stream format
+    byte-for-byte for the standard dtypes, so ``prefix-XXXX.params``
+    checkpoints round-trip between the two frameworks. bfloat16 arrays are
+    widened to float32 on save (the 2017 format predates bf16).
+    """
     if isinstance(data, dict):
         names, arrays = list(data.keys()), list(data.values())
     elif isinstance(data, (list, tuple)):
@@ -341,42 +352,50 @@ def save(fname, data):
     else:
         raise TypeError("save requires dict/list/NDArray")
     with open(fname, "wb") as f:
-        f.write(struct.pack("<QQ", _MAGIC, len(arrays)))
+        f.write(struct.pack("<QQQ", _MAGIC, 0, len(arrays)))
+        for arr in arrays:
+            np_arr = arr.asnumpy() if isinstance(arr, NDArray) \
+                else np.asarray(arr)
+            dt = np.dtype(np_arr.dtype)
+            if dt not in _DTYPE_CODE:
+                np_arr = np_arr.astype(np.float32)
+                dt = np.dtype(np.float32)
+            f.write(struct.pack("<I", np_arr.ndim))
+            f.write(struct.pack(f"<{np_arr.ndim}I", *np_arr.shape))
+            f.write(struct.pack("<ii", 1, 0))  # Context: cpu(0)
+            f.write(struct.pack("<i", _DTYPE_CODE[dt]))
+            f.write(np.ascontiguousarray(np_arr).tobytes())
         f.write(struct.pack("<Q", len(names)))
         for name in names:
             b = name.encode()
             f.write(struct.pack("<Q", len(b)))
             f.write(b)
-        for arr in arrays:
-            np_arr = arr.asnumpy() if isinstance(arr, NDArray) else np.asarray(arr)
-            dt = np.dtype(np_arr.dtype)
-            if dt not in _DTYPE_CODE:
-                np_arr = np_arr.astype(np.float32)
-                dt = np.dtype(np.float32)
-            f.write(struct.pack("<II", len(np_arr.shape), _DTYPE_CODE[dt]))
-            f.write(struct.pack(f"<{len(np_arr.shape)}q", *np_arr.shape))
-            f.write(np_arr.tobytes())
 
 
 def load(fname):
-    """Load NDArrays saved by :func:`save`."""
+    """Load NDArrays saved by :func:`save` or by the reference's mx.nd.save."""
     with open(fname, "rb") as f:
-        magic, n_arr = struct.unpack("<QQ", f.read(16))
+        magic, _reserved, n_arr = struct.unpack("<QQQ", f.read(24))
         if magic != _MAGIC:
             raise MXNetError(f"invalid NDArray file {fname}")
+        arrays = []
+        for _ in range(n_arr):
+            ndim, = struct.unpack("<I", f.read(4))
+            shape = struct.unpack(f"<{ndim}I", f.read(4 * ndim))
+            if ndim == 0:  # is_none() array: shape only
+                arrays.append(array(np.zeros((0,), np.float32)))
+                continue
+            struct.unpack("<ii", f.read(8))  # Context (ignored)
+            dcode, = struct.unpack("<i", f.read(4))
+            dt = _CODE_DTYPE[dcode]
+            count = int(np.prod(shape, dtype=np.int64))
+            buf = f.read(count * dt.itemsize)
+            arrays.append(array(np.frombuffer(buf, dtype=dt).reshape(shape)))
         n_names, = struct.unpack("<Q", f.read(8))
         names = []
         for _ in range(n_names):
             ln, = struct.unpack("<Q", f.read(8))
             names.append(f.read(ln).decode())
-        arrays = []
-        for _ in range(n_arr):
-            ndim, dcode = struct.unpack("<II", f.read(8))
-            shape = struct.unpack(f"<{ndim}q", f.read(8 * ndim)) if ndim else ()
-            dt = _CODE_DTYPE[dcode]
-            count = int(np.prod(shape, dtype=np.int64)) if shape else 1
-            buf = f.read(count * dt.itemsize)
-            arrays.append(array(np.frombuffer(buf, dtype=dt).reshape(shape)))
     if names:
         return dict(zip(names, arrays))
     return arrays

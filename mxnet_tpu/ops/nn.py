@@ -268,7 +268,19 @@ def _pooling(attrs, data):
     ptype = attrs.get("pool_type", "max")
     window = (1, 1) + tuple(kernel)
     strides = (1, 1) + tuple(stride)
-    pads = ((0, 0), (0, 0)) + tuple((p, p) for p in pad)
+    # pooling_convention='full' (ceil output shape): pad extra on the high
+    # side so reduce_window's floor semantics yield the ceil-based shape
+    # that _pool_infer reports
+    extra = [0] * nd
+    if attrs.get("pooling_convention", "valid") == "full" and \
+            not parse_bool(attrs.get("global_pool", False)):
+        for i in range(nd):
+            x = data.shape[2 + i] + 2 * pad[i] - kernel[i]
+            want = int(np.ceil(x / stride[i])) + 1
+            extra[i] = max(0, (want - 1) * stride[i] + kernel[i]
+                           - (data.shape[2 + i] + 2 * pad[i]))
+    pads = ((0, 0), (0, 0)) + tuple(
+        (p, p + e) for p, e in zip(pad, extra))
     if ptype == "max":
         init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) else \
             jnp.iinfo(data.dtype).min
@@ -574,14 +586,15 @@ def _upsampling(attrs, *xs):
     scale = parse_int(attrs.get("scale", 2))
     stype = attrs.get("sample_type", "nearest")
     if stype == "nearest":
+        # every input is upsampled to the common target size (first input's
+        # spatial dims x scale), each by its own integer factor — reference
+        # upsampling_nearest semantics for multi-resolution inputs
+        th = xs[0].shape[2] * scale
+        tw = xs[0].shape[3] * scale
         outs = []
-        target = None
         for x in xs:
-            up = jnp.repeat(jnp.repeat(x, scale, axis=2), scale, axis=3) \
-                if target is None else x
-            if target is None:
-                target = up.shape[2:]
-            outs.append(up)
+            fh, fw = th // x.shape[2], tw // x.shape[3]
+            outs.append(jnp.repeat(jnp.repeat(x, fh, axis=2), fw, axis=3))
         if len(outs) == 1:
             return outs[0]
         if attrs.get("multi_input_mode", "concat") == "sum":

@@ -120,7 +120,8 @@ def _run_layer(mode, x, wi, wh, bi, bh, h0, c0, H, reverse):
             r = jax.nn.sigmoid(xg[:, 0 * H:1 * H] + hg[:, 0 * H:1 * H])
             z = jax.nn.sigmoid(xg[:, 1 * H:2 * H] + hg[:, 1 * H:2 * H])
             n = jnp.tanh(xg[:, 2 * H:3 * H] + r * hg[:, 2 * H:3 * H])
-            new_h = h + z * (n - h)
+            # cuDNN/reference convention: h' = (1-z)*n + z*h
+            new_h = n + z * (h - n)
             return ((new_h,), new_h)
         (hT,), out = lax.scan(body, (h0,), xw)
         cT = None

@@ -286,7 +286,8 @@ class GRUCell(BaseRNNCell):
                                         name=f"{name}z_act")
         next_h_tmp = symbol.Activation(i2h + reset_gate * h2h,
                                        act_type="tanh", name=f"{name}h_act")
-        next_h = prev_state_h + update_gate * (next_h_tmp - prev_state_h)
+        # cuDNN/reference convention: h' = (1-z)*n + z*h_prev
+        next_h = next_h_tmp + update_gate * (prev_state_h - next_h_tmp)
         return next_h, [next_h]
 
 
