@@ -1,94 +1,112 @@
-"""Training callbacks (reference: python/mxnet/callback.py)."""
+"""Training callbacks.
+
+API parity with reference python/mxnet/callback.py (batch-end callbacks
+receive a ``BatchEndParam``-shaped namedtuple with ``epoch``, ``nbatch``,
+``eval_metric``, ``locals``; epoch-end checkpointers receive
+``(epoch, symbol, arg_params, aux_params)``), rebuilt around a small
+formatting helper instead of the reference's per-callback string plumbing.
+"""
 from __future__ import annotations
 
 import logging
-import math
 import time
 
 __all__ = ["Speedometer", "do_checkpoint", "log_train_metric",
            "module_checkpoint", "ProgressBar"]
 
+log = logging.getLogger(__name__)
 
-def module_checkpoint(mod, prefix, period=1, save_optimizer_states=False):
-    """Checkpoint a Module each `period` epochs. reference: callback.py:20."""
-    period = int(max(1, period))
 
-    def _callback(iter_no, sym=None, arg=None, aux=None):
-        if (iter_no + 1) % period == 0:
-            mod.save_checkpoint(prefix, iter_no + 1, save_optimizer_states)
-    return _callback
+def _metric_text(eval_metric, reset=False):
+    """'name=val name2=val2' for a metric (possibly composite), or ''."""
+    if eval_metric is None:
+        return ""
+    pairs = eval_metric.get_name_value()
+    if reset:
+        eval_metric.reset()
+    return " ".join(f"{n}={v:f}" for n, v in pairs)
 
 
 def do_checkpoint(prefix, period=1):
-    """Save symbol+params each `period` epochs. reference: callback.py:39."""
-    from .model import save_checkpoint
-    period = int(max(1, period))
+    """Epoch-end callback saving symbol + params every ``period`` epochs.
 
-    def _callback(iter_no, sym, arg, aux):
-        if (iter_no + 1) % period == 0:
-            save_checkpoint(prefix, iter_no + 1, sym, arg, aux)
-    return _callback
+    reference: callback.py:39 (used as ``fit(epoch_end_callback=...)``).
+    """
+    from .model import save_checkpoint
+    period = max(1, int(period))
+
+    def _save(epoch, sym, arg_params, aux_params):
+        if (epoch + 1) % period == 0:
+            save_checkpoint(prefix, epoch + 1, sym, arg_params, aux_params)
+    return _save
+
+
+def module_checkpoint(mod, prefix, period=1, save_optimizer_states=False):
+    """Epoch-end callback delegating to ``Module.save_checkpoint`` (so
+    optimizer state rides along). reference: callback.py:20."""
+    period = max(1, int(period))
+
+    def _save(epoch, sym=None, arg_params=None, aux_params=None):
+        if (epoch + 1) % period == 0:
+            mod.save_checkpoint(prefix, epoch + 1, save_optimizer_states)
+    return _save
 
 
 def log_train_metric(period, auto_reset=False):
-    """Log metric every `period` batches. reference: callback.py:60."""
-    def _callback(param):
-        if param.nbatch % period == 0 and param.eval_metric is not None:
-            name_value = param.eval_metric.get_name_value()
-            for name, value in name_value:
-                logging.info("Iter[%d] Batch[%d] Train-%s=%f",
-                             param.epoch, param.nbatch, name, value)
-            if auto_reset:
-                param.eval_metric.reset()
-    return _callback
+    """Batch-end callback printing the running train metric every
+    ``period`` batches. reference: callback.py:60."""
+    def _log(param):
+        if param.nbatch % period == 0:
+            text = _metric_text(param.eval_metric, reset=auto_reset)
+            if text:
+                log.info("epoch %d batch %d train: %s",
+                         param.epoch, param.nbatch, text)
+    return _log
 
 
 class Speedometer:
-    """samples/sec logging. reference: callback.py:85."""
+    """Batch-end callback reporting throughput (samples/sec) and the
+    training metric every ``frequent`` batches. reference: callback.py:85.
+
+    Throughput is measured over the window since the previous report, so
+    the first report of each epoch is skipped (no window yet).
+    """
 
     def __init__(self, batch_size, frequent=50):
         self.batch_size = batch_size
         self.frequent = frequent
-        self.init = False
-        self.tic = 0
-        self.last_count = 0
+        self._window_start = None
+        self._prev_nbatch = 0
 
     def __call__(self, param):
-        count = param.nbatch
-        if self.last_count > count:
-            self.init = False
-        self.last_count = count
-        if self.init:
-            if count % self.frequent == 0:
-                speed = self.frequent * self.batch_size / \
-                    (time.time() - self.tic)
-                if param.eval_metric is not None:
-                    name_value = param.eval_metric.get_name_value()
-                    param.eval_metric.reset()
-                    for name, value in name_value:
-                        logging.info(
-                            "Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec"
-                            "\tTrain-%s=%f", param.epoch, count, speed,
-                            name, value)
-                else:
-                    logging.info("Iter[%d] Batch [%d]\tSpeed: %.2f samples/sec",
-                                 param.epoch, count, speed)
-                self.tic = time.time()
-        else:
-            self.init = True
-            self.tic = time.time()
+        if param.nbatch < self._prev_nbatch:  # new epoch: restart window
+            self._window_start = None
+        self._prev_nbatch = param.nbatch
+
+        if self._window_start is None:
+            self._window_start = time.time()
+            return
+        if param.nbatch % self.frequent != 0:
+            return
+        elapsed = time.time() - self._window_start
+        speed = self.frequent * self.batch_size / max(elapsed, 1e-12)
+        text = _metric_text(param.eval_metric, reset=True)
+        log.info("Epoch[%d] Batch[%d] speed=%.2f samples/s%s",
+                 param.epoch, param.nbatch, speed,
+                 " " + text if text else "")
+        self._window_start = time.time()
 
 
 class ProgressBar:
-    """reference: callback.py:130."""
+    """Batch-end callback drawing a text progress bar over ``total``
+    batches. reference: callback.py:130."""
 
     def __init__(self, total, length=80):
-        self.bar_len = length
         self.total = total
+        self.length = length
 
     def __call__(self, param):
-        count = param.nbatch
-        filled_len = int(round(self.bar_len * count / float(self.total)))
-        percents = math.ceil(100.0 * count / float(self.total))
-        prog_bar = "=" * filled_len + "-" * (self.bar_len - filled_len)
-        logging.info("[%s] %s%s\r", prog_bar, percents, "%")
+        frac = min(param.nbatch / float(self.total), 1.0)
+        filled = int(round(self.length * frac))
+        bar = "#" * filled + "." * (self.length - filled)
+        log.info("[%s] %d%%", bar, int(100 * frac))

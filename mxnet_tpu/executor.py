@@ -43,7 +43,7 @@ from . import random as _random
 __all__ = ["Executor"]
 
 
-def _build_graph_runner(symbol, shape_overrides=None):
+def _build_graph_runner(symbol, shape_overrides=None, tap=None):
     """Close the symbol graph into run(arg_vals, aux_vals, is_train, rng).
 
     Returns (runner, arg_names, aux_names, loss_mask). The runner is pure:
@@ -53,6 +53,11 @@ def _build_graph_runner(symbol, shape_overrides=None):
     whose declared shape had unknown (0) dims — e.g. RNN begin_state
     ``sym.zeros(shape=(0, H))`` resolved to the bound batch size (the
     reference resolves these in PlanMemory; here at runner-build time).
+
+    ``tap(node, outputs)`` — optional per-op observation hook called after
+    every non-variable node (the analog of the reference's per-op monitor
+    callback, graph_executor.cc:758-778). Only meaningful when the runner
+    executes un-jitted (eager per-op dispatch).
     """
     nodes = symbol._topo_nodes()
     node_index = {id(n): i for i, n in enumerate(nodes)}
@@ -88,6 +93,8 @@ def _build_graph_runner(symbol, shape_overrides=None):
             outs, aux_out = opdef.forward(attrs, regular, aux,
                                           is_train, krng)
             vals[id(node)] = outs
+            if tap is not None:
+                tap(node, outs)
             if aux_n and is_train:
                 for (inp, _), new_val in zip(
                         node.inputs[len(node.inputs) - aux_n:], aux_out):
@@ -133,6 +140,7 @@ class Executor:
         except MXNetError:
             pass
 
+        self._shape_overrides = shape_overrides
         self._runner, self.arg_names, self.aux_names, self._loss_mask = \
             _build_graph_runner(symbol, shape_overrides)
         self.aux_arrays = self._normalize_args(aux_states, self.aux_names,
@@ -277,11 +285,34 @@ class Executor:
         if self._outputs is not None or self._pending is None:
             return
         kind, rng = self._pending
+        if self._monitor_callback is not None:
+            # monitored execution: walk the graph eagerly (un-jitted) and
+            # tap every op's outputs — full parity with the reference's
+            # ExecuteMonCallback granularity (graph_executor.cc:758-778),
+            # at interpreter speed (it's a debug mode there too: bulk exec
+            # must be off for per-op stats, env_var.md:71)
+            cb = self._monitor_callback
+
+            def tap(node, outs):
+                out_names = node.output_names() if hasattr(
+                    node, "output_names") else None
+                for i, o in enumerate(outs):
+                    nm = out_names[i] if out_names and i < len(out_names) \
+                        else (f"{node.name}_output" if len(outs) == 1
+                              else f"{node.name}_output{i}")
+                    cb(nm, NDArray(o, ctx=self._ctx))
+
+            runner, *_ = _build_graph_runner(self._symbol,
+                                             self._shape_overrides, tap=tap)
+            outs, new_aux = runner(self._arg_vals(), self._aux_vals(),
+                                   kind == "fwd_train", rng)
+            self._finish(outs, new_aux, monitored=True)
+            return
         prog = self._get_program(kind)
         outs, new_aux = prog(self._arg_vals(), self._aux_vals(), rng)
         self._finish(outs, new_aux)
 
-    def _finish(self, outs, new_aux, grads=None):
+    def _finish(self, outs, new_aux, grads=None, monitored=False):
         self._outputs = [NDArray(o, ctx=self._ctx) for o in outs]
         if new_aux:
             aux_d = self.aux_dict
@@ -298,7 +329,7 @@ class Executor:
                     dst._set(g.astype(dst.dtype))
                 elif req == "add":
                     dst._set(dst.asjax() + g.astype(dst.dtype))
-        if self._monitor_callback is not None:
+        if self._monitor_callback is not None and not monitored:
             for nm, arr in zip(self.output_names, self._outputs):
                 self._monitor_callback(nm, arr)
 

@@ -1,74 +1,137 @@
-"""Learning-rate schedulers (reference: python/mxnet/lr_scheduler.py)."""
+"""Learning-rate schedules.
+
+Behavioral parity with the reference scheduler API (python/mxnet/
+lr_scheduler.py: ``__call__(num_update) -> lr``), re-designed stateless:
+each schedule is a closed-form function of the global update count rather
+than a stateful while-loop, so the same object gives the same answer for
+any query order — which also makes schedules safe to evaluate inside a
+jitted train step if lowered as a traced scalar.
+"""
 from __future__ import annotations
 
+import bisect
 import logging
 
-__all__ = ["LRScheduler", "FactorScheduler", "MultiFactorScheduler"]
+__all__ = ["LRScheduler", "FactorScheduler", "MultiFactorScheduler",
+           "PolyScheduler", "CosineScheduler", "WarmupScheduler"]
+
+log = logging.getLogger(__name__)
 
 
 class LRScheduler:
-    def __init__(self, base_lr=0.01):
-        self.base_lr = base_lr
+    """Maps the optimizer's global update count to a learning rate.
 
-    def __call__(self, num_update):
-        raise NotImplementedError
+    ``base_lr`` is injected by ``Optimizer.set_lr_scheduler`` /
+    ``Optimizer.__init__`` exactly like the reference does.
+    """
+
+    def __init__(self, base_lr: float = 0.01):
+        self.base_lr = base_lr
+        self._last_logged = None
+
+    def _rate(self, num_update: int) -> float:
+        raise NotImplementedError("subclass must implement _rate()")
+
+    def __call__(self, num_update: int) -> float:
+        lr = self._rate(num_update)
+        if lr != self._last_logged:
+            if self._last_logged is not None:
+                log.info("lr schedule: update %d -> lr %.3e", num_update, lr)
+            self._last_logged = lr
+        return lr
 
 
 class FactorScheduler(LRScheduler):
-    """lr *= factor every `step` updates. reference: lr_scheduler.py:32."""
+    """Multiply lr by ``factor`` once every ``step`` updates.
 
-    def __init__(self, step, factor=1.0, stop_factor_lr=1e-8):
+    Closed form of reference FactorScheduler (lr_scheduler.py:32):
+    ``lr(u) = base_lr * factor ** floor((u-1)/step)`` clamped at
+    ``stop_factor_lr``.
+    """
+
+    def __init__(self, step: int, factor: float = 1.0,
+                 stop_factor_lr: float = 1e-8):
         super().__init__()
         if step < 1:
-            raise ValueError("Schedule step must be greater or equal than 1")
+            raise ValueError(f"step must be >= 1, got {step}")
         if factor > 1.0:
-            raise ValueError("Factor must be no more than 1 to make lr reduce")
-        self.step = step
+            raise ValueError(f"a decay factor > 1 would grow the lr: {factor}")
+        self.step = int(step)
         self.factor = factor
         self.stop_factor_lr = stop_factor_lr
-        self.count = 0
 
-    def __call__(self, num_update):
-        while num_update > self.count + self.step:
-            self.count += self.step
-            self.base_lr *= self.factor
-            if self.base_lr < self.stop_factor_lr:
-                self.base_lr = self.stop_factor_lr
-                logging.info("Update[%d]: now learning rate arrived at %0.5e, "
-                             "will not change in the future", num_update,
-                             self.base_lr)
-            else:
-                logging.info("Update[%d]: Change learning rate to %0.5e",
-                             num_update, self.base_lr)
-        return self.base_lr
+    def _rate(self, num_update):
+        n_decays = max(0, (int(num_update) - 1) // self.step)
+        return max(self.base_lr * self.factor ** n_decays,
+                   self.stop_factor_lr)
 
 
 class MultiFactorScheduler(LRScheduler):
-    """lr *= factor at given steps. reference: lr_scheduler.py:74."""
+    """Multiply lr by ``factor`` as each milestone in ``step`` is passed.
 
-    def __init__(self, step, factor=1):
+    Closed form of reference MultiFactorScheduler (lr_scheduler.py:74):
+    the number of decays at update ``u`` is the number of milestones
+    strictly below ``u``.
+    """
+
+    def __init__(self, step, factor: float = 1.0):
         super().__init__()
-        assert isinstance(step, list) and len(step) >= 1
-        for i, _step in enumerate(step):
-            if i != 0 and step[i] <= step[i - 1]:
-                raise ValueError("Schedule step must be an increasing list")
-            if _step < 1:
-                raise ValueError("Schedule step must be greater or equal than 1")
+        if not step or any(s < 1 for s in step):
+            raise ValueError(f"milestones must be positive ints: {step}")
+        if any(b <= a for a, b in zip(step, step[1:])):
+            raise ValueError(f"milestones must be strictly increasing: {step}")
         if factor > 1.0:
-            raise ValueError("Factor must be no more than 1 to make lr reduce")
-        self.step = step
-        self.cur_step_ind = 0
+            raise ValueError(f"a decay factor > 1 would grow the lr: {factor}")
+        self.step = list(step)
         self.factor = factor
-        self.count = 0
 
-    def __call__(self, num_update):
-        while self.cur_step_ind <= len(self.step) - 1:
-            if num_update > self.step[self.cur_step_ind]:
-                self.count = self.step[self.cur_step_ind]
-                self.cur_step_ind += 1
-                self.base_lr *= self.factor
-                logging.info("Update[%d]: Change learning rate to %0.5e",
-                             num_update, self.base_lr)
-            else:
-                return self.base_lr
-        return self.base_lr
+    def _rate(self, num_update):
+        n_decays = bisect.bisect_left(self.step, int(num_update))
+        return self.base_lr * self.factor ** n_decays
+
+
+class PolyScheduler(LRScheduler):
+    """Polynomial decay to zero over ``max_update`` steps (power ``pwr``)."""
+
+    def __init__(self, max_update: int, pwr: float = 2.0):
+        super().__init__()
+        if max_update < 1:
+            raise ValueError("max_update must be >= 1")
+        self.max_update = int(max_update)
+        self.pwr = pwr
+
+    def _rate(self, num_update):
+        frac = min(int(num_update), self.max_update) / self.max_update
+        return self.base_lr * (1.0 - frac) ** self.pwr
+
+
+class CosineScheduler(LRScheduler):
+    """Cosine decay from base_lr to ``final_lr`` over ``max_update`` steps."""
+
+    def __init__(self, max_update: int, final_lr: float = 0.0):
+        super().__init__()
+        if max_update < 1:
+            raise ValueError("max_update must be >= 1")
+        self.max_update = int(max_update)
+        self.final_lr = final_lr
+
+    def _rate(self, num_update):
+        import math
+        frac = min(int(num_update), self.max_update) / self.max_update
+        return self.final_lr + 0.5 * (self.base_lr - self.final_lr) * (
+            1.0 + math.cos(math.pi * frac))
+
+
+class WarmupScheduler(LRScheduler):
+    """Linear warmup over ``warmup_steps`` wrapped around another schedule."""
+
+    def __init__(self, warmup_steps: int, wrapped: LRScheduler):
+        super().__init__(wrapped.base_lr)
+        self.warmup_steps = int(warmup_steps)
+        self.wrapped = wrapped
+
+    def _rate(self, num_update):
+        self.wrapped.base_lr = self.base_lr
+        if num_update < self.warmup_steps:
+            return self.base_lr * (num_update + 1) / self.warmup_steps
+        return self.wrapped._rate(num_update)

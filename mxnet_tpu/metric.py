@@ -1,9 +1,11 @@
-"""Evaluation metrics (reference: python/mxnet/metric.py, 490 LoC).
+"""Evaluation metrics.
 
-Same accumulate-on-host contract as the reference: ``update(labels, preds)``
-takes lists of NDArrays, ``get()`` returns (name, value). The ``asnumpy()``
-inside update is the step's only sync point — identical to the reference's
-behavior (SURVEY.md §3.1).
+API parity with reference python/mxnet/metric.py — ``update(labels,
+preds)`` over lists of NDArrays, ``get() -> (name, value)``, the
+``asnumpy()`` inside update being the training step's only host sync —
+rebuilt around a name registry and shared label/pred normalization
+helpers instead of the reference's per-class plumbing. Regression
+metrics share one base class with an ``_error`` hook.
 """
 from __future__ import annotations
 
@@ -11,183 +13,186 @@ import math
 
 import numpy as _np
 
-from .base import MXNetError
 from .ndarray import NDArray
 
 __all__ = ["EvalMetric", "Accuracy", "TopKAccuracy", "F1", "Perplexity",
            "MAE", "MSE", "RMSE", "CrossEntropy", "CustomMetric",
-           "CompositeEvalMetric", "np", "create"]
+           "CompositeEvalMetric", "np", "create", "check_label_shapes"]
+
+_REGISTRY: dict = {}
+
+
+def _register(*names):
+    def deco(cls):
+        for n in names:
+            _REGISTRY[n] = cls
+        return cls
+    return deco
+
+
+def _host(x):
+    """NDArray/array-like -> numpy array on host (the sync point)."""
+    return x.asnumpy() if isinstance(x, NDArray) else _np.asarray(x)
 
 
 def check_label_shapes(labels, preds, shape=0):
-    if shape == 0:
-        label_shape, pred_shape = len(labels), len(preds)
-    else:
-        label_shape, pred_shape = labels.shape, preds.shape
-    if label_shape != pred_shape:
-        raise ValueError(f"Shape of labels {label_shape} does not match "
-                         f"shape of predictions {pred_shape}")
+    """Raise if the label/pred batch lists (or shapes) disagree."""
+    got = (len(labels), len(preds)) if shape == 0 \
+        else (labels.shape, preds.shape)
+    if got[0] != got[1]:
+        raise ValueError(
+            f"labels {got[0]} and predictions {got[1]} do not match")
+
+
+def _each(labels, preds, check=True):
+    """Yield (label, pred) numpy pairs for one update call."""
+    if check:
+        check_label_shapes(labels, preds)
+    for label, pred in zip(labels, preds):
+        yield _host(label), _host(pred)
 
 
 class EvalMetric:
-    """Base metric. reference: metric.py:21-85."""
+    """Base class: a running (sum, count) with named readout.
+
+    ``sum_metric`` / ``num_inst`` keep the reference's attribute names —
+    downstream code (and the reference's own tests) poke them directly.
+    """
 
     def __init__(self, name, num=None):
         self.name = name
         self.num = num
         self.reset()
 
+    def reset(self):
+        if self.num is None:
+            self.sum_metric, self.num_inst = 0.0, 0
+        else:
+            self.sum_metric = [0.0] * self.num
+            self.num_inst = [0] * self.num
+
+    def _accumulate(self, total, count, index=None):
+        if index is None:
+            self.sum_metric += total
+            self.num_inst += count
+        else:
+            self.sum_metric[index] += total
+            self.num_inst[index] += count
+
     def update(self, labels, preds):
         raise NotImplementedError
 
-    def reset(self):
-        if self.num is None:
-            self.num_inst = 0
-            self.sum_metric = 0.0
-        else:
-            self.num_inst = [0] * self.num
-            self.sum_metric = [0.0] * self.num
+    @staticmethod
+    def _ratio(total, count):
+        return total / count if count else float("nan")
 
     def get(self):
         if self.num is None:
-            if self.num_inst == 0:
-                return (self.name, float("nan"))
-            return (self.name, self.sum_metric / self.num_inst)
-        names = [f"{self.name}_{i}" for i in range(self.num)]
-        values = [x / y if y != 0 else float("nan")
-                  for x, y in zip(self.sum_metric, self.num_inst)]
-        return (names, values)
+            return self.name, self._ratio(self.sum_metric, self.num_inst)
+        return ([f"{self.name}_{i}" for i in range(self.num)],
+                [self._ratio(s, c)
+                 for s, c in zip(self.sum_metric, self.num_inst)])
 
     def get_name_value(self):
-        name, value = self.get()
-        if not isinstance(name, list):
-            name = [name]
-        if not isinstance(value, list):
-            value = [value]
-        return list(zip(name, value))
+        names, values = self.get()
+        if not isinstance(names, list):
+            names, values = [names], [values]
+        return list(zip(names, values))
 
     def __str__(self):
         return f"EvalMetric: {dict(self.get_name_value())}"
 
 
 class CompositeEvalMetric(EvalMetric):
-    """reference: metric.py:86."""
+    """Fan an update out to several child metrics."""
 
     def __init__(self, metrics=None, name="composite"):
         super().__init__(name)
-        self.metrics = metrics if metrics is not None else []
+        self.metrics = [create(m) for m in (metrics or [])]
 
     def add(self, metric):
         self.metrics.append(create(metric))
 
     def get_metric(self, index):
-        try:
-            return self.metrics[index]
-        except IndexError:
-            return ValueError(f"Metric index {index} is out of range")
+        if index >= len(self.metrics):
+            return ValueError(f"no metric at index {index}")
+        return self.metrics[index]
 
     def update(self, labels, preds):
-        for metric in self.metrics:
-            metric.update(labels, preds)
+        for m in self.metrics:
+            m.update(labels, preds)
 
     def reset(self):
-        try:
-            for metric in self.metrics:
-                metric.reset()
-        except AttributeError:
-            pass
+        for m in getattr(self, "metrics", []):
+            m.reset()
 
     def get(self):
-        names = []
-        results = []
-        for metric in self.metrics:
-            result = metric.get()
-            names.append(result[0])
-            results.append(result[1])
-        return (names, results)
+        out = [m.get() for m in self.metrics]
+        return [n for n, _ in out], [v for _, v in out]
 
 
+@_register("acc", "accuracy")
 class Accuracy(EvalMetric):
-    """reference: metric.py:132."""
+    """Fraction of argmax predictions equal to the integer label."""
 
     def __init__(self):
         super().__init__("accuracy")
 
     def update(self, labels, preds):
-        check_label_shapes(labels, preds)
-        for label, pred_label in zip(labels, preds):
-            pred = pred_label.asnumpy() if isinstance(pred_label, NDArray) \
-                else _np.asarray(pred_label)
-            if pred.ndim > 1 and pred.shape != _np.asarray(
-                    label.asnumpy() if isinstance(label, NDArray)
-                    else label).shape:
-                pred = _np.argmax(pred, axis=1)
-            lab = (label.asnumpy() if isinstance(label, NDArray)
-                   else _np.asarray(label)).astype("int32")
-            pred = pred.astype("int32").reshape(lab.shape)
-            self.sum_metric += int((pred.flat == lab.flat).sum())
-            self.num_inst += len(pred.flat)
+        for lab, pred in _each(labels, preds):
+            if pred.ndim > 1 and pred.shape != lab.shape:
+                pred = pred.argmax(axis=-1)
+            lab = lab.astype(_np.int32).ravel()
+            pred = pred.astype(_np.int32).ravel()
+            self._accumulate(int((pred == lab).sum()), lab.size)
 
 
+@_register("top_k_accuracy", "top_k_acc")
 class TopKAccuracy(EvalMetric):
-    """reference: metric.py:152."""
+    """Label within the k highest-scoring classes."""
 
     def __init__(self, top_k=1):
-        super().__init__("top_k_accuracy")
+        if top_k <= 1:
+            raise ValueError("top_k must exceed 1 (use Accuracy otherwise)")
+        super().__init__(f"top_k_accuracy_{top_k}")
         self.top_k = top_k
-        assert self.top_k > 1, "Please use Accuracy if top_k is no more than 1"
-        self.name += f"_{self.top_k}"
 
     def update(self, labels, preds):
-        check_label_shapes(labels, preds)
-        for label, pred_label in zip(labels, preds):
-            pred = _np.argsort(pred_label.asnumpy().astype("float32"), axis=1)
-            lab = label.asnumpy().astype("int32")
-            check_label_shapes(lab, pred)
-            num_samples = pred.shape[0]
-            num_dims = len(pred.shape)
-            if num_dims == 1:
-                self.sum_metric += (pred.flat == lab.flat).sum()
-            elif num_dims == 2:
-                num_classes = pred.shape[1]
-                top_k = min(num_classes, self.top_k)
-                for j in range(top_k):
-                    self.sum_metric += (
-                        pred[:, num_classes - 1 - j].flat == lab.flat).sum()
-            self.num_inst += num_samples
+        for lab, pred in _each(labels, preds):
+            lab = lab.astype(_np.int32)
+            if pred.ndim == 1:
+                hits = int((pred.astype(_np.int32) == lab).sum())
+            else:
+                k = min(self.top_k, pred.shape[1])
+                top = _np.argpartition(pred, -k, axis=1)[:, -k:]
+                hits = int((top == lab[:, None]).any(axis=1).sum())
+            self._accumulate(hits, lab.shape[0])
 
 
+@_register("f1")
 class F1(EvalMetric):
-    """Binary F1. reference: metric.py:183."""
+    """Binary F1 over argmax predictions, averaged per batch."""
 
     def __init__(self):
         super().__init__("f1")
 
     def update(self, labels, preds):
-        check_label_shapes(labels, preds)
-        for label, pred in zip(labels, preds):
-            pred = pred.asnumpy()
-            label = label.asnumpy().astype("int32")
-            pred_label = _np.argmax(pred, axis=1)
-            check_label_shapes(label, pred)
-            if len(_np.unique(label)) > 2:
-                raise ValueError("F1 currently only supports binary "
-                                 "classification.")
-            true_pos = ((pred_label == 1) & (label == 1)).sum()
-            false_pos = ((pred_label == 1) & (label == 0)).sum()
-            false_neg = ((pred_label == 0) & (label == 1)).sum()
-            precision = true_pos / (true_pos + false_pos) \
-                if true_pos + false_pos > 0 else 0.0
-            recall = true_pos / (true_pos + false_neg) \
-                if true_pos + false_neg > 0 else 0.0
-            f1_score = 2 * (precision * recall) / (precision + recall) \
-                if precision + recall > 0 else 0.0
-            self.sum_metric += f1_score
-            self.num_inst += 1
+        for lab, pred in _each(labels, preds):
+            lab = lab.astype(_np.int32).ravel()
+            if set(_np.unique(lab)) - {0, 1}:
+                raise ValueError("F1 is defined for binary labels {0,1}")
+            hat = pred.argmax(axis=-1).ravel()
+            tp = int(((hat == 1) & (lab == 1)).sum())
+            fp = int(((hat == 1) & (lab == 0)).sum())
+            fn = int(((hat == 0) & (lab == 1)).sum())
+            denom = 2 * tp + fp + fn
+            self._accumulate(2.0 * tp / denom if denom else 0.0, 1)
 
 
+@_register("perplexity")
 class Perplexity(EvalMetric):
-    """reference: metric.py:230."""
+    """exp(mean negative log-prob of the target), with an optional
+    ignored padding label."""
 
     def __init__(self, ignore_label, axis=-1):
         super().__init__("Perplexity")
@@ -196,135 +201,106 @@ class Perplexity(EvalMetric):
 
     def update(self, labels, preds):
         assert len(labels) == len(preds)
-        loss = 0.0
-        num = 0
-        for label, pred in zip(labels, preds):
-            lab = label.asnumpy().astype("int32").reshape(-1)
-            prob = pred.asnumpy().reshape(-1, pred.shape[-1] if self.axis
-                                          in (-1, pred.ndim - 1)
-                                          else pred.shape[self.axis])
-            picked = prob[_np.arange(lab.shape[0]), lab]
+        nll, count = 0.0, 0
+        for lab, prob in _each(labels, preds, check=False):
+            lab = lab.astype(_np.int64).ravel()
+            ncls = prob.shape[self.axis]
+            prob = _np.moveaxis(prob, self.axis, -1).reshape(-1, ncls)
+            p_target = prob[_np.arange(lab.size), lab]
             if self.ignore_label is not None:
-                ignore = (lab == self.ignore_label)
-                picked = _np.where(ignore, 1.0, picked)
-                num -= int(ignore.sum())
-            loss -= _np.sum(_np.log(_np.maximum(1e-10, picked)))
-            num += lab.shape[0]
-        self.sum_metric += float(math.exp(loss / max(num, 1))) * max(num, 1)
-        self.num_inst += max(num, 1)
-
-    def get(self):
-        if self.num_inst == 0:
-            return (self.name, float("nan"))
-        return (self.name, self.sum_metric / self.num_inst)
+                keep = lab != self.ignore_label
+                p_target = _np.where(keep, p_target, 1.0)
+                count += int(keep.sum())
+            else:
+                count += lab.size
+            nll -= float(_np.log(_np.maximum(p_target, 1e-10)).sum())
+        count = max(count, 1)
+        self._accumulate(math.exp(nll / count) * count, count)
 
 
-class MAE(EvalMetric):
-    """reference: metric.py:274."""
+class _RegressionMetric(EvalMetric):
+    """Shared shell for elementwise-error metrics (one hook to fill in)."""
 
+    def _error(self, lab, pred):
+        raise NotImplementedError
+
+    def update(self, labels, preds):
+        for lab, pred in _each(labels, preds):
+            if lab.ndim == 1:
+                lab = lab[:, None]
+            if pred.shape != lab.shape:
+                pred = pred.reshape(lab.shape)
+            self._accumulate(float(self._error(lab, pred)), 1)
+
+
+@_register("mae")
+class MAE(_RegressionMetric):
     def __init__(self):
         super().__init__("mae")
 
-    def update(self, labels, preds):
-        check_label_shapes(labels, preds)
-        for label, pred in zip(labels, preds):
-            label = label.asnumpy()
-            pred = pred.asnumpy()
-            if len(label.shape) == 1:
-                label = label.reshape(label.shape[0], 1)
-            if pred.shape != label.shape:
-                pred = pred.reshape(label.shape)
-            self.sum_metric += _np.abs(label - pred).mean()
-            self.num_inst += 1
+    def _error(self, lab, pred):
+        return _np.abs(lab - pred).mean()
 
 
-class MSE(EvalMetric):
-    """reference: metric.py:293."""
-
+@_register("mse")
+class MSE(_RegressionMetric):
     def __init__(self):
         super().__init__("mse")
 
-    def update(self, labels, preds):
-        check_label_shapes(labels, preds)
-        for label, pred in zip(labels, preds):
-            label = label.asnumpy()
-            pred = pred.asnumpy()
-            if len(label.shape) == 1:
-                label = label.reshape(label.shape[0], 1)
-            if pred.shape != label.shape:
-                pred = pred.reshape(label.shape)
-            self.sum_metric += ((label - pred) ** 2.0).mean()
-            self.num_inst += 1
+    def _error(self, lab, pred):
+        return ((lab - pred) ** 2).mean()
 
 
-class RMSE(EvalMetric):
-    """reference: metric.py:311."""
-
+@_register("rmse")
+class RMSE(_RegressionMetric):
     def __init__(self):
         super().__init__("rmse")
 
-    def update(self, labels, preds):
-        check_label_shapes(labels, preds)
-        for label, pred in zip(labels, preds):
-            label = label.asnumpy()
-            pred = pred.asnumpy()
-            if len(label.shape) == 1:
-                label = label.reshape(label.shape[0], 1)
-            if pred.shape != label.shape:
-                pred = pred.reshape(label.shape)
-            self.sum_metric += _np.sqrt(((label - pred) ** 2.0).mean())
-            self.num_inst += 1
+    def _error(self, lab, pred):
+        return _np.sqrt(((lab - pred) ** 2).mean())
 
 
+@_register("ce", "cross-entropy")
 class CrossEntropy(EvalMetric):
-    """reference: metric.py:329."""
+    """Mean -log p(target) given per-class probability rows."""
 
     def __init__(self, eps=1e-8):
         super().__init__("cross-entropy")
         self.eps = eps
 
     def update(self, labels, preds):
-        check_label_shapes(labels, preds)
-        for label, pred in zip(labels, preds):
-            label = label.asnumpy()
-            pred = pred.asnumpy()
-            label = label.ravel()
-            assert label.shape[0] == pred.shape[0]
-            prob = pred[_np.arange(label.shape[0]), _np.int64(label)]
-            self.sum_metric += (-_np.log(prob + self.eps)).sum()
-            self.num_inst += label.shape[0]
+        for lab, prob in _each(labels, preds):
+            lab = lab.astype(_np.int64).ravel()
+            assert lab.shape[0] == prob.shape[0]
+            p_target = prob[_np.arange(lab.size), lab]
+            self._accumulate(float(-_np.log(p_target + self.eps).sum()),
+                             lab.size)
 
 
 class CustomMetric(EvalMetric):
-    """Wrap a python feval. reference: metric.py:364."""
+    """Adapt a python ``feval(label, pred)`` into the metric protocol."""
 
     def __init__(self, feval, name=None, allow_extra_outputs=False):
         if name is None:
             name = feval.__name__
-            if name.find("<") != -1:
+            if "<" in name:  # lambdas
                 name = f"custom({name})"
         super().__init__(name)
         self._feval = feval
         self._allow_extra_outputs = allow_extra_outputs
 
     def update(self, labels, preds):
-        if not self._allow_extra_outputs:
-            check_label_shapes(labels, preds)
-        for pred, label in zip(preds, labels):
-            label = label.asnumpy()
-            pred = pred.asnumpy()
-            reval = self._feval(label, pred)
-            if isinstance(reval, tuple):
-                (sum_metric, num_inst) = reval
-                self.sum_metric += sum_metric
-                self.num_inst += num_inst
+        for lab, pred in _each(labels, preds,
+                               check=not self._allow_extra_outputs):
+            res = self._feval(lab, pred)
+            if isinstance(res, tuple):
+                self._accumulate(*res)
             else:
-                self.sum_metric += reval
-                self.num_inst += 1
+                self._accumulate(res, 1)
 
 
 def np(numpy_feval, name=None, allow_extra_outputs=False):
-    """Wrap a numpy feval into a metric. reference: metric.py:405."""
+    """Wrap a bare numpy function as a metric."""
     def feval(label, pred):
         return numpy_feval(label, pred)
     feval.__name__ = numpy_feval.__name__
@@ -332,24 +308,18 @@ def np(numpy_feval, name=None, allow_extra_outputs=False):
 
 
 def create(metric, **kwargs):
-    """Create by name/callable/list. reference: metric.py:430."""
-    if callable(metric):
-        return CustomMetric(metric)
+    """Resolve a metric from a name, callable, instance, or list."""
     if isinstance(metric, EvalMetric):
         return metric
+    if callable(metric):
+        return CustomMetric(metric)
     if isinstance(metric, list):
-        composite_metric = CompositeEvalMetric()
-        for child_metric in metric:
-            composite_metric.add(create(child_metric, **kwargs))
-        return composite_metric
-    metrics = {
-        "acc": Accuracy, "accuracy": Accuracy, "f1": F1,
-        "mae": MAE, "mse": MSE, "rmse": RMSE,
-        "ce": CrossEntropy, "cross-entropy": CrossEntropy,
-        "top_k_accuracy": TopKAccuracy, "perplexity": Perplexity,
-    }
+        out = CompositeEvalMetric()
+        for m in metric:
+            out.add(create(m, **kwargs))
+        return out
     try:
-        return metrics[metric.lower()](**kwargs)
-    except Exception:
-        raise ValueError(f"Metric must be either callable or in "
-                         f"{sorted(metrics)}")
+        return _REGISTRY[metric.lower()](**kwargs)
+    except KeyError:
+        raise ValueError(
+            f"unknown metric {metric!r}; registered: {sorted(_REGISTRY)}")

@@ -1,45 +1,51 @@
-"""BaseModule: the abstract train/predict/score interface.
+"""BaseModule: the abstract train/evaluate/predict interface.
 
-reference: python/mxnet/module/base_module.py (the fit loop at :368-507 is
-the behavioral spec: bind -> init_params -> init_optimizer -> per-batch
-forward_backward + update + update_metric, epoch-end param sync).
+API parity with reference python/mxnet/module/base_module.py — ``fit``
+runs bind -> init_params -> init_optimizer -> per-batch
+forward_backward/update/update_metric with the same callback hook points
+— reorganized here into small helpers (`_prepare_fit`, `_fit_epoch`)
+around the single-executor design. Subclasses implement the narrow
+abstract surface at the bottom.
 """
 from __future__ import annotations
 
 import logging
 import time
 
-import numpy as np
-
-from ..base import MXNetError
 from .. import metric as metric_mod
 from .. import ndarray as nd
 from ..model import BatchEndParam
-from ..io import DataDesc
 
 __all__ = ["BaseModule"]
 
 
+def _as_list(obj):
+    return obj if isinstance(obj, list) else [obj]
+
+
+def _fire(callbacks, param):
+    for cb in _as_list(callbacks):
+        cb(param)
+
+
 def _check_input_names(symbol, names, typename, throw):
-    """reference: base_module.py:33."""
+    """Verify user-declared input names exist among the symbol's args."""
     args = symbol.list_arguments()
     for name in names:
-        if name not in args:
-            candidates = [arg for arg in args if not arg.endswith("_weight")
-                          and not arg.endswith("_bias")
-                          and not arg.endswith("_gamma")
-                          and not arg.endswith("_beta")]
-            msg = (f"You created Module with Module(..., {typename}_names="
-                   f"{names}) but input with name '{name}' is not found in "
-                   f"symbol.list_arguments(). Did you mean one of: "
-                   f"{candidates}")
-            if throw:
-                raise ValueError(msg)
-            logging.warning(msg)
+        if name in args:
+            continue
+        non_params = [a for a in args
+                      if not a.split("_")[-1] in
+                      ("weight", "bias", "gamma", "beta")]
+        msg = (f"{typename} name {name!r} is not an argument of the symbol "
+               f"(free inputs are: {non_params})")
+        if throw:
+            raise ValueError(msg)
+        logging.warning(msg)
 
 
 class BaseModule:
-    """reference: base_module.py:60-507."""
+    """Shared high-level driver; subclasses provide the executor plumbing."""
 
     def __init__(self, logger=logging):
         self.logger = logger
@@ -51,100 +57,15 @@ class BaseModule:
         self._symbol = None
         self._total_exec_bytes = 0
 
-    # ------------------------------------------------------------ high level
+    # ------------------------------------------------------------- training
     def forward_backward(self, data_batch):
-        """reference: base_module.py:191."""
+        """One fused fwd+bwd pass (the hot call of fit)."""
         self.forward(data_batch, is_train=True)
         self.backward()
 
-    def score(self, eval_data, eval_metric, num_batch=None,
-              batch_end_callback=None, score_end_callback=None, reset=True,
-              epoch=0):
-        """reference: base_module.py:199."""
-        assert self.binded and self.params_initialized
-        if reset:
-            eval_data.reset()
-        if not isinstance(eval_metric, metric_mod.EvalMetric):
-            eval_metric = metric_mod.create(eval_metric)
-        eval_metric.reset()
-        actual_num_batch = 0
-        for nbatch, eval_batch in enumerate(eval_data):
-            if num_batch is not None and nbatch == num_batch:
-                break
-            self.forward(eval_batch, is_train=False)
-            self.update_metric(eval_metric, eval_batch.label)
-            if batch_end_callback is not None:
-                params = BatchEndParam(epoch=epoch, nbatch=nbatch,
-                                       eval_metric=eval_metric,
-                                       locals=locals())
-                for callback in _as_list(batch_end_callback):
-                    callback(params)
-            actual_num_batch += 1
-        if score_end_callback:
-            params = BatchEndParam(epoch=epoch, nbatch=actual_num_batch,
-                                   eval_metric=eval_metric, locals=locals())
-            for callback in _as_list(score_end_callback):
-                callback(params)
-        return eval_metric.get_name_value()
-
-    def iter_predict(self, eval_data, num_batch=None, reset=True):
-        """reference: base_module.py:260."""
-        assert self.binded and self.params_initialized
-        if reset:
-            eval_data.reset()
-        for nbatch, eval_batch in enumerate(eval_data):
-            if num_batch is not None and nbatch == num_batch:
-                break
-            self.forward(eval_batch, is_train=False)
-            pad = eval_batch.pad
-            outputs = [out[0:out.shape[0] - pad] for out in
-                       self.get_outputs()]
-            yield (outputs, nbatch, eval_batch)
-
-    def predict(self, eval_data, num_batch=None, merge_batches=True,
-                reset=True, always_output_list=False):
-        """reference: base_module.py:285."""
-        assert self.binded and self.params_initialized
-        if reset:
-            eval_data.reset()
-        output_list = []
-        for nbatch, eval_batch in enumerate(eval_data):
-            if num_batch is not None and nbatch == num_batch:
-                break
-            self.forward(eval_batch, is_train=False)
-            pad = eval_batch.pad
-            outputs = [out[0:out.shape[0] - pad].copy()
-                       for out in self.get_outputs()]
-            output_list.append(outputs)
-        if len(output_list) == 0:
-            return output_list
-        if merge_batches:
-            num_outputs = len(output_list[0])
-            for out in output_list:
-                assert len(out) == num_outputs, \
-                    "Cannot merge batches, as num of outputs is not the " \
-                    "same in mini-batches. Maybe bucketing is used?"
-            output_list2 = [nd.concatenate([out[i] for out in output_list])
-                            for i in range(num_outputs)]
-            if num_outputs == 1 and not always_output_list:
-                return output_list2[0]
-            return output_list2
-        return output_list
-
-    def fit(self, train_data, eval_data=None, eval_metric="acc",
-            epoch_end_callback=None, batch_end_callback=None, kvstore="local",
-            optimizer="sgd", optimizer_params=(("learning_rate", 0.01),),
-            eval_end_callback=None, eval_batch_end_callback=None,
-            initializer=None, arg_params=None, aux_params=None,
-            allow_missing=False, force_rebind=False, force_init=False,
-            begin_epoch=0, num_epoch=None, validation_metric=None,
-            monitor=None):
-        """THE training loop. reference: base_module.py:368-507."""
-        from ..initializer import Uniform
-        assert num_epoch is not None, "please specify number of epochs"
-        if initializer is None:
-            initializer = Uniform(0.01)
-
+    def _prepare_fit(self, train_data, initializer, arg_params, aux_params,
+                     allow_missing, force_rebind, force_init, kvstore,
+                     optimizer, optimizer_params, monitor):
         self.bind(data_shapes=train_data.provide_data,
                   label_shapes=train_data.provide_label,
                   for_training=True, force_rebind=force_rebind)
@@ -156,54 +77,137 @@ class BaseModule:
         self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
                             optimizer_params=optimizer_params)
 
-        if validation_metric is None:
-            validation_metric = eval_metric
-        if not isinstance(eval_metric, metric_mod.EvalMetric):
-            eval_metric = metric_mod.create(eval_metric)
+    def _fit_epoch(self, epoch, train_data, eval_metric, batch_end_callback,
+                   monitor):
+        for nbatch, batch in enumerate(train_data):
+            if monitor is not None:
+                monitor.tic()
+            self.forward_backward(batch)
+            self.update()
+            self.update_metric(eval_metric, batch.label)
+            if monitor is not None:
+                monitor.toc_print()
+            if batch_end_callback is not None:
+                _fire(batch_end_callback,
+                      BatchEndParam(epoch=epoch, nbatch=nbatch,
+                                    eval_metric=eval_metric,
+                                    locals=locals()))
 
-        # ---------------------------------------- training loop
+    def fit(self, train_data, eval_data=None, eval_metric="acc",
+            epoch_end_callback=None, batch_end_callback=None, kvstore="local",
+            optimizer="sgd", optimizer_params=(("learning_rate", 0.01),),
+            eval_end_callback=None, eval_batch_end_callback=None,
+            initializer=None, arg_params=None, aux_params=None,
+            allow_missing=False, force_rebind=False, force_init=False,
+            begin_epoch=0, num_epoch=None, validation_metric=None,
+            monitor=None):
+        """The training loop (reference base_module.py:368-507 contract)."""
+        from ..initializer import Uniform
+        if num_epoch is None:
+            raise ValueError("fit() needs num_epoch")
+        self._prepare_fit(train_data, initializer or Uniform(0.01),
+                          arg_params, aux_params, allow_missing,
+                          force_rebind, force_init, kvstore, optimizer,
+                          optimizer_params, monitor)
+
+        eval_metric = metric_mod.create(eval_metric)
+        validation_metric = validation_metric or eval_metric
+
         for epoch in range(begin_epoch, num_epoch):
-            tic = time.time()
+            start = time.time()
             eval_metric.reset()
-            for nbatch, data_batch in enumerate(train_data):
-                if monitor is not None:
-                    monitor.tic()
-                self.forward_backward(data_batch)
-                self.update()
-                self.update_metric(eval_metric, data_batch.label)
-                if monitor is not None:
-                    monitor.toc_print()
-                if batch_end_callback is not None:
-                    batch_end_params = BatchEndParam(
-                        epoch=epoch, nbatch=nbatch, eval_metric=eval_metric,
-                        locals=locals())
-                    for callback in _as_list(batch_end_callback):
-                        callback(batch_end_params)
+            self._fit_epoch(epoch, train_data, eval_metric,
+                            batch_end_callback, monitor)
 
             for name, val in eval_metric.get_name_value():
                 self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
-            toc = time.time()
-            self.logger.info("Epoch[%d] Time cost=%.3f", epoch, (toc - tic))
+            self.logger.info("Epoch[%d] Time cost=%.3f", epoch,
+                             time.time() - start)
 
-            # sync aux params across devices (reference: :501-502)
-            arg_params_, aux_params_ = self.get_params()
-            self.set_params(arg_params_, aux_params_)
-
+            # pull the trained params off-device once per epoch so callbacks
+            # (checkpointing) see current values
+            arg_now, aux_now = self.get_params()
+            self.set_params(arg_now, aux_now)
             if epoch_end_callback is not None:
-                for callback in _as_list(epoch_end_callback):
-                    callback(epoch, self.symbol, arg_params_, aux_params_)
+                for cb in _as_list(epoch_end_callback):
+                    cb(epoch, self.symbol, arg_now, aux_now)
 
             if eval_data:
-                res = self.score(eval_data, validation_metric,
-                                 score_end_callback=eval_end_callback,
-                                 batch_end_callback=eval_batch_end_callback,
-                                 epoch=epoch)
-                for name, val in res:
+                for name, val in self.score(
+                        eval_data, validation_metric,
+                        score_end_callback=eval_end_callback,
+                        batch_end_callback=eval_batch_end_callback,
+                        epoch=epoch):
                     self.logger.info("Epoch[%d] Validation-%s=%f", epoch,
                                      name, val)
             train_data.reset()
 
-    # --------------------------------------------------------- param access
+    # ------------------------------------------------------------ evaluation
+    def score(self, eval_data, eval_metric, num_batch=None,
+              batch_end_callback=None, score_end_callback=None, reset=True,
+              epoch=0):
+        """Run inference over ``eval_data`` accumulating ``eval_metric``."""
+        assert self.binded and self.params_initialized
+        if reset:
+            eval_data.reset()
+        eval_metric = metric_mod.create(eval_metric)
+        eval_metric.reset()
+
+        nbatch = 0
+        for nbatch, batch in enumerate(eval_data):
+            if num_batch is not None and nbatch == num_batch:
+                nbatch -= 1
+                break
+            self.forward(batch, is_train=False)
+            self.update_metric(eval_metric, batch.label)
+            if batch_end_callback is not None:
+                _fire(batch_end_callback,
+                      BatchEndParam(epoch=epoch, nbatch=nbatch,
+                                    eval_metric=eval_metric,
+                                    locals=locals()))
+        if score_end_callback:
+            _fire(score_end_callback,
+                  BatchEndParam(epoch=epoch, nbatch=nbatch + 1,
+                                eval_metric=eval_metric, locals=locals()))
+        return eval_metric.get_name_value()
+
+    def iter_predict(self, eval_data, num_batch=None, reset=True):
+        """Yield (outputs-without-pad, batch index, batch) per batch."""
+        assert self.binded and self.params_initialized
+        if reset:
+            eval_data.reset()
+        for nbatch, batch in enumerate(eval_data):
+            if num_batch is not None and nbatch == num_batch:
+                break
+            self.forward(batch, is_train=False)
+            valid = [o[:o.shape[0] - batch.pad] for o in self.get_outputs()]
+            yield valid, nbatch, batch
+
+    def predict(self, eval_data, num_batch=None, merge_batches=True,
+                reset=True, always_output_list=False):
+        """Collect forward outputs over the iterator.
+
+        With ``merge_batches`` the per-batch output lists are concatenated
+        along the batch axis (requires a constant output arity — bucketed
+        graphs with varying outputs should pass merge_batches=False).
+        """
+        per_batch = [outs for outs, _, _ in
+                     self.iter_predict(eval_data, num_batch, reset)]
+        if not per_batch:
+            return per_batch
+        if not merge_batches:
+            return per_batch
+        arity = len(per_batch[0])
+        if any(len(outs) != arity for outs in per_batch):
+            raise ValueError("output arity varies across batches; "
+                             "use merge_batches=False")
+        merged = [nd.concatenate([outs[i] for outs in per_batch])
+                  for i in range(arity)]
+        if arity == 1 and not always_output_list:
+            return merged[0]
+        return merged
+
+    # ---------------------------------------------------------- param access
     def get_params(self):
         raise NotImplementedError()
 
@@ -219,25 +223,24 @@ class BaseModule:
 
     def save_params(self, fname):
         arg_params, aux_params = self.get_params()
-        save_dict = {f"arg:{k}": v for k, v in arg_params.items()}
-        save_dict.update({f"aux:{k}": v for k, v in aux_params.items()})
-        nd.save(fname, save_dict)
+        payload = {f"arg:{k}": v for k, v in arg_params.items()}
+        payload.update({f"aux:{k}": v for k, v in aux_params.items()})
+        nd.save(fname, payload)
 
     def load_params(self, fname):
-        save_dict = nd.load(fname)
-        arg_params = {}
-        aux_params = {}
-        for k, value in save_dict.items():
-            arg_type, name = k.split(":", 1)
-            if arg_type == "arg":
+        arg_params, aux_params = {}, {}
+        for key, value in nd.load(fname).items():
+            kind, _, name = key.partition(":")
+            if kind == "arg":
                 arg_params[name] = value
-            elif arg_type == "aux":
+            elif kind == "aux":
                 aux_params[name] = value
             else:
-                raise ValueError(f"Invalid param file {fname}")
+                raise ValueError(
+                    f"{fname} is not a param file (bad key {key!r})")
         self.set_params(arg_params, aux_params)
 
-    # ----------------------------------------------------------- interfaces
+    # ------------------------------------------------------ abstract surface
     @property
     def symbol(self):
         return self._symbol
@@ -292,9 +295,3 @@ class BaseModule:
 
     def install_monitor(self, mon):
         raise NotImplementedError()
-
-
-def _as_list(obj):
-    if isinstance(obj, list):
-        return obj
-    return [obj]

@@ -161,17 +161,14 @@ class DataParallelExecutorGroup:
                                  self.grad_req, aux)
         self.execs = [self.executor]  # reference-compat alias
 
-        # param/grad arrays in reference layout: list (over params) of
-        # list (over "devices" — here the single logical executor)
-        self.param_arrays = [[self.executor.arg_dict[name]]
+        # flat layout — one logical sharded executor, so one array per
+        # param (the reference's per-device inner lists don't exist here);
+        # grad entry is None for fixed/untrained params, keeping 1:1 zip
+        self.param_arrays = [self.executor.arg_dict[name]
                              for name in self.param_names]
-        self.grad_arrays = [[self.executor.grad_dict[name]]
-                            for name in self.param_names
-                            if self.grad_req.get(name, "null") != "null"]
-        # keep 1:1 with param_arrays for Module.update zip (None when fixed)
-        self.grad_arrays = [[self.executor.grad_dict.get(name)]
+        self.grad_arrays = [self.executor.grad_dict.get(name)
                             for name in self.param_names]
-        self.aux_arrays = [[a] for a in self.executor.aux_arrays]
+        self.aux_arrays = list(self.executor.aux_arrays)
 
         self.data_arrays = [self.executor.arg_dict[name]
                             for name in self.data_names]
@@ -215,22 +212,20 @@ class DataParallelExecutorGroup:
         """
         if is_train is None:
             is_train = self.for_training
-        kwargs = {}
-        for name, arr in zip(self.data_names, data_batch.data):
-            val = arr.asjax() if isinstance(arr, NDArray) else jnp.asarray(
-                np.asarray(arr))
-            dst = self.executor.arg_dict[name]
-            kwargs[name] = None
-            dst._set(self._place(val.astype(dst.dtype), "data"))
-        if is_train or True:
-            if self.label_names and data_batch.label:
-                for name, arr in zip(self.label_names, data_batch.label):
-                    if name not in self.executor.arg_dict:
-                        continue
-                    val = arr.asjax() if isinstance(arr, NDArray) else \
-                        jnp.asarray(np.asarray(arr))
-                    dst = self.executor.arg_dict[name]
-                    dst._set(self._place(val.astype(dst.dtype), "data"))
+
+        def load(names, arrays):
+            for name, arr in zip(names, arrays):
+                dst = self.executor.arg_dict.get(name)
+                if dst is None:
+                    continue
+                val = arr.asjax() if isinstance(arr, NDArray) else \
+                    jnp.asarray(np.asarray(arr))
+                dst._set(self._place(val.astype(dst.dtype), "data"))
+
+        load(self.data_names, data_batch.data)
+        # labels are loaded for inference too: eval graphs (score) read them
+        if self.label_names and data_batch.label:
+            load(self.label_names, data_batch.label)
         self.executor.forward(is_train=is_train)
 
     def backward(self, out_grads=None):

@@ -1,124 +1,91 @@
 """Module: the primary training interface over one Symbol.
 
-reference: python/mxnet/module/module.py (bind :323, init_optimizer :432,
-update :553, save/load_checkpoint :674+).
+Behavioral parity with reference python/mxnet/module/module.py, written
+for this framework's execution model: ONE mesh-sharded executor instead
+of a list of per-device executors, so parameter handling is a flat
+name->NDArray mapping throughout and the update path walks
+``zip(param_names, param_arrays, grad_arrays)`` with stride 1.
 """
 from __future__ import annotations
 
 import logging
+import pickle
 
 import numpy as np
 
-from ..base import MXNetError
 from .. import ndarray as nd
-from ..ndarray import NDArray
-from ..context import cpu, current_context
-from ..initializer import Uniform
 from .. import optimizer as opt
-from ..model import (_create_kvstore, _initialize_kvstore, save_checkpoint,
-                     load_checkpoint)
-from ..io import DataDesc
+from ..context import current_context
+from ..initializer import Uniform
+from ..model import (_create_kvstore, _initialize_kvstore, load_checkpoint)
+from ..ndarray import NDArray
 from .base_module import BaseModule, _check_input_names
 from .executor_group import DataParallelExecutorGroup
 
 __all__ = ["Module"]
 
 
-def _update_params_on_kvstore(param_arrays, grad_arrays, kvstore):
-    """push grad, pull weight. reference: model.py:88-97."""
-    for index, pair in enumerate(zip(param_arrays, grad_arrays)):
-        arg_list, grad_list = pair
-        if grad_list[0] is None:
-            continue
-        kvstore.push(index, grad_list, priority=-index)
-        kvstore.pull(index, arg_list, priority=-index)
-
-
-def _update_params(param_arrays, grad_arrays, updater, num_device,
-                   kvstore=None):
-    """local update path. reference: model.py:99-116."""
-    for index, pair in enumerate(zip(param_arrays, grad_arrays)):
-        arg_list, grad_list = pair
-        if grad_list[0] is None:
-            continue
-        if kvstore:
-            kvstore.push(index, grad_list, priority=-index)
-            kvstore.pull(index, grad_list, priority=-index)
-        for k, p in enumerate(zip(arg_list, grad_list)):
-            w, g = p
-            updater(index * num_device + k, g, w)
-
-
 class Module(BaseModule):
-    """reference: module/module.py:40-700."""
+    """Train/predict over a single Symbol bound to a (possibly multi-
+    device) context list. reference: module/module.py:40-700."""
 
     def __init__(self, symbol, data_names=("data",),
                  label_names=("softmax_label",), logger=logging,
                  context=None, work_load_list=None, fixed_param_names=None,
                  state_names=None):
         super().__init__(logger=logger)
-        if context is None:
-            context = [current_context()]
-        if not isinstance(context, (list, tuple)):
-            context = [context]
-        self._context = list(context)
-        if work_load_list is None:
-            work_load_list = [1] * len(self._context)
-        self._work_load_list = work_load_list
+        context = context if context is not None else [current_context()]
+        self._context = list(context) if isinstance(context, (list, tuple)) \
+            else [context]
+        self._work_load_list = work_load_list or [1] * len(self._context)
 
         self._symbol = symbol
-        data_names = list(data_names) if data_names is not None else []
-        label_names = list(label_names) if label_names is not None else []
-        arg_names = symbol.list_arguments()
-        input_names = data_names + label_names
-        self._param_names = [x for x in arg_names if x not in input_names]
-        self._fixed_param_names = list(fixed_param_names or [])
-        self._aux_names = symbol.list_auxiliary_states()
-        self._data_names = data_names
-        self._label_names = label_names
+        self._data_names = list(data_names or [])
+        self._label_names = list(label_names or [])
         self._state_names = list(state_names or [])
+        self._fixed_param_names = list(fixed_param_names or [])
         self._output_names = symbol.list_outputs()
-        _check_input_names(symbol, data_names, "data", True)
-        _check_input_names(symbol, label_names, "label", False)
+        self._aux_names = symbol.list_auxiliary_states()
+        inputs = set(self._data_names) | set(self._label_names)
+        self._param_names = [a for a in symbol.list_arguments()
+                             if a not in inputs]
+        _check_input_names(symbol, self._data_names, "data", True)
+        _check_input_names(symbol, self._label_names, "label", False)
         _check_input_names(symbol, self._state_names, "state", True)
         _check_input_names(symbol, self._fixed_param_names, "fixed_param",
                            True)
 
+        self._exec_group = None
         self._arg_params = None
         self._aux_params = None
         self._params_dirty = False
+        self._grad_req = None
         self._optimizer = None
         self._kvstore = None
         self._update_on_kvstore = None
         self._updater = None
         self._preload_opt_states = None
-        self._exec_group = None
-        self._grad_req = None
 
+    # ------------------------------------------------------------ checkpoint
     @staticmethod
     def load(prefix, epoch, load_optimizer_states=False, **kwargs):
-        """reference: module.py load."""
+        """Build a Module from a saved checkpoint (symbol JSON + params)."""
         sym, args, auxs = load_checkpoint(prefix, epoch)
         mod = Module(symbol=sym, **kwargs)
-        mod._arg_params = args
-        mod._aux_params = auxs
+        mod._arg_params, mod._aux_params = args, auxs
         mod.params_initialized = True
         if load_optimizer_states:
             mod._preload_opt_states = f"{prefix}-{epoch:04d}.states"
         return mod
 
     def save_checkpoint(self, prefix, epoch, save_optimizer_states=False):
-        """reference: module.py save_checkpoint."""
+        """Write prefix-symbol.json + prefix-NNNN.params (+ .states)."""
         self._symbol.save(f"{prefix}-symbol.json")
-        param_name = f"{prefix}-{epoch:04d}.params"
-        self.save_params(param_name)
-        logging.info('Saved checkpoint to "%s"', param_name)
+        self.save_params(f"{prefix}-{epoch:04d}.params")
         if save_optimizer_states:
-            state_name = f"{prefix}-{epoch:04d}.states"
-            self.save_optimizer_states(state_name)
-            logging.info('Saved optimizer state to "%s"', state_name)
+            self.save_optimizer_states(f"{prefix}-{epoch:04d}.states")
 
-    # ---------------------------------------------------------- properties
+    # ------------------------------------------------------------ properties
     @property
     def data_names(self):
         return self._data_names
@@ -144,59 +111,58 @@ class Module(BaseModule):
     @property
     def output_shapes(self):
         assert self.binded
-        shapes = {d.name: d.shape for d in self._exec_group.data_shapes}
-        if self._exec_group.label_shapes:
-            shapes.update({l.name: l.shape
-                           for l in self._exec_group.label_shapes})
-        _, out_shapes, _ = self._symbol.infer_shape(**shapes)
+        known = {d.name: d.shape for d in self._exec_group.data_shapes}
+        for l in self._exec_group.label_shapes or []:
+            known[l.name] = l.shape
+        _, out_shapes, _ = self._symbol.infer_shape(**known)
         return list(zip(self._output_names, out_shapes))
 
-    # -------------------------------------------------------------- params
+    # ---------------------------------------------------------------- params
     def get_params(self):
         assert self.binded and self.params_initialized
         if self._params_dirty:
             self._sync_params_from_devices()
-        return (self._arg_params, self._aux_params)
+        return self._arg_params, self._aux_params
 
     def init_params(self, initializer=Uniform(0.01), arg_params=None,
                     aux_params=None, allow_missing=False, force_init=False):
-        """reference: module.py init_params."""
+        """Fill parameter arrays from the caches and/or the initializer."""
         if self.params_initialized and not force_init:
             return
-        assert self.binded, "call bind before initializing the parameters"
+        assert self.binded, "bind() must run before init_params()"
 
+        exe = self._exec_group.executor
         if self._arg_params is None:
             self._arg_params = {
-                name: nd.zeros(self._exec_group.executor.arg_dict[name].shape,
-                               dtype=self._exec_group.executor
-                               .arg_dict[name].dtype)
-                for name in self._param_names}
+                n: nd.zeros(exe.arg_dict[n].shape,
+                            dtype=exe.arg_dict[n].dtype)
+                for n in self._param_names}
         if self._aux_params is None:
             self._aux_params = {
-                name: nd.zeros(arr.shape, dtype=arr.dtype)
-                for name, arr in self._exec_group.executor.aux_dict.items()}
+                n: nd.zeros(a.shape, dtype=a.dtype)
+                for n, a in exe.aux_dict.items()}
 
-        def _impl(name, arr, cache):
-            if cache is not None:
-                if name in cache:
-                    cache_arr = cache[name]
-                    if cache_arr is not arr:
-                        if isinstance(cache_arr, NDArray):
-                            cache_arr.copyto(arr)
-                        else:
-                            arr._set(np.asarray(cache_arr))
-                else:
-                    if not allow_missing:
-                        raise RuntimeError(f"{name} is not presented")
-                    if initializer is not None:
-                        initializer(name, arr)
-            else:
+        def fill(name, arr, cache):
+            if cache is None:
+                initializer(name, arr)
+            elif name in cache:
+                src = cache[name]
+                if src is not arr:
+                    if isinstance(src, NDArray):
+                        src.copyto(arr)
+                    else:
+                        arr._set(np.asarray(src))
+            elif not allow_missing:
+                raise RuntimeError(
+                    f"parameter {name!r} missing from the provided params "
+                    "(pass allow_missing=True to initialize it instead)")
+            elif initializer is not None:
                 initializer(name, arr)
 
-        for name, arr in sorted(self._arg_params.items()):
-            _impl(name, arr, arg_params)
-        for name, arr in sorted(self._aux_params.items()):
-            _impl(name, arr, aux_params)
+        for name in sorted(self._arg_params):
+            fill(name, self._arg_params[name], arg_params)
+        for name in sorted(self._aux_params):
+            fill(name, self._aux_params[name], aux_params)
 
         self.params_initialized = True
         self._params_dirty = False
@@ -206,8 +172,7 @@ class Module(BaseModule):
                    force_init=True):
         if not allow_missing:
             self.init_params(initializer=None, arg_params=arg_params,
-                             aux_params=aux_params,
-                             allow_missing=allow_missing,
+                             aux_params=aux_params, allow_missing=False,
                              force_init=force_init)
             return
         if self.params_initialized and not force_init:
@@ -216,24 +181,28 @@ class Module(BaseModule):
         self._params_dirty = True
         self.params_initialized = True
 
-    # ----------------------------------------------------------------- bind
+    def _sync_params_from_devices(self):
+        self._exec_group.get_params(self._arg_params, self._aux_params)
+        self._params_dirty = False
+
+    # ------------------------------------------------------------------ bind
     def bind(self, data_shapes, label_shapes=None, for_training=True,
              inputs_need_grad=False, force_rebind=False, shared_module=None,
              grad_req="write"):
-        """reference: module.py:323-430."""
+        """Compile the symbol into the sharded executor group."""
         if force_rebind:
             self._reset_bind()
         if self.binded:
-            self.logger.warning("Already binded, ignoring bind()")
+            self.logger.warning("Module is already bound; ignoring bind() "
+                                "(use force_rebind=True to re-bind)")
             return
+        if not for_training:
+            assert not inputs_need_grad
 
         self.for_training = for_training
         self.inputs_need_grad = inputs_need_grad
         self.binded = True
         self._grad_req = grad_req
-
-        if not for_training:
-            assert not inputs_need_grad
 
         shared_group = None
         if shared_module is not None:
@@ -265,42 +234,40 @@ class Module(BaseModule):
         if self.params_initialized:
             self._exec_group.set_params(self._arg_params, self._aux_params)
 
-    # ------------------------------------------------------------ optimizer
+    # ------------------------------------------------------------- optimizer
     def init_optimizer(self, kvstore="local", optimizer="sgd",
                        optimizer_params=(("learning_rate", 0.01),),
                        force_init=False):
-        """reference: module.py:432-506."""
+        """Resolve the kvstore/updater arrangement and build the optimizer."""
         assert self.binded and self.params_initialized
         if self.optimizer_initialized and not force_init:
-            self.logger.warning("optimizer already initialized, ignoring...")
+            self.logger.warning("optimizer is already initialized; "
+                                "ignoring init_optimizer()")
             return
 
-        (kvstore, update_on_kvstore) = _create_kvstore(
+        kvstore, update_on_kvstore = _create_kvstore(
             kvstore, len(self._context), self._arg_params)
 
+        # dist_sync semantics: every worker sees the global batch
         batch_size = self._exec_group.batch_size
-        if kvstore and "dist" in kvstore.type and \
-                "_sync" in kvstore.type:
+        if kvstore and "dist" in kvstore.type and "_sync" in kvstore.type:
             batch_size *= kvstore.num_workers
-        rescale_grad = 1.0 / batch_size
 
         if isinstance(optimizer, str):
-            # one logical sharded executor -> updater indices always have
-            # stride 1, regardless of how many contexts back the mesh
-            idx2name = dict(enumerate(self._exec_group.param_names))
-            optimizer_params = dict(optimizer_params)
-            if "rescale_grad" not in optimizer_params:
-                optimizer_params["rescale_grad"] = rescale_grad
-            optimizer = opt.create(optimizer, sym=self.symbol,
-                                   param_idx2name=idx2name,
-                                   **optimizer_params)
+            params = dict(optimizer_params)
+            params.setdefault("rescale_grad", 1.0 / batch_size)
+            optimizer = opt.create(
+                optimizer, sym=self.symbol,
+                param_idx2name=dict(enumerate(self._param_names)),
+                **params)
         else:
             assert isinstance(optimizer, opt.Optimizer)
 
         self._optimizer = optimizer
         self._kvstore = kvstore
         self._update_on_kvstore = update_on_kvstore
-        self._updater = None
+        self._updater = None if update_on_kvstore \
+            else opt.get_updater(optimizer)
 
         if kvstore:
             _initialize_kvstore(kvstore=kvstore,
@@ -308,16 +275,22 @@ class Module(BaseModule):
                                 arg_params=self._arg_params,
                                 param_names=self._param_names,
                                 update_on_kvstore=update_on_kvstore)
-        if update_on_kvstore:
-            kvstore.set_optimizer(self._optimizer)
-        else:
-            self._updater = opt.get_updater(optimizer)
+            if update_on_kvstore:
+                kvstore.set_optimizer(optimizer)
 
         self.optimizer_initialized = True
-
         if self._preload_opt_states is not None:
             self.load_optimizer_states(self._preload_opt_states)
             self._preload_opt_states = None
+
+    def borrow_optimizer(self, shared_module):
+        """Share optimizer state with another Module (bucketing)."""
+        assert shared_module.optimizer_initialized
+        self._optimizer = shared_module._optimizer
+        self._kvstore = shared_module._kvstore
+        self._update_on_kvstore = shared_module._update_on_kvstore
+        self._updater = shared_module._updater
+        self.optimizer_initialized = True
 
     # ------------------------------------------------------------ train step
     def forward(self, data_batch, is_train=None):
@@ -329,20 +302,33 @@ class Module(BaseModule):
         self._exec_group.backward(out_grads=out_grads)
 
     def update(self):
-        """reference: module.py:553-580 + model.py:88-116."""
+        """Apply one optimizer step to every trainable parameter.
+
+        Two arrangements (reference model.py:88-116 semantics, flat here):
+        update_on_kvstore — push grad / pull weight, the store's updater
+        does the math; otherwise — optional kvstore grad all-reduce, then
+        the local updater writes the weights in place.
+        """
         assert self.binded and self.params_initialized and \
             self.optimizer_initialized
         self._params_dirty = True
+        triples = zip(range(len(self._param_names)),
+                      self._exec_group.param_arrays,
+                      self._exec_group.grad_arrays)
         if self._update_on_kvstore:
-            _update_params_on_kvstore(self._exec_group.param_arrays,
-                                      self._exec_group.grad_arrays,
-                                      self._kvstore)
+            for i, weight, grad in triples:
+                if grad is None:
+                    continue
+                self._kvstore.push(i, grad, priority=-i)
+                self._kvstore.pull(i, weight, priority=-i)
         else:
-            _update_params(self._exec_group.param_arrays,
-                           self._exec_group.grad_arrays,
-                           updater=self._updater,
-                           num_device=1,  # one logical (sharded) executor
-                           kvstore=self._kvstore)
+            for i, weight, grad in triples:
+                if grad is None:
+                    continue
+                if self._kvstore:
+                    self._kvstore.push(i, grad, priority=-i)
+                    self._kvstore.pull(i, grad, priority=-i)
+                self._updater(i, grad, weight)
 
     def get_outputs(self, merge_multi_context=True):
         assert self.binded and self.params_initialized
@@ -356,41 +342,30 @@ class Module(BaseModule):
     def update_metric(self, eval_metric, labels):
         self._exec_group.update_metric(eval_metric, labels)
 
-    # ----------------------------------------------------------------- misc
-    def _sync_params_from_devices(self):
-        self._exec_group.get_params(self._arg_params, self._aux_params)
-        self._params_dirty = False
-
+    # ------------------------------------------------------ optimizer states
     def save_optimizer_states(self, fname):
         assert self.optimizer_initialized
         if self._update_on_kvstore:
             self._kvstore.save_optimizer_states(fname)
-        else:
-            import pickle
-            states = {k: (v.asnumpy() if isinstance(v, NDArray) else
-                          [x.asnumpy() if isinstance(x, NDArray) else x
-                           for x in v] if isinstance(v, (tuple, list)) else v)
-                      for k, v in self._updater.states.items()}
-            with open(fname, "wb") as fout:
-                pickle.dump(states, fout)
+            return
+        def host(v):
+            if isinstance(v, NDArray):
+                return v.asnumpy()
+            if isinstance(v, (tuple, list)):
+                return [host(x) for x in v]
+            return v
+        with open(fname, "wb") as fout:
+            pickle.dump({k: host(v) for k, v in
+                         self._updater.states.items()}, fout)
 
     def load_optimizer_states(self, fname):
         assert self.optimizer_initialized
         if self._update_on_kvstore:
             self._kvstore.load_optimizer_states(fname)
         else:
-            import pickle
             with open(fname, "rb") as fin:
                 self._updater.states.update(pickle.load(fin))
 
     def install_monitor(self, mon):
         assert self.binded
         self._exec_group.install_monitor(mon)
-
-    def borrow_optimizer(self, shared_module):
-        assert shared_module.optimizer_initialized
-        self._optimizer = shared_module._optimizer
-        self._kvstore = shared_module._kvstore
-        self._update_on_kvstore = shared_module._update_on_kvstore
-        self._updater = shared_module._updater
-        self.optimizer_initialized = True

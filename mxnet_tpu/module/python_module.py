@@ -1,13 +1,15 @@
-"""PythonModule / PythonLossModule (reference:
-python/mxnet/module/python_module.py) — modules computed in python,
-typically metric-only or custom-loss heads."""
+"""PythonModule / PythonLossModule: module-shaped python computations.
+
+API parity with reference python/mxnet/module/python_module.py. These
+carry no parameters and no executor; they exist so python-side logic
+(custom losses, metric heads) can slot into a SequentialModule chain or
+be driven by the fit loop. PythonModule supplies the no-op plumbing;
+subclasses implement ``forward``/``backward``/``_compute_output_shapes``.
+"""
 from __future__ import annotations
 
 import logging
 
-import numpy as np
-
-from .. import ndarray as nd
 from ..ndarray import NDArray, array
 from .base_module import BaseModule
 
@@ -15,17 +17,14 @@ __all__ = ["PythonModule", "PythonLossModule"]
 
 
 class PythonModule(BaseModule):
-    """reference: python_module.py:18-150."""
+    """Parameter-free module shell: bind records shapes, params/optimizer
+    are no-ops, update_metric runs on whatever forward produced."""
 
     def __init__(self, data_names, label_names, output_names, logger=logging):
         super().__init__(logger=logger)
-        if isinstance(data_names, tuple):
-            data_names = list(data_names)
-        if isinstance(label_names, tuple):
-            label_names = list(label_names)
-        self._data_names = data_names
-        self._label_names = label_names
-        self._output_names = output_names
+        self._data_names = list(data_names or [])
+        self._label_names = list(label_names or [])
+        self._output_names = list(output_names or [])
         self._data_shapes = None
         self._label_shapes = None
         self._output_shapes = None
@@ -50,30 +49,36 @@ class PythonModule(BaseModule):
     def output_shapes(self):
         return self._output_shapes
 
+    # no parameters to manage
     def get_params(self):
-        return (dict(), dict())
+        return {}, {}
 
     def init_params(self, initializer=None, arg_params=None, aux_params=None,
                     allow_missing=False, force_init=False):
         self.params_initialized = True
 
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.01),),
+                       force_init=False):
+        self.optimizer_initialized = True
+
     def update(self):
         pass
 
     def update_metric(self, eval_metric, labels):
-        if self._label_shapes is None:
-            return
-        eval_metric.update(labels, self.get_outputs())
+        if self._label_shapes is not None:
+            eval_metric.update(labels, self.get_outputs())
 
     def bind(self, data_shapes, label_shapes=None, for_training=True,
              inputs_need_grad=False, force_rebind=False, shared_module=None,
              grad_req="write"):
         if self.binded and not force_rebind:
-            self.logger.warning("Already binded, ignoring bind()")
+            self.logger.warning("Module is already bound; ignoring bind()")
             return
+        if grad_req != "write":
+            raise ValueError("PythonModule only supports grad_req='write'")
         self.for_training = for_training
         self.inputs_need_grad = inputs_need_grad
-        assert grad_req == "write"
         self._data_shapes = data_shapes
         self._label_shapes = label_shapes
         self._output_shapes = self._compute_output_shapes()
@@ -82,37 +87,38 @@ class PythonModule(BaseModule):
     def _compute_output_shapes(self):
         raise NotImplementedError()
 
-    def init_optimizer(self, kvstore="local", optimizer="sgd",
-                       optimizer_params=(("learning_rate", 0.01),),
-                       force_init=False):
-        self.optimizer_initialized = True
-
     def install_monitor(self, mon):
         pass
 
 
 class PythonLossModule(PythonModule):
-    """reference: python_module.py:152-280."""
+    """Identity forward + user-supplied gradient: the terminal loss stage
+    of a SequentialModule chain.
+
+    ``grad_func(scores, labels) -> grad`` defines the backward; forward
+    passes scores through unchanged (like MakeLoss).
+    """
 
     def __init__(self, name="pyloss", data_names=("data",),
                  label_names=("softmax_label",), logger=logging,
                  grad_func=None):
-        super().__init__(data_names, label_names,
-                         [name + "_output"], logger=logger)
+        if len(data_names) != 1 or len(label_names) != 1:
+            raise ValueError("PythonLossModule takes exactly one data and "
+                             "one label input")
+        super().__init__(data_names, label_names, [f"{name}_output"],
+                         logger=logger)
         self._name = name
-        assert len(data_names) == 1
-        assert len(label_names) == 1
+        if grad_func is not None and not callable(grad_func):
+            raise TypeError("grad_func must be callable")
+        self._grad_func = grad_func
         self._scores = None
         self._labels = None
         self._scores_grad = None
-        if grad_func is not None:
-            assert callable(grad_func)
-        self._grad_func = grad_func
 
     def _compute_output_shapes(self):
-        return [(self._name + "_output", self._data_shapes[0].shape
-                 if hasattr(self._data_shapes[0], "shape")
-                 else self._data_shapes[0][1])]
+        d = self._data_shapes[0]
+        shape = d.shape if hasattr(d, "shape") else d[1]
+        return [(f"{self._name}_output", shape)]
 
     def forward(self, data_batch, is_train=None):
         self._scores = data_batch.data[0]
@@ -126,18 +132,14 @@ class PythonLossModule(PythonModule):
         return [self._scores]
 
     def backward(self, out_grads=None):
-        assert out_grads is None, "For a loss module, out_grads should be None"
+        if out_grads is not None:
+            raise ValueError("a loss stage takes no upstream out_grads")
         assert self.for_training
-        self._backward_impl()
-
-    def _backward_impl(self):
-        if self._grad_func is not None:
-            grad = self._grad_func(self._scores, self._labels)
-            if not isinstance(grad, NDArray):
-                grad = array(grad)
-            self._scores_grad = grad
-        else:
-            raise NotImplementedError()
+        if self._grad_func is None:
+            raise NotImplementedError(
+                "pass grad_func= or subclass and override backward()")
+        grad = self._grad_func(self._scores, self._labels)
+        self._scores_grad = grad if isinstance(grad, NDArray) else array(grad)
 
     def get_input_grads(self, merge_multi_context=True):
         assert merge_multi_context

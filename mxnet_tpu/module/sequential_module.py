@@ -1,12 +1,17 @@
-"""SequentialModule: chain modules head-to-tail.
+"""SequentialModule: run a list of modules head-to-tail.
 
-reference: python/mxnet/module/sequential_module.py.
+API parity with reference python/mxnet/module/sequential_module.py
+(``add(module, take_labels=..., auto_wiring=...)`` then the usual
+BaseModule surface). Forward threads each stage's outputs into the next
+stage's data; backward threads input-gradients in reverse. Stages are
+bound with ``inputs_need_grad=True`` for every stage after the first so
+the gradient chain is closed.
 """
 from __future__ import annotations
 
+import copy
 import logging
 
-from ..base import MXNetError
 from ..io import DataDesc
 from .base_module import BaseModule
 
@@ -19,40 +24,45 @@ class SequentialModule(BaseModule):
 
     def __init__(self, logger=logging):
         super().__init__(logger=logger)
-        self._modules = []
-        self._metas = []
+        self._stages = []          # [(module, meta_dict)]
         self._label_shapes = None
-        self._data_shapes = None
-        self._meta_keys = {x for x in dir(self)
-                           if x.startswith("META_")}
+
+    # backward-compat views (the reference exposes parallel lists)
+    @property
+    def _modules(self):
+        return [m for m, _ in self._stages]
+
+    @property
+    def _metas(self):
+        return [meta for _, meta in self._stages]
 
     def add(self, module, **kwargs):
-        self._modules.append(module)
-        for key in kwargs:
-            assert f"META_{key.upper()}" in self._meta_keys, \
-                f"Unknown meta {key}"
-        self._metas.append(kwargs)
+        """Append a stage. Recognized meta: take_labels, auto_wiring."""
+        valid = {self.META_TAKE_LABELS, self.META_AUTO_WIRING}
+        unknown = set(kwargs) - valid
+        if unknown:
+            raise ValueError(f"unknown stage meta {sorted(unknown)}; "
+                             f"valid: {sorted(valid)}")
+        self._stages.append((module, kwargs))
+        # adding a stage invalidates any previous binding
         self.binded = False
         self.params_initialized = False
         self.optimizer_initialized = False
         return self
 
+    # -------------------------------------------------------- properties
     @property
     def data_names(self):
-        if len(self._modules) > 0:
-            return self._modules[0].data_names
-        return []
+        return self._stages[0][0].data_names if self._stages else []
 
     @property
     def output_names(self):
-        if len(self._modules) > 0:
-            return self._modules[-1].output_names
-        return []
+        return self._stages[-1][0].output_names if self._stages else []
 
     @property
     def data_shapes(self):
         assert self.binded
-        return self._modules[0].data_shapes
+        return self._stages[0][0].data_shapes
 
     @property
     def label_shapes(self):
@@ -62,17 +72,17 @@ class SequentialModule(BaseModule):
     @property
     def output_shapes(self):
         assert self.binded
-        return self._modules[-1].output_shapes
+        return self._stages[-1][0].output_shapes
 
+    # ------------------------------------------------------------ params
     def get_params(self):
         assert self.binded and self.params_initialized
-        arg_params = {}
-        aux_params = {}
-        for module in self._modules:
+        arg_params, aux_params = {}, {}
+        for module, _ in self._stages:
             arg, aux = module.get_params()
             arg_params.update(arg)
             aux_params.update(aux)
-        return (arg_params, aux_params)
+        return arg_params, aux_params
 
     def init_params(self, initializer=None, arg_params=None, aux_params=None,
                     allow_missing=False, force_init=False):
@@ -80,137 +90,125 @@ class SequentialModule(BaseModule):
             return
         assert self.binded
         from ..initializer import Uniform
-        if initializer is None:
-            initializer = Uniform(0.01)
-        for module in self._modules:
-            module.init_params(initializer=initializer,
+        for module, _ in self._stages:
+            module.init_params(initializer=initializer or Uniform(0.01),
                                arg_params=arg_params, aux_params=aux_params,
-                               allow_missing=True,
-                               force_init=force_init)
-
-        def _check_name(known_names, new_names, modules, i):
-            for name in new_names:
-                assert not name in known_names, \
-                    f"Duplicated parameter names: name {name} in " \
-                    f"layer {i} ({type(modules[i])}) is already used earlier."
-                known_names[name] = i
-
-        arg_names = {}
-        aux_names = {}
-        for i_layer, module in enumerate(self._modules):
+                               allow_missing=True, force_init=force_init)
+        # a param name appearing in two stages would silently fork state
+        seen = {}
+        for i, (module, _) in enumerate(self._stages):
             arg, aux = module.get_params()
-            _check_name(arg_names, arg.keys(), self._modules, i_layer)
-            _check_name(aux_names, aux.keys(), self._modules, i_layer)
+            for name in list(arg) + list(aux):
+                if name in seen:
+                    raise ValueError(
+                        f"parameter {name!r} defined by both stage "
+                        f"{seen[name]} and stage {i}")
+                seen[name] = i
         self.params_initialized = True
 
+    # -------------------------------------------------------------- bind
     def bind(self, data_shapes, label_shapes=None, for_training=True,
              inputs_need_grad=False, force_rebind=False, shared_module=None,
              grad_req="write"):
         if self.binded and not force_rebind:
-            self.logger.warning("Already binded, ignoring bind()")
+            self.logger.warning("Module is already bound; ignoring bind()")
             return
         if inputs_need_grad:
             assert for_training
-        assert shared_module is None, "Shared module is not supported"
-        assert len(self._modules) > 0, "Attempting to bind an empty " \
-            "SequentialModule"
+        assert shared_module is None, \
+            "SequentialModule does not support shared_module"
+        assert self._stages, "no stages added"
 
         self.binded = True
         self.for_training = for_training
         self.inputs_need_grad = inputs_need_grad
-        self._label_shapes = label_shapes
 
-        my_data_shapes = data_shapes
-        anybody_ever_needs_label = False
-        for i_layer, module in enumerate(self._modules):
-            meta = self._metas[i_layer]
-            if self.META_TAKE_LABELS in meta and meta[self.META_TAKE_LABELS]:
-                my_label_shapes = label_shapes
-                anybody_ever_needs_label = True
-            else:
-                my_label_shapes = None
-
-            my_inputs_need_grad = bool(for_training and (
-                inputs_need_grad or i_layer > 0))
-
+        flowing = list(data_shapes)
+        labels_used = False
+        for i, (module, meta) in enumerate(self._stages):
+            takes_labels = meta.get(self.META_TAKE_LABELS, False)
+            labels_used |= takes_labels
             if meta.get(self.META_AUTO_WIRING, False):
-                data_names = module.data_names
-                assert len(data_names) == len(my_data_shapes)
-                my_data_shapes = [DataDesc(new_name, shape)
-                                  for (new_name, (_, shape)) in
-                                  zip(data_names,
-                                      [(d.name, d.shape)
-                                       for d in my_data_shapes])]
+                # rename the flowing outputs to this stage's input names
+                names = module.data_names
+                assert len(names) == len(flowing)
+                flowing = [DataDesc(nm, d.shape)
+                           for nm, d in zip(names, flowing)]
+            module.bind(
+                data_shapes=flowing,
+                label_shapes=label_shapes if takes_labels else None,
+                for_training=for_training,
+                # interior stages must produce input grads to keep the
+                # chain rule flowing backward
+                inputs_need_grad=bool(for_training and
+                                      (inputs_need_grad or i > 0)),
+                force_rebind=force_rebind, grad_req=grad_req)
+            flowing = [DataDesc(nm, shape)
+                       for nm, shape in module.output_shapes]
 
-            module.bind(data_shapes=my_data_shapes,
-                        label_shapes=my_label_shapes,
-                        for_training=for_training,
-                        inputs_need_grad=my_inputs_need_grad,
-                        force_rebind=force_rebind, shared_module=None,
-                        grad_req=grad_req)
-            my_data_shapes = [DataDesc(name, shape) for (name, shape) in
-                              module.output_shapes]
+        self._label_shapes = label_shapes if labels_used else None
 
-        if not anybody_ever_needs_label:
-            self._label_shapes = None
-
+    # --------------------------------------------------------- optimizer
     def init_optimizer(self, kvstore="local", optimizer="sgd",
                        optimizer_params=(("learning_rate", 0.01),),
                        force_init=False):
         assert self.binded and self.params_initialized
         if self.optimizer_initialized and not force_init:
-            self.logger.warning("optimizer already initialized, ignoring.")
+            self.logger.warning("optimizer is already initialized; "
+                                "ignoring init_optimizer()")
             return
-        for module in self._modules:
+        for module, _ in self._stages:
             module.init_optimizer(kvstore=kvstore, optimizer=optimizer,
                                   optimizer_params=optimizer_params,
                                   force_init=force_init)
         self.optimizer_initialized = True
 
+    # -------------------------------------------------------- train step
     def forward(self, data_batch, is_train=None):
         assert self.binded and self.params_initialized
-        import copy
-        data_batch = copy.copy(data_batch)
-        for i_layer, module in enumerate(self._modules):
-            module.forward(data_batch, is_train=is_train)
-            if i_layer + 1 == len(self._modules):
+        batch = copy.copy(data_batch)
+        for i, (module, _) in enumerate(self._stages):
+            module.forward(batch, is_train=is_train)
+            if i + 1 == len(self._stages):
                 break
-            data_batch.data = module.get_outputs()
-            if hasattr(data_batch, "provide_data"):
-                data_batch.provide_data = [
-                    DataDesc(name, out.shape) for name, out in
-                    zip(module.output_names, module.get_outputs())]
+            outs = module.get_outputs()
+            batch.data = outs
+            if hasattr(batch, "provide_data"):
+                batch.provide_data = [
+                    DataDesc(nm, o.shape)
+                    for nm, o in zip(module.output_names, outs)]
 
     def backward(self, out_grads=None):
         assert self.binded and self.params_initialized
-        for i_layer, module in reversed(list(enumerate(self._modules))):
+        for i in reversed(range(len(self._stages))):
+            module = self._stages[i][0]
             module.backward(out_grads=out_grads)
-            if i_layer == 0:
+            if i == 0:
                 break
             out_grads = module.get_input_grads()
 
     def update(self):
         assert self.binded and self.params_initialized and \
             self.optimizer_initialized
-        for module in self._modules:
+        for module, _ in self._stages:
             module.update()
 
     def get_outputs(self, merge_multi_context=True):
         assert self.binded and self.params_initialized
-        return self._modules[-1].get_outputs(merge_multi_context)
+        return self._stages[-1][0].get_outputs(merge_multi_context)
 
     def get_input_grads(self, merge_multi_context=True):
         assert self.binded and self.params_initialized and \
             self.inputs_need_grad
-        return self._modules[0].get_input_grads(merge_multi_context)
+        return self._stages[0][0].get_input_grads(merge_multi_context)
 
     def update_metric(self, eval_metric, labels):
         assert self.binded and self.params_initialized
-        for meta, module in zip(self._metas, self._modules):
+        for module, meta in self._stages:
             if meta.get(self.META_TAKE_LABELS, False):
                 module.update_metric(eval_metric, labels)
 
     def install_monitor(self, mon):
         assert self.binded
-        for module in self._modules:
+        for module, _ in self._stages:
             module.install_monitor(mon)

@@ -1,9 +1,16 @@
-"""Monitor: per-op tensor statistics for debugging.
+"""Monitor: per-op tensor statistics for debugging training.
 
-reference: python/mxnet/monitor.py + the C-level output callback
-(graph_executor.cc:758-778 ExecuteMonCallback). Here the executor's
-monitor callback taps outputs after each materialization; ``install``
-registers on an Executor, ``tic``/``toc`` collect stats.
+API parity with reference python/mxnet/monitor.py backed by this
+framework's per-op tap: installing a monitor switches the executor's
+forward pass to eager per-node dispatch so *every* operator output is
+observed (the analog of graph_executor.cc:758-778 ExecuteMonCallback),
+not just the graph outputs. Weights are sampled at ``toc`` time.
+
+Usage (same as the reference)::
+
+    mon = Monitor(interval=2, pattern=".*fc.*")
+    mod.fit(..., monitor=mon)        # or mon.install(executor)
+    # per interval: mon.tic() before forward, mon.toc_print() after
 """
 from __future__ import annotations
 
@@ -15,66 +22,66 @@ from . import ndarray as nd
 
 __all__ = ["Monitor"]
 
+log = logging.getLogger(__name__)
+
+
+def _abs_mean(x):
+    """Default statistic: mean of |x| — cheap and NaN-revealing."""
+    return nd.abs(x).asnumpy().mean()
+
 
 class Monitor:
+    """Collects ``stat_func`` over op outputs (and weights) every
+    ``interval`` training steps, for tensor names matching ``pattern``."""
+
     def __init__(self, interval, stat_func=None, pattern=".*", sort=False):
-        if stat_func is None:
-            def asum_stat(x):
-                return nd.abs(x).asnumpy().mean()
-            stat_func = asum_stat
-        self.stat_func = stat_func
         self.interval = interval
-        self.activated = False
-        self.queue = []
-        self.step = 0
-        self.exes = []
-        self.re_prog = re.compile(pattern)
+        self.stat_func = stat_func or _abs_mean
         self.sort = sort
+        self._pattern = re.compile(pattern)
+        self._executors = []
+        self._records = []       # (step, tensor_name, stat)
+        self._step = 0
+        self._recording = False
 
-        def stat_helper(name, arr):
-            if not self.activated or not self.re_prog.match(name):
-                return
-            self.queue.append((self.step, name, self.stat_func(arr)))
-        self.stat_helper = stat_helper
+    # the executor calls this for every op output while recording
+    def _observe(self, name, array):
+        if self._recording and self._pattern.match(name):
+            self._records.append((self._step, name, self.stat_func(array)))
 
-    def install_exe(self, exe):
-        exe.set_monitor_callback(self.stat_helper)
-        self.exes.append(exe)
-
-    # Module calls install via its executor group
     def install(self, exe):
-        self.install_exe(exe)
+        """Attach to an Executor (Module installs on its sharded exec)."""
+        exe.set_monitor_callback(self._observe)
+        self._executors.append(exe)
+
+    # reference spelling
+    install_exe = install
 
     def tic(self):
-        if self.step % self.interval == 0:
-            for exe in self.exes:
-                for array in exe.arg_arrays:
-                    array.wait_to_read()
-            self.queue = []
-            self.activated = True
-        self.step += 1
+        """Start a recording window if this step is on the interval."""
+        if self._step % self.interval == 0:
+            self._records = []
+            self._recording = True
+        self._step += 1
 
     def toc(self):
-        if not self.activated:
+        """Close the window; returns [(step, name, stat_str)] collected."""
+        if not self._recording:
             return []
-        for exe in self.exes:
-            for array in exe.arg_arrays:
-                array.wait_to_read()
-        for exe in self.exes:
-            for name, array in zip(exe.arg_names, exe.arg_arrays):
-                if self.re_prog.match(name):
-                    self.queue.append((self.step, name,
-                                       self.stat_func(array)))
-        self.activated = False
-        res = []
-        if self.sort:
-            self.queue.sort(key=lambda x: x[1])
-        for n, k, v_list in self.queue:
-            res.append((n, k, str(v_list)))
-        self.queue = []
-        return res
+        self._recording = True
+        # sample bound weights too, like the reference toc does
+        for exe in self._executors:
+            for name, arr in zip(exe.arg_names, exe.arg_arrays):
+                if arr is not None and self._pattern.match(name):
+                    self._records.append(
+                        (self._step, name, self.stat_func(arr)))
+        self._recording = False
+        out = sorted(self._records, key=lambda r: r[1]) if self.sort \
+            else list(self._records)
+        self._records = []
+        return [(step, name, str(val)) for step, name, val in out]
 
     def toc_print(self):
-        res = self.toc()
-        for n, k, v in res:
-            logging.info("Batch: %7d %30s %s", n, k, v)
+        """toc() + log each record."""
+        for step, name, val in self.toc():
+            log.info("monitor step %d  %-30s %s", step, name, val)

@@ -1,7 +1,16 @@
-"""Bucketing sequence iterator (reference: python/mxnet/rnn/io.py)."""
+"""Bucketed sequence iterator for variable-length text.
+
+API parity with reference python/mxnet/rnn/io.py (``encode_sentences`` +
+``BucketSentenceIter`` feeding ``BucketingModule``), restructured: one
+flat index of (bucket, row-range) batch slots built once, per-bucket
+storage as padded 2-D arrays, and next-token labels derived by a single
+roll at reset. Sequences are binned to the smallest bucket that fits;
+overflow sequences are dropped (and counted).
+"""
 from __future__ import annotations
 
 import bisect
+import logging
 import random
 
 import numpy as np
@@ -11,99 +20,112 @@ from ..ndarray import array
 
 __all__ = ["BucketSentenceIter", "encode_sentences"]
 
+log = logging.getLogger(__name__)
+
 
 def encode_sentences(sentences, vocab=None, invalid_label=-1,
                      invalid_key="\n", start_label=0):
-    """Map token sequences to int arrays. reference: rnn/io.py:15-50."""
-    idx = start_label
-    if vocab is None:
+    """Integer-encode token sequences, growing ``vocab`` when it's ours.
+
+    Matches reference rnn/io.py:15-50: if ``vocab`` is given, unknown
+    tokens are an error; otherwise a fresh vocabulary is assigned ids
+    from ``start_label``, skipping ``invalid_label``.
+    """
+    grow = vocab is None
+    if grow:
         vocab = {invalid_key: invalid_label}
-        new_vocab = True
-    else:
-        new_vocab = False
-    res = []
+    next_id = start_label
+    encoded = []
     for sent in sentences:
-        coded = []
-        for word in sent:
-            if word not in vocab:
-                assert new_vocab, f"Unknown token {word}"
-                if idx == invalid_label:
-                    idx += 1
-                vocab[word] = idx
-                idx += 1
-            coded.append(vocab[word])
-        res.append(coded)
-    return res, vocab
+        row = []
+        for tok in sent:
+            if tok not in vocab:
+                if not grow:
+                    raise KeyError(f"token {tok!r} not in the given vocab")
+                if next_id == invalid_label:
+                    next_id += 1
+                vocab[tok] = next_id
+                next_id += 1
+            row.append(vocab[tok])
+        encoded.append(row)
+    return encoded, vocab
 
 
 class BucketSentenceIter(DataIter):
-    """reference: rnn/io.py:52-168."""
+    """Serve fixed-shape (batch, bucket_len) slices of padded sequences,
+    one bucket per batch, with next-token labels."""
 
     def __init__(self, sentences, batch_size, buckets=None, invalid_label=-1,
-                 data_name="data", label_name="softmax_label", dtype="float32"):
+                 data_name="data", label_name="softmax_label",
+                 dtype="float32"):
         super().__init__(batch_size)
         if not buckets:
-            buckets = [i for i, j in enumerate(
-                np.bincount([len(s) for s in sentences]))
-                if j >= batch_size]
-        buckets.sort()
-        ndiscard = 0
-        self.data = [[] for _ in buckets]
-        for sent in sentences:
-            buck = bisect.bisect_left(buckets, len(sent))
-            if buck == len(buckets):
-                ndiscard += 1
-                continue
-            buff = np.full((buckets[buck],), invalid_label, dtype=dtype)
-            buff[:len(sent)] = sent
-            self.data[buck].append(buff)
-        self.data = [np.asarray(i, dtype=dtype) for i in self.data]
-
+            # default policy: one bucket per length that has at least a
+            # full batch of examples
+            counts = np.bincount([len(s) for s in sentences])
+            buckets = [length for length, c in enumerate(counts)
+                       if c >= batch_size]
+        self.buckets = sorted(buckets)
         self.batch_size = batch_size
-        self.buckets = buckets
+        self.invalid_label = invalid_label
         self.data_name = data_name
         self.label_name = label_name
         self.dtype = dtype
-        self.invalid_label = invalid_label
-        self.nddata = []
-        self.ndlabel = []
-        self.major_axis = 0
-        self.default_bucket_key = max(buckets)
-        self.provide_data = [DataDesc(
-            data_name, (batch_size, self.default_bucket_key))]
-        self.provide_label = [DataDesc(
-            label_name, (batch_size, self.default_bucket_key))]
-        self.idx = []
-        for i, buck in enumerate(self.data):
-            self.idx.extend([(i, j) for j in
-                             range(0, len(buck) - batch_size + 1,
-                                   batch_size)])
-        self.curr_idx = 0
+        self.major_axis = 0  # NT layout
+
+        # bin sentences into per-bucket padded matrices
+        rows = [[] for _ in self.buckets]
+        dropped = 0
+        for sent in sentences:
+            b = bisect.bisect_left(self.buckets, len(sent))
+            if b == len(self.buckets):
+                dropped += 1
+                continue
+            padded = np.full(self.buckets[b], invalid_label, dtype=dtype)
+            padded[:len(sent)] = sent
+            rows[b].append(padded)
+        if dropped:
+            log.warning("BucketSentenceIter: dropped %d sequences longer "
+                        "than the largest bucket (%d)", dropped,
+                        self.buckets[-1])
+        self._bucket_data = [
+            np.asarray(r, dtype=dtype).reshape(-1, blen)
+            for r, blen in zip(rows, self.buckets)]
+
+        # one slot per full batch within each bucket
+        self._slots = [(b, start)
+                       for b, mat in enumerate(self._bucket_data)
+                       for start in range(0, len(mat) - batch_size + 1,
+                                          batch_size)]
+        self._cursor = 0
+
+        self.default_bucket_key = self.buckets[-1]
+        self.provide_data = [
+            DataDesc(data_name, (batch_size, self.default_bucket_key))]
+        self.provide_label = [
+            DataDesc(label_name, (batch_size, self.default_bucket_key))]
         self.reset()
 
     def reset(self):
-        self.curr_idx = 0
-        random.shuffle(self.idx)
-        for buck in self.data:
-            np.random.shuffle(buck)
-        self.nddata = []
-        self.ndlabel = []
-        for buck in self.data:
-            label = np.empty_like(buck)
-            label[:, :-1] = buck[:, 1:]
-            label[:, -1] = self.invalid_label
-            self.nddata.append(array(buck, dtype=self.dtype))
-            self.ndlabel.append(array(label, dtype=self.dtype))
+        self._cursor = 0
+        random.shuffle(self._slots)
+        self._nd_data, self._nd_label = [], []
+        for mat in self._bucket_data:
+            np.random.shuffle(mat)
+            # label = input shifted left one step; tail padded invalid
+            lab = np.roll(mat, -1, axis=1)
+            lab[:, -1] = self.invalid_label
+            self._nd_data.append(array(mat, dtype=self.dtype))
+            self._nd_label.append(array(lab, dtype=self.dtype))
 
     def next(self):
-        if self.curr_idx == len(self.idx):
+        if self._cursor >= len(self._slots):
             raise StopIteration
-        i, j = self.idx[self.curr_idx]
-        self.curr_idx += 1
-        data = self.nddata[i][j:j + self.batch_size]
-        label = self.ndlabel[i][j:j + self.batch_size]
-        return DataBatch([data], [label], pad=0,
-                         bucket_key=self.buckets[i],
-                         provide_data=[DataDesc(self.data_name, data.shape)],
-                         provide_label=[DataDesc(self.label_name,
-                                                 label.shape)])
+        b, start = self._slots[self._cursor]
+        self._cursor += 1
+        data = self._nd_data[b][start:start + self.batch_size]
+        label = self._nd_label[b][start:start + self.batch_size]
+        return DataBatch(
+            [data], [label], pad=0, bucket_key=self.buckets[b],
+            provide_data=[DataDesc(self.data_name, data.shape)],
+            provide_label=[DataDesc(self.label_name, label.shape)])

@@ -102,7 +102,9 @@ def test_lr_scheduler():
     state = opt.create_state(0, w)
     for _ in range(25):
         opt.update(0, w, mx.nd.array(np.ones(1, dtype=np.float32)), state)
-    assert sched.base_lr < 1.0
+    # after 25 updates with step=10 the rate has decayed twice
+    assert sched(25) == 0.25
+    assert sched(5) == 1.0  # stateless: earlier queries still exact
     multi = mx.lr_scheduler.MultiFactorScheduler(step=[5, 15], factor=0.1)
     multi.base_lr = 1.0
     assert multi(20) < 1.0
