@@ -43,7 +43,7 @@ from . import random as _random
 __all__ = ["Executor"]
 
 
-def _build_graph_runner(symbol, shape_overrides=None, tap=None):
+def _build_graph_runner(symbol, shape_overrides=None, tap=None, mp_plan=None):
     """Close the symbol graph into run(arg_vals, aux_vals, is_train, rng).
 
     Returns (runner, arg_names, aux_names, loss_mask). The runner is pure:
@@ -58,6 +58,11 @@ def _build_graph_runner(symbol, shape_overrides=None, tap=None):
     every non-variable node (the analog of the reference's per-op monitor
     callback, graph_executor.cc:758-778). Only meaningful when the runner
     executes un-jitted (eager per-op dispatch).
+
+    ``mp_plan`` — optional ModelParallelPlan (parallel/placement.py): its
+    boundary constraints are applied to cross-ctx_group edges, lowering
+    the reference's PlaceDevice/_CrossDeviceCopy onto sharding
+    constraints that XLA turns into collectives.
     """
     nodes = symbol._topo_nodes()
     node_index = {id(n): i for i, n in enumerate(nodes)}
@@ -92,6 +97,8 @@ def _build_graph_runner(symbol, shape_overrides=None, tap=None):
                 if opdef.need_rng else None
             outs, aux_out = opdef.forward(attrs, regular, aux,
                                           is_train, krng)
+            if mp_plan is not None:
+                outs = mp_plan.constrain(id(node), outs)
             vals[id(node)] = outs
             if tap is not None:
                 tap(node, outs)
@@ -140,13 +147,39 @@ class Executor:
         except MXNetError:
             pass
 
+        # model parallelism: ctx_group tags + group2ctx -> mesh shardings
+        # (reference AssignContext/PlaceDevice, graph_executor.cc:242-331)
+        self._mp_plan = None
+        if self._group2ctx:
+            from .parallel.placement import build_plan
+            shapes_by_name = {nm: tuple(a.shape)
+                              for nm, a in zip(arg_names_all, self.arg_arrays)
+                              if a is not None}
+            self._mp_plan = build_plan(symbol, self._group2ctx,
+                                       shapes_by_name)
+
         self._shape_overrides = shape_overrides
         self._runner, self.arg_names, self.aux_names, self._loss_mask = \
-            _build_graph_runner(symbol, shape_overrides)
+            _build_graph_runner(symbol, shape_overrides,
+                                mp_plan=self._mp_plan)
         self.aux_arrays = self._normalize_args(aux_states, self.aux_names,
                                                "aux_states", allow_none=True)
         self.grad_req = self._normalize_req(grad_req)
         self.grad_arrays = self._normalize_grads(args_grad)
+
+        if self._mp_plan is not None:
+            # re-place every bound array per the plan (params sharded over
+            # the model axis, the rest replicated across the mesh)
+            for nm, arr in zip(self.arg_names, self.arg_arrays):
+                if arr is not None:
+                    arr._set(self._mp_plan.place(nm, arr.asjax()))
+            for nm, arr in zip(self.arg_names, self.grad_arrays):
+                if arr is not None:
+                    arr._set(self._mp_plan.place(nm, arr.asjax()))
+            for arr in self.aux_arrays:
+                if arr is not None:
+                    arr._set(jax.device_put(arr.asjax(),
+                                            self._mp_plan.replicated))
 
         # compiled program cache: (kind, ) -> jitted fn
         self._jit_cache = {}
@@ -303,7 +336,8 @@ class Executor:
                     cb(nm, NDArray(o, ctx=self._ctx))
 
             runner, *_ = _build_graph_runner(self._symbol,
-                                             self._shape_overrides, tap=tap)
+                                             self._shape_overrides, tap=tap,
+                                             mp_plan=self._mp_plan)
             outs, new_aux = runner(self._arg_vals(), self._aux_vals(),
                                    kind == "fwd_train", rng)
             self._finish(outs, new_aux, monitored=True)

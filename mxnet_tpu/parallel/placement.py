@@ -1,0 +1,108 @@
+"""Model parallelism: lower ctx_group annotations onto mesh shardings.
+
+Reference mechanism (reference: src/executor/graph_executor.cc:242-331
+``AssignContext``): ``with AttrScope(ctx_group='g')`` tags nodes, bind's
+``group2ctx={'g': ctx}`` maps groups to devices, the PlaceDevice pass
+pins ops and inserts ``_CrossDeviceCopy`` at boundaries
+(example/model-parallel-lstm/lstm.py:48-112).
+
+TPU-native lowering — there is no per-op device pinning in SPMD/XLA;
+the mesh equivalent is *parameter sharding*: the devices named by
+``group2ctx`` become a 1-D ``model`` mesh axis, every parameter tagged
+with a ctx_group is sharded across that axis along its largest divisible
+dimension, and activations crossing a group boundary get a replication
+constraint (``lax.with_sharding_constraint`` — the compiler inserts the
+all-gather that replaces ``_CrossDeviceCopy``). XLA then partitions one
+program over all the devices, which both distributes the memory the way
+the reference's layer placement did and overlaps the per-group compute.
+
+Numerics are unchanged by construction — shardings never alter values —
+which is exactly the reference's contract for moving a model from one
+GPU to several.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["ModelParallelPlan", "build_plan"]
+
+
+class ModelParallelPlan:
+    """Shardings derived from (symbol, group2ctx) for one executor."""
+
+    def __init__(self, mesh, param_shardings, boundary_nodes, replicated):
+        self.mesh = mesh
+        self.param_shardings = param_shardings   # arg name -> NamedSharding
+        self.boundary_nodes = boundary_nodes     # id(node) -> NamedSharding
+        self.replicated = replicated             # NamedSharding, P()
+
+    def place(self, name, value):
+        """Device-put an arg/aux value according to the plan."""
+        sh = self.param_shardings.get(name, self.replicated)
+        return jax.device_put(value, sh)
+
+    def constrain(self, node_id, arrays):
+        """Apply the boundary (cross-group) replication constraint."""
+        sh = self.boundary_nodes.get(node_id)
+        if sh is None:
+            return arrays
+        return [jax.lax.with_sharding_constraint(a, sh) for a in arrays]
+
+
+def _shard_spec(shape, n_dev, axis_name="model"):
+    """Shard the largest divisible dim over the model axis, else replicate."""
+    dims = sorted(range(len(shape)), key=lambda i: -shape[i])
+    for i in dims:
+        if shape[i] % n_dev == 0 and shape[i] >= n_dev:
+            spec = [None] * len(shape)
+            spec[i] = axis_name
+            return P(*spec)
+    return P()
+
+
+def build_plan(symbol, group2ctx, arg_shapes_by_name):
+    """Build a ModelParallelPlan, or None when group2ctx is empty/unused.
+
+    ``group2ctx``: dict group-name -> Context; the distinct devices (in
+    group-name order) form the model axis. Nodes/params without a
+    ctx_group ride along replicated.
+    """
+    if not group2ctx:
+        return None
+    nodes = symbol._topo_nodes()
+    grouped = [n for n in nodes if n._extra.get("ctx_group")]
+    if not grouped:
+        return None
+
+    devices, seen = [], set()
+    for g in sorted(group2ctx):
+        dev = group2ctx[g].jax_device()
+        if id(dev) not in seen:
+            seen.add(id(dev))
+            devices.append(dev)
+    mesh = Mesh(np.array(devices), ("model",))
+    n_dev = len(devices)
+    replicated = NamedSharding(mesh, P())
+
+    param_shardings = {}
+    for node in nodes:
+        if not node.is_variable or not node._extra.get("ctx_group"):
+            continue
+        shape = arg_shapes_by_name.get(node.name)
+        if shape is None:
+            continue
+        param_shardings[node.name] = NamedSharding(
+            mesh, _shard_spec(shape, n_dev))
+
+    # cross-group edges: the producer's outputs must be gathered before a
+    # different group consumes them (the _CrossDeviceCopy analog)
+    boundary = {}
+    for node in nodes:
+        g_self = node._extra.get("ctx_group")
+        for inp, _ in node.inputs:
+            g_in = inp._extra.get("ctx_group")
+            if g_in is not None and g_in != g_self and not inp.is_variable:
+                boundary[id(inp)] = replicated
+    return ModelParallelPlan(mesh, param_shardings, boundary, replicated)
