@@ -1,0 +1,263 @@
+"""CustomOp: python-defined operators inside nd and sym graphs.
+
+Reference mechanism (reference: python/mxnet/operator.py:396-660 +
+src/operator/custom/custom.cc): a ``CustomOpProp`` subclass registered
+under a name; the graph node ``Custom(op_type=name)`` calls back into
+python for forward/backward, executed as ``kAsync`` engine callbacks.
+
+TPU-native bridge: the python body runs on host via
+``jax.pure_callback`` — inside jitted graphs XLA inserts the host
+round-trip at exactly this op, while everything around it stays fused on
+device. The declared backward is wired through ``jax.custom_vjp`` so
+``jax.vjp`` of the whole graph (our Gradient pass) flows through the
+python ``backward``. SURVEY.md §7 M6 names this mapping.
+
+Usage is reference-identical::
+
+    @mx.operator.register("mysigmoid")
+    class MySigmoidProp(mx.operator.CustomOpProp):
+        def list_arguments(self): return ['data']
+        def infer_shape(self, in_shape): return in_shape, [in_shape[0]], []
+        def create_operator(self, ctx, shapes, dtypes):
+            return MySigmoid()
+
+    y = mx.nd.Custom(x, op_type="mysigmoid")
+    s = mx.sym.Custom(data, op_type="mysigmoid", name="sig")
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .base import MXNetError
+from .ops.registry import register as _register_op
+
+__all__ = ["CustomOp", "CustomOpProp", "register", "get_all_registered"]
+
+_CUSTOM_PROPS: dict = {}
+
+
+class CustomOp:
+    """Base class for python operator bodies (forward/backward on host)."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        """Compute outputs; write them with ``self.assign(out_data[i],
+        req[i], value)``."""
+        raise NotImplementedError
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        """Compute input gradients into ``in_grad`` (default: zero)."""
+        for i, g in enumerate(in_grad):
+            self.assign(g, req[i] if i < len(req) else "write",
+                        np.zeros_like(g.asnumpy()))
+
+    @staticmethod
+    def assign(dst, req, src):
+        """Write ``src`` into the NDArray ``dst`` honoring the req."""
+        if req == "null":
+            return
+        from .ndarray import NDArray
+        val = src.asnumpy() if isinstance(src, NDArray) else np.asarray(src)
+        if req in ("write", "inplace"):
+            dst._set(jnp.asarray(val.reshape(dst.shape), dtype=dst.dtype))
+        elif req == "add":
+            dst._set(dst.asjax() + jnp.asarray(val.reshape(dst.shape),
+                                               dtype=dst.dtype))
+        else:
+            raise MXNetError(f"unknown req {req!r}")
+
+
+class CustomOpProp:
+    """Declarative metadata for a CustomOp (names, shapes, factory)."""
+
+    def __init__(self, need_top_grad=False):
+        self.need_top_grad_ = need_top_grad
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def list_auxiliary_states(self):
+        return []
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]], []
+
+    def infer_type(self, in_type):
+        return (in_type, [in_type[0]] * len(self.list_outputs()),
+                [in_type[0]] * len(self.list_auxiliary_states()))
+
+    def need_top_grad(self):
+        return self.need_top_grad_
+
+    def declare_backward_dependency(self, out_grad, in_data, out_data):
+        deps = []
+        if self.need_top_grad_:
+            deps.extend(out_grad)
+        deps.extend(in_data)
+        deps.extend(out_data)
+        return deps
+
+    def create_operator(self, ctx, in_shapes, in_dtypes=None):
+        return CustomOp()
+
+
+def register(reg_name):
+    """Class decorator registering a CustomOpProp under ``reg_name``."""
+    def deco(prop_cls):
+        if not issubclass(prop_cls, CustomOpProp):
+            raise MXNetError("register() expects a CustomOpProp subclass")
+        _CUSTOM_PROPS[reg_name] = prop_cls
+        return prop_cls
+    return deco
+
+
+def get_all_registered():
+    return dict(_CUSTOM_PROPS)
+
+
+# ---------------------------------------------------------------- plumbing
+def _prop_for(attrs):
+    op_type = attrs.get("op_type")
+    if op_type is None:
+        raise MXNetError("Custom requires op_type=")
+    try:
+        cls = _CUSTOM_PROPS[op_type]
+    except KeyError:
+        raise MXNetError(
+            f"no CustomOpProp registered as {op_type!r} "
+            f"(registered: {sorted(_CUSTOM_PROPS)})") from None
+    kwargs = {k: str(v) for k, v in attrs.items()
+              if k not in ("op_type",) and not k.startswith("__")}
+    return cls(**kwargs)
+
+
+def _nd(arrays):
+    from .ndarray import NDArray
+    return [NDArray(jnp.asarray(a)) for a in arrays]
+
+
+def _run_forward_host(prop, is_train, n_in, *host_arrays):
+    """Host-side forward: build NDArray cells, run the user's CustomOp."""
+    in_data = _nd(host_arrays[:n_in])
+    aux = _nd(host_arrays[n_in:])
+    in_shapes = [list(a.shape) for a in in_data]
+    _, out_shapes, _ = prop.infer_shape(in_shapes)
+    _, out_types, _ = prop.infer_type(
+        [np.dtype(a.dtype) for a in in_data] or [np.dtype(np.float32)])
+    out_data = _nd([np.zeros(tuple(s), dt)
+                    for s, dt in zip(out_shapes, out_types)])
+    op = prop.create_operator(None, in_shapes,
+                              [np.dtype(a.dtype) for a in in_data])
+    op.forward(bool(is_train), ["write"] * len(out_data), in_data,
+               out_data, aux)
+    return tuple(o.asnumpy() for o in out_data)
+
+
+def _run_backward_host(prop, n_in, n_out, n_aux, *host_arrays):
+    """Host-side backward: out_grads + saved (in, out, aux) -> in_grads."""
+    k = 0
+    out_grad = _nd(host_arrays[k:k + n_out]); k += n_out
+    in_data = _nd(host_arrays[k:k + n_in]); k += n_in
+    out_data = _nd(host_arrays[k:k + n_out]); k += n_out
+    aux = _nd(host_arrays[k:k + n_aux])
+    in_grad = _nd([np.zeros(a.shape, a.dtype) for a in in_data])
+    op = prop.create_operator(None, [list(a.shape) for a in in_data],
+                              [np.dtype(a.dtype) for a in in_data])
+    op.backward(["write"] * len(in_grad), out_grad, in_data, out_data,
+                in_grad, aux)
+    return tuple(g.asnumpy() for g in in_grad)
+
+
+@functools.lru_cache(maxsize=None)
+def _custom_call(attrs_key, is_train):
+    """Build (once per attrs/is_train) the custom_vjp'd jax function."""
+    attrs = dict(attrs_key)
+    prop = _prop_for(attrs)
+    n_in = len(prop.list_arguments())
+    n_out = len(prop.list_outputs())
+    n_aux = len(prop.list_auxiliary_states())
+
+    def out_struct(inputs):
+        in_shapes = [list(np.shape(a)) for a in inputs]
+        _, out_shapes, _ = prop.infer_shape(in_shapes)
+        _, out_types, _ = prop.infer_type(
+            [np.dtype(a.dtype) for a in inputs] or [np.dtype(np.float32)])
+        return tuple(jax.ShapeDtypeStruct(tuple(s), dt)
+                     for s, dt in zip(out_shapes, out_types))
+
+    @jax.custom_vjp
+    def call(inputs, aux):
+        return jax.pure_callback(
+            functools.partial(_run_forward_host, prop, is_train, n_in),
+            out_struct(inputs), *inputs, *aux)
+
+    def call_fwd(inputs, aux):
+        outs = call(inputs, aux)
+        return outs, (inputs, outs, aux)
+
+    def call_bwd(res, out_grads):
+        inputs, outs, aux = res
+        grad_struct = tuple(
+            jax.ShapeDtypeStruct(np.shape(a), a.dtype) for a in inputs)
+        in_grads = jax.pure_callback(
+            functools.partial(_run_backward_host, prop, n_in, n_out, n_aux),
+            grad_struct, *out_grads, *inputs, *outs, *aux)
+        aux_grads = tuple(jnp.zeros(np.shape(a), a.dtype) for a in aux)
+        return tuple(in_grads), aux_grads
+
+    call.defvjp(call_fwd, call_bwd)
+    return call, prop
+
+
+def _attrs_key(attrs):
+    return tuple(sorted((k, str(v)) for k, v in attrs.items()
+                        if not k.startswith("__")))
+
+
+def _custom_forward(attrs, inputs, aux, is_train, rng):
+    call, _ = _custom_call(_attrs_key(attrs), bool(is_train))
+    outs = call(tuple(inputs), tuple(aux))
+    return list(outs), list(aux)
+
+
+def _custom_inputs(attrs):
+    return _prop_for(attrs).list_arguments()
+
+
+def _custom_aux(attrs):
+    return _prop_for(attrs).list_auxiliary_states()
+
+
+def _custom_num_outputs(attrs):
+    return len(_prop_for(attrs).list_outputs())
+
+
+def _custom_output_names(attrs):
+    return _prop_for(attrs).list_outputs()
+
+
+def _custom_infer_shape(attrs, in_shapes):
+    prop = _prop_for(attrs)
+    n_in = len(prop.list_arguments())
+    ins = [list(s) if s is not None else None for s in in_shapes[:n_in]]
+    if any(s is None or 0 in s for s in ins):
+        raise MXNetError("Custom op needs complete input shapes")
+    new_in, out_shapes, aux_shapes = prop.infer_shape(ins)
+    return ([tuple(s) for s in new_in],
+            [tuple(s) for s in out_shapes],
+            [tuple(s) for s in (aux_shapes or [])])
+
+
+_register_op("Custom", inputs=_custom_inputs, aux=_custom_aux,
+             num_outputs=_custom_num_outputs,
+             output_names=_custom_output_names,
+             infer_shape=_custom_infer_shape,
+             full=_custom_forward,
+             doc="Python-defined operator (op_type= selects the "
+                 "registered CustomOpProp)")

@@ -5,3 +5,4 @@ from . import nn  # noqa: F401 — registers layer ops
 from . import loss  # noqa: F401 — registers loss heads
 from . import optimizer_op  # noqa: F401 — registers fused updates
 from . import rnn_op  # noqa: F401 — registers the fused RNN
+from .. import operator as _custom_op  # noqa: F401 — registers Custom

@@ -1,0 +1,90 @@
+"""CustomOp bridge: python ops inside nd/sym graphs via pure_callback.
+
+reference behavior: python/mxnet/operator.py:396-660 + the standard
+Softmax CustomOp example (example/numpy-ops/custom_softmax.py) —
+a registered prop must work imperatively, symbolically, and train
+inside Module.fit with gradients flowing through the python backward.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import symbol as sym
+
+
+class _Sigmoid(mx.operator.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        x = in_data[0].asnumpy()
+        self.assign(out_data[0], req[0], 1.0 / (1.0 + np.exp(-x)))
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        y = out_data[0].asnumpy()
+        g = out_grad[0].asnumpy()
+        self.assign(in_grad[0], req[0], g * y * (1.0 - y))
+
+
+@mx.operator.register("test_sigmoid")
+class _SigmoidProp(mx.operator.CustomOpProp):
+    def __init__(self):
+        super().__init__(need_top_grad=True)
+
+    def create_operator(self, ctx, in_shapes, in_dtypes=None):
+        return _Sigmoid()
+
+
+def test_custom_nd():
+    x = mx.nd.array(np.array([[0.0, 1.0], [-1.0, 2.0]], np.float32))
+    y = mx.nd.Custom(x, op_type="test_sigmoid")
+    expect = 1.0 / (1.0 + np.exp(-x.asnumpy()))
+    np.testing.assert_allclose(y.asnumpy(), expect, rtol=1e-6)
+
+
+def test_custom_sym_forward_backward():
+    data = sym.var("data")
+    out = sym.Custom(data, op_type="test_sigmoid", name="sig")
+    exe = out.simple_bind(mx.cpu(), grad_req="write", data=(3, 4))
+    x = np.random.RandomState(0).randn(3, 4).astype(np.float32)
+    exe.arg_dict["data"]._set(x)
+    exe.forward(is_train=True)
+    y = exe.outputs[0].asnumpy()
+    np.testing.assert_allclose(y, 1 / (1 + np.exp(-x)), rtol=1e-6)
+    head = np.ones_like(y)
+    exe.backward([mx.nd.array(head)])
+    np.testing.assert_allclose(exe.grad_dict["data"].asnumpy(),
+                               y * (1 - y), rtol=1e-5)
+
+
+def test_custom_infer_shape_through_graph():
+    data = sym.var("data")
+    net = sym.FullyConnected(data, num_hidden=6, name="fc")
+    net = sym.Custom(net, op_type="test_sigmoid", name="sig")
+    args, outs, _ = net.infer_shape(data=(5, 3))
+    assert outs[0] == (5, 6)
+
+
+def test_custom_trains_in_module():
+    """reference-style gate: a logistic regressor through the python
+    sigmoid must fit a separable blob."""
+    rng = np.random.RandomState(42)
+    n = 200
+    x = rng.randn(n, 2).astype(np.float32)
+    labels = (x[:, 0] + x[:, 1] > 0).astype(np.float32).reshape(-1, 1)
+
+    data = sym.var("data")
+    fc = sym.FullyConnected(data, num_hidden=1, name="fc")
+    s = sym.Custom(fc, op_type="test_sigmoid", name="sig")
+    # logistic loss via LinearRegressionOutput on the sigmoid (grad = p - y)
+    out = sym.LinearRegressionOutput(s, name="lro")
+
+    it = mx.io.NDArrayIter(x, labels, batch_size=20,
+                           label_name="lro_label")
+    mod = mx.mod.Module(out, data_names=("data",),
+                        label_names=("lro_label",), context=mx.cpu())
+    mod.fit(it, num_epoch=10, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.5},
+            eval_metric="mse",
+            initializer=mx.initializer.Uniform(0.5))
+    it.reset()
+    preds = mod.predict(it).asnumpy().ravel()[:n]
+    acc = ((preds > 0.5) == (labels.ravel()[:len(preds)] > 0.5)).mean()
+    assert acc > 0.9, f"custom-op logistic regression accuracy {acc}"
