@@ -22,7 +22,53 @@ __all__ = ["grad_and_loss", "grad", "mark_variables", "backward",
            "train_section", "test_section", "set_is_training",
            "is_training"]
 
-_STATE = {"train": False, "marked": []}
+_STATE = {"train": False}
+_MARKED = {}      # id(var cell) -> (var, grad_cell, req)
+_TAPE = []        # recorded _TapeEntry, in execution order
+
+
+class _TapeEntry:
+    """One recorded imperative op (reference: AGNode, autograd.h).
+
+    Inputs are stored as (cell id, captured value): if the id resolves to
+    a marked variable or an earlier entry's output at replay time the
+    value flows through the graph, otherwise the captured constant is
+    used. Output cells are recorded by id so later entries (and
+    ``backward(outputs)``) can refer to them. ``replay`` is a pure
+    function list-of-arrays -> list-of-arrays.
+    """
+
+    __slots__ = ("replay", "in_ids", "in_consts", "out_ids")
+
+    def __init__(self, replay, in_ids, in_consts, out_ids):
+        self.replay = replay
+        self.in_ids = in_ids
+        self.in_consts = in_consts
+        self.out_ids = out_ids
+
+
+def _record_fn(replay, input_handles, input_vals, output_handles):
+    """Generic tape hook (NDArray operators record through this)."""
+    if not _STATE["train"]:
+        return
+    _TAPE.append(_TapeEntry(replay, [id(h) for h in input_handles],
+                            list(input_vals),
+                            [id(h) for h in output_handles]))
+
+
+def _record(opdef, attrs, input_handles, input_vals, output_handles, rng):
+    """Called by imperative_invoke for every registry op while training."""
+    if not _STATE["train"] or opdef.mutate_inputs:
+        return
+    n_aux = len(opdef.aux_names(attrs))
+
+    def replay(vals):
+        split = len(vals) - n_aux if n_aux else len(vals)
+        outs, _ = opdef.forward(attrs, vals[:split], vals[split:],
+                                True, rng)
+        return outs
+
+    _record_fn(replay, input_handles, input_vals, output_handles)
 
 
 def set_is_training(is_train):
@@ -62,7 +108,8 @@ def mark_variables(variables, gradients, grad_reqs="write"):
         gradients = [gradients]
     if isinstance(grad_reqs, str):
         grad_reqs = [grad_reqs] * len(variables)
-    _STATE["marked"] = list(zip(variables, gradients, grad_reqs))
+    for var, grad_cell, req in zip(variables, gradients, grad_reqs):
+        _MARKED[id(var)] = (var, grad_cell, req)
 
 
 def grad_and_loss(func, argnum=None):
@@ -109,11 +156,58 @@ def grad(func, argnum=None):
 
 
 def backward(outputs, out_grads=None, retain_graph=False):
-    """Compute gradients of marked variables w.r.t. outputs produced by
-    ``compute``-style closures. In this framework the recommended API is
-    grad_and_loss; this shim supports simple marked-variable use where the
-    forward is re-traced."""
-    raise MXNetError(
-        "imperative backward() requires the taped-execution mode; use "
-        "autograd.grad_and_loss(func)(args) which differentiates the "
-        "function directly via jax.vjp")
+    """Differentiate taped imperative work back to the marked variables.
+
+    reference: contrib/autograd.py backward -> AutogradRuntime::
+    ComputeGradient (autograd.cc:132-188), which rebuilds a graph from
+    the tape and runs a GraphExecutor backward. Here the tape replays as
+    a pure jax function of the marked leaves and ``jax.vjp`` produces the
+    gradients, which land in the buffers given to ``mark_variables``
+    honoring each req (write/add/null).
+    """
+    if isinstance(outputs, NDArray):
+        outputs = [outputs]
+    if out_grads is not None and isinstance(out_grads, NDArray):
+        out_grads = [out_grads]
+    if not _TAPE:
+        raise MXNetError(
+            "no imperative ops were recorded — run the computation inside "
+            "a train_section() with variables marked first")
+
+    tape = list(_TAPE)
+    leaves = {vid: var.asjax() for vid, (var, _, _) in _MARKED.items()}
+    leaf_ids = list(leaves)
+    out_ids = [id(o) for o in outputs]
+
+    def replay(leaf_vals):
+        env = dict(zip(leaf_ids, leaf_vals))
+        for e in tape:
+            vals = [env.get(i, c) for i, c in zip(e.in_ids, e.in_consts)]
+            outs = e.replay(vals)
+            for oid, val in zip(e.out_ids, outs):
+                env[oid] = val
+        missing = [i for i in out_ids if i not in env]
+        if missing:
+            raise MXNetError(
+                "backward() got outputs that were not produced by recorded "
+                "ops in this train_section")
+        return [env[i] for i in out_ids]
+
+    out_vals, vjp_fn = jax.vjp(replay, list(leaves.values()))
+    if out_grads is None:
+        heads = [jnp.ones_like(o) for o in out_vals]
+    else:
+        heads = [g.asjax() if isinstance(g, NDArray) else jnp.asarray(g)
+                 for g in out_grads]
+    (leaf_grads,) = vjp_fn(heads)
+
+    for vid, g in zip(leaf_ids, leaf_grads):
+        _, grad_cell, req = _MARKED[vid]
+        if req == "null" or grad_cell is None:
+            continue
+        if req == "add":
+            grad_cell._set(grad_cell.asjax() + g.astype(grad_cell.dtype))
+        else:
+            grad_cell._set(g.astype(grad_cell.dtype))
+    if not retain_graph:
+        _TAPE.clear()

@@ -186,9 +186,13 @@ class NDArray:
     # ----------------------------------------------------------- arithmetic
     def _binary(self, other, fn, rfn=None):
         if isinstance(other, NDArray):
-            return NDArray(fn(self._data, other._data), ctx=self._ctx)
+            out = NDArray(fn(self._data, other._data), ctx=self._ctx)
+            _maybe_tape(fn, [self, other], out)
+            return out
         if isinstance(other, (int, float, np.generic)):
-            return NDArray(fn(self._data, other), ctx=self._ctx)
+            out = NDArray(fn(self._data, other), ctx=self._ctx)
+            _maybe_tape(lambda a, _o=other: fn(a, _o), [self], out)
+            return out
         return NotImplemented
 
     def __add__(self, o): return self._binary(o, jnp.add)
@@ -229,6 +233,15 @@ class NDArray:
     def __lt__(self, o): return self._binary(o, lambda a, b: (a < b).astype(a.dtype))
     def __le__(self, o): return self._binary(o, lambda a, b: (a <= b).astype(a.dtype))
     __hash__ = object.__hash__
+
+
+def _maybe_tape(fn, input_handles, out_handle):
+    """Record an NDArray operator on the autograd tape while training."""
+    from . import autograd as _ag
+    if not _ag._STATE["train"]:
+        return
+    _ag._record_fn(lambda vals: [fn(*vals)], input_handles,
+                   [h.asjax() for h in input_handles], [out_handle])
 
 
 def _placement_matches(data, ctx):
@@ -442,6 +455,11 @@ def imperative_invoke(op_name, *inputs, out=None, **kwargs):
         outs = out if isinstance(out, (list, tuple)) else [out]
         for dst, src in zip(outs, results):
             dst._set(src.asjax())
+        results = list(outs)
+    # autograd tape (reference: RecordImperativeFCompute, autograd.cc:70)
+    from . import autograd as _ag
+    _ag._record(opdef, attrs, list(inputs), arrs, results, rng)
+    if out is not None:
         return out
     if len(results) == 1:
         return results[0]

@@ -1,0 +1,64 @@
+"""Imperative autograd: grad_and_loss + the mark_variables/backward tape.
+
+reference behavior: python/mxnet/contrib/autograd.py + autograd.cc
+(MarkVariables/RecordImperativeFCompute/ComputeGradient) and
+tests/python/unittest/test_contrib_autograd.py.
+"""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd as ag
+
+
+def test_grad_and_loss():
+    def f(x):
+        return mx.nd.sum(x * x)
+
+    x = mx.nd.array(np.array([1.0, 2.0, 3.0], np.float32))
+    grads, loss = ag.grad_and_loss(f)(x)
+    np.testing.assert_allclose(loss.asnumpy(), 14.0, rtol=1e-6)
+    np.testing.assert_allclose(grads[0].asnumpy(), [2.0, 4.0, 6.0],
+                               rtol=1e-6)
+
+
+def test_marked_backward_arithmetic():
+    x = mx.nd.array(np.array([1.0, 2.0], np.float32))
+    gx = mx.nd.zeros((2,))
+    ag.mark_variables(x, gx)
+    with ag.train_section():
+        y = x * x + 3.0 * x
+    ag.backward(y)
+    np.testing.assert_allclose(gx.asnumpy(), 2 * x.asnumpy() + 3.0,
+                               rtol=1e-6)
+
+
+def test_marked_backward_registry_ops():
+    x = mx.nd.array(np.random.RandomState(0).rand(3, 4).astype(np.float32))
+    gx = mx.nd.zeros((3, 4))
+    ag.mark_variables(x, gx)
+    with ag.train_section():
+        y = mx.nd.relu(x - 0.5)
+        z = mx.nd.sum(y)
+    ag.backward(z)
+    expect = (x.asnumpy() - 0.5 > 0).astype(np.float32)
+    np.testing.assert_allclose(gx.asnumpy(), expect, rtol=1e-6)
+
+
+def test_backward_grad_req_add():
+    x = mx.nd.array(np.array([2.0], np.float32))
+    gx = mx.nd.array(np.array([10.0], np.float32))
+    ag.mark_variables(x, gx, grad_reqs="add")
+    with ag.train_section():
+        y = x * x
+    ag.backward(y)
+    np.testing.assert_allclose(gx.asnumpy(), [14.0], rtol=1e-6)
+
+
+def test_backward_with_head_grads():
+    x = mx.nd.array(np.array([1.0, 2.0], np.float32))
+    gx = mx.nd.zeros((2,))
+    ag.mark_variables(x, gx)
+    with ag.train_section():
+        y = x * 2.0
+    ag.backward(y, out_grads=mx.nd.array(np.array([3.0, 5.0], np.float32)))
+    np.testing.assert_allclose(gx.asnumpy(), [6.0, 10.0], rtol=1e-6)
