@@ -1,0 +1,105 @@
+"""Dataset helpers for the example scripts.
+
+The reference examples download MNIST/CIFAR/ImageNet (reference:
+example/image-classification/train_mnist.py:14-26). This environment has
+no network egress, so each loader first looks for the real files on disk
+and otherwise *generates* a structured synthetic stand-in with the same
+shapes/protocol: class prototypes + noise, which real models learn the
+same way (convergence gates stay meaningful — an untrained net scores
+1/num_classes, a working training loop reaches >0.9).
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def _read_idx_images(path):
+    with gzip.open(path, "rb") as f:
+        _, n, rows, cols = struct.unpack(">IIII", f.read(16))
+        return np.frombuffer(f.read(), dtype=np.uint8).reshape(n, rows, cols)
+
+
+def _read_idx_labels(path):
+    with gzip.open(path, "rb") as f:
+        _, n = struct.unpack(">II", f.read(8))
+        return np.frombuffer(f.read(), dtype=np.uint8)
+
+
+def synthetic_classification(num, shape, num_classes, seed=0, noise=0.35):
+    """Prototype-plus-noise images: class k = fixed random pattern k."""
+    rng = np.random.RandomState(seed)
+    protos = (rng.rand(num_classes, *shape) - 0.5).astype(np.float32)
+    labels = rng.randint(0, num_classes, size=num)
+    imgs = protos[labels] + noise * rng.randn(num, *shape).astype(np.float32)
+    return imgs.astype(np.float32), labels.astype(np.float32)
+
+
+def mnist_iters(batch_size, data_dir="data", flat=False, seed=0,
+                num_train=8000, num_val=2000):
+    """(train_iter, val_iter) of 28x28 digits — real MNIST if the idx
+    files exist under ``data_dir``, synthetic otherwise."""
+    files = ["train-images-idx3-ubyte.gz", "train-labels-idx1-ubyte.gz",
+             "t10k-images-idx3-ubyte.gz", "t10k-labels-idx1-ubyte.gz"]
+    paths = [os.path.join(data_dir, f) for f in files]
+    if all(os.path.exists(p) for p in paths):
+        tr_img = _read_idx_images(paths[0]).astype(np.float32) / 255
+        tr_lbl = _read_idx_labels(paths[1]).astype(np.float32)
+        va_img = _read_idx_images(paths[2]).astype(np.float32) / 255
+        va_lbl = _read_idx_labels(paths[3]).astype(np.float32)
+        tr_img = tr_img[:, None]
+        va_img = va_img[:, None]
+    else:
+        tr_img, tr_lbl = synthetic_classification(
+            num_train, (1, 28, 28), 10, seed=seed)
+        va_img, va_lbl = synthetic_classification(
+            num_val, (1, 28, 28), 10, seed=seed)  # same prototypes
+    if flat:
+        tr_img = tr_img.reshape(len(tr_img), -1)
+        va_img = va_img.reshape(len(va_img), -1)
+    train = mx.io.NDArrayIter(tr_img, tr_lbl, batch_size, shuffle=True)
+    val = mx.io.NDArrayIter(va_img, va_lbl, batch_size)
+    return train, val
+
+
+def cifar_like_iters(batch_size, num_classes=10, seed=0,
+                     num_train=6000, num_val=1500):
+    """32x32x3 image iterators (synthetic CIFAR-10 stand-in)."""
+    tr_img, tr_lbl = synthetic_classification(
+        num_train, (3, 32, 32), num_classes, seed=seed)
+    va_img, va_lbl = synthetic_classification(
+        num_val, (3, 32, 32), num_classes, seed=seed)
+    train = mx.io.NDArrayIter(tr_img, tr_lbl, batch_size, shuffle=True)
+    val = mx.io.NDArrayIter(va_img, va_lbl, batch_size)
+    return train, val
+
+
+def imagenet_like_iters(batch_size, num_classes=1000, image_shape=(3, 224, 224),
+                        num_train=2560, num_val=256, seed=0):
+    """224x224 iterators for throughput runs (synthetic ImageNet shapes)."""
+    tr_img, tr_lbl = synthetic_classification(
+        num_train, image_shape, num_classes, seed=seed)
+    va_img, va_lbl = synthetic_classification(
+        num_val, image_shape, num_classes, seed=seed)
+    train = mx.io.NDArrayIter(tr_img, tr_lbl, batch_size, shuffle=True)
+    val = mx.io.NDArrayIter(va_img, va_lbl, batch_size)
+    return train, val
+
+
+def synthetic_sentences(num=2000, vocab=128, max_len=30, seed=0):
+    """Integer token sequences with a learnable next-token structure
+    (each token ~ (3*prev + class) mod vocab), variable lengths."""
+    rng = np.random.RandomState(seed)
+    sents = []
+    for _ in range(num):
+        length = rng.randint(5, max_len)
+        s = [int(rng.randint(1, vocab))]
+        for _ in range(length - 1):
+            s.append(int((3 * s[-1] + 1) % (vocab - 1)) + 1)
+        sents.append(s)
+    return sents

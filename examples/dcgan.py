@@ -1,0 +1,153 @@
+#!/usr/bin/env python
+"""DCGAN: adversarial training with two Modules sharing a data path.
+
+reference config: example/gan/dcgan.py — generator (Deconvolution stack)
+and discriminator (Convolution stack) as separate Modules; the
+discriminator is bound with inputs_need_grad=True so its input gradient
+drives the generator's backward. Real images are synthetic blobs in this
+zero-egress environment.
+
+    python examples/dcgan.py --num-epochs 2
+"""
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import mxnet_tpu as mx
+from mxnet_tpu import symbol as sym
+
+
+def make_generator(ngf=32, nc=3, code_dim=64):
+    rand = sym.var("rand")
+    g = sym.Deconvolution(rand, name="g1", kernel=(4, 4), num_filter=ngf * 4,
+                          no_bias=True)
+    g = sym.BatchNorm(g, name="gbn1", fix_gamma=False)
+    g = sym.Activation(g, name="gact1", act_type="relu")
+    g = sym.Deconvolution(g, name="g2", kernel=(4, 4), stride=(2, 2),
+                          pad=(1, 1), num_filter=ngf * 2, no_bias=True)
+    g = sym.BatchNorm(g, name="gbn2", fix_gamma=False)
+    g = sym.Activation(g, name="gact2", act_type="relu")
+    g = sym.Deconvolution(g, name="g3", kernel=(4, 4), stride=(2, 2),
+                          pad=(1, 1), num_filter=ngf, no_bias=True)
+    g = sym.BatchNorm(g, name="gbn3", fix_gamma=False)
+    g = sym.Activation(g, name="gact3", act_type="relu")
+    g = sym.Deconvolution(g, name="g4", kernel=(4, 4), stride=(2, 2),
+                          pad=(1, 1), num_filter=nc, no_bias=True)
+    return sym.Activation(g, name="gout", act_type="tanh")
+
+
+def make_discriminator(ndf=32):
+    data = sym.var("data")
+    label = sym.var("label")
+    d = sym.Convolution(data, name="d1", kernel=(4, 4), stride=(2, 2),
+                        pad=(1, 1), num_filter=ndf, no_bias=True)
+    d = sym.LeakyReLU(d, name="dact1", act_type="leaky", slope=0.2)
+    d = sym.Convolution(d, name="d2", kernel=(4, 4), stride=(2, 2),
+                        pad=(1, 1), num_filter=ndf * 2, no_bias=True)
+    d = sym.BatchNorm(d, name="dbn2", fix_gamma=False)
+    d = sym.LeakyReLU(d, name="dact2", act_type="leaky", slope=0.2)
+    d = sym.Convolution(d, name="d3", kernel=(4, 4), stride=(2, 2),
+                        pad=(1, 1), num_filter=ndf * 4, no_bias=True)
+    d = sym.BatchNorm(d, name="dbn3", fix_gamma=False)
+    d = sym.LeakyReLU(d, name="dact3", act_type="leaky", slope=0.2)
+    d = sym.Convolution(d, name="d4", kernel=(4, 4), num_filter=1,
+                        no_bias=True)
+    d = sym.Flatten(d)
+    return sym.LogisticRegressionOutput(d, label, name="dloss")
+
+
+def real_batch(rng, batch_size):
+    """Synthetic 'real' images: bright gaussian blob on dark ground."""
+    yy, xx = np.mgrid[0:32, 0:32]
+    imgs = np.empty((batch_size, 3, 32, 32), np.float32)
+    for i in range(batch_size):
+        cy, cx = rng.uniform(8, 24, size=2)
+        blob = np.exp(-(((yy - cy) ** 2 + (xx - cx) ** 2) / 30.0))
+        imgs[i] = np.stack([blob] * 3) * 2 - 1
+    return imgs
+
+
+def main():
+    parser = argparse.ArgumentParser(description="dcgan")
+    parser.add_argument("--num-epochs", type=int, default=2)
+    parser.add_argument("--batches-per-epoch", type=int, default=30)
+    parser.add_argument("--batch-size", type=int, default=32)
+    parser.add_argument("--code-dim", type=int, default=64)
+    parser.add_argument("--lr", type=float, default=0.0002)
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO, format="%(asctime)s %(message)s")
+
+    batch, zdim = args.batch_size, args.code_dim
+    rng = np.random.RandomState(0)
+
+    modG = mx.mod.Module(make_generator(code_dim=zdim), data_names=("rand",),
+                         label_names=None, context=mx.current_context())
+    modG.bind(data_shapes=[("rand", (batch, zdim, 1, 1))])
+    modG.init_params(mx.initializer.Normal(0.02))
+    modG.init_optimizer(optimizer="adam",
+                        optimizer_params={"learning_rate": args.lr,
+                                          "beta1": 0.5})
+
+    modD = mx.mod.Module(make_discriminator(), data_names=("data",),
+                         label_names=("label",),
+                         context=mx.current_context())
+    modD.bind(data_shapes=[("data", (batch, 3, 32, 32))],
+              label_shapes=[("label", (batch, 1))],
+              inputs_need_grad=True)
+    modD.init_params(mx.initializer.Normal(0.02))
+    modD.init_optimizer(optimizer="adam",
+                        optimizer_params={"learning_rate": args.lr,
+                                          "beta1": 0.5})
+
+    def as_batch(data, label=None):
+        return mx.io.DataBatch([mx.nd.array(data)],
+                               [mx.nd.array(label)] if label is not None
+                               else [])
+
+    ones = np.ones((batch, 1), np.float32)
+    zeros = np.zeros((batch, 1), np.float32)
+    metric_d = mx.metric.CustomMetric(
+        lambda lab, pred: ((pred > 0.5) == (lab > 0.5)).mean(), name="dacc")
+
+    for epoch in range(args.num_epochs):
+        metric_d.reset()
+        for it in range(args.batches_per_epoch):
+            noise = rng.randn(batch, zdim, 1, 1).astype(np.float32)
+            modG.forward(as_batch(noise), is_train=True)
+            fake = modG.get_outputs()[0]
+
+            # discriminator: fake pass (label 0), stash grads
+            modD.forward(as_batch(fake.asnumpy(), zeros), is_train=True)
+            modD.backward()
+            stash = [g.asnumpy() if g is not None else None
+                     for g in modD._exec_group.grad_arrays]
+            metric_d.update([mx.nd.array(zeros)], modD.get_outputs())
+
+            # real pass (label 1), accumulate and update once
+            modD.forward(as_batch(real_batch(rng, batch), ones),
+                         is_train=True)
+            modD.backward()
+            for g, s in zip(modD._exec_group.grad_arrays, stash):
+                if g is not None and s is not None:
+                    g._set(g.asjax() + s)
+            modD.update()
+            metric_d.update([mx.nd.array(ones)], modD.get_outputs())
+
+            # generator: push fakes toward label 1 through D's input grad
+            modD.forward(as_batch(fake.asnumpy(), ones), is_train=True)
+            modD.backward()
+            diff = modD.get_input_grads()[0]
+            modG.backward([diff])
+            modG.update()
+
+        name, val = metric_d.get()
+        logging.info("epoch %d  %s=%.3f", epoch, name, val)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,110 @@
+#!/usr/bin/env python
+"""Bucketed LSTM language model.
+
+reference config: example/rnn/lstm_bucketing.py — BucketingModule +
+BucketSentenceIter + stacked LSTM cells, perplexity metric. Uses PTB
+text if ``--data-dir`` has ptb.train.txt, else a synthetic corpus.
+
+    python examples/lstm_bucketing.py --num-epochs 3
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import mxnet_tpu as mx
+from mxnet_tpu import symbol as sym
+from common import data as data_mod
+
+
+def tokenize_text(fname, vocab=None, invalid_label=-1, start_label=0):
+    with open(fname) as f:
+        lines = [line.split() for line in f]
+    return mx.rnn.io.encode_sentences(lines, vocab=vocab,
+                                      invalid_label=invalid_label,
+                                      start_label=start_label)
+
+
+def main():
+    parser = argparse.ArgumentParser(description="bucketed LSTM LM")
+    parser.add_argument("--data-dir", type=str, default="data")
+    parser.add_argument("--num-layers", type=int, default=2)
+    parser.add_argument("--num-hidden", type=int, default=200)
+    parser.add_argument("--num-embed", type=int, default=200)
+    parser.add_argument("--num-epochs", type=int, default=3)
+    parser.add_argument("--lr", type=float, default=0.01)
+    parser.add_argument("--mom", type=float, default=0.0)
+    parser.add_argument("--wd", type=float, default=1e-5)
+    parser.add_argument("--batch-size", type=int, default=32)
+    parser.add_argument("--disp-batches", type=int, default=20)
+    parser.add_argument("--kv-store", type=str, default="local")
+    args = parser.parse_args()
+
+    buckets = [10, 20, 30, 40]
+    start_label = 1
+    invalid_label = 0
+
+    ptb = os.path.join(args.data_dir, "ptb.train.txt")
+    if os.path.exists(ptb):
+        sentences, vocab = tokenize_text(ptb, start_label=start_label,
+                                         invalid_label=invalid_label)
+        val_sent, _ = tokenize_text(
+            os.path.join(args.data_dir, "ptb.valid.txt"), vocab=vocab,
+            invalid_label=invalid_label)
+        vocab_size = len(vocab) + start_label
+    else:
+        vocab_size = 128
+        sentences = data_mod.synthetic_sentences(2000, vocab=vocab_size,
+                                                 max_len=max(buckets))
+        val_sent = data_mod.synthetic_sentences(400, vocab=vocab_size,
+                                                max_len=max(buckets), seed=7)
+
+    data_train = mx.rnn.BucketSentenceIter(sentences, args.batch_size,
+                                           buckets=buckets,
+                                           invalid_label=invalid_label)
+    data_val = mx.rnn.BucketSentenceIter(val_sent, args.batch_size,
+                                         buckets=buckets,
+                                         invalid_label=invalid_label)
+
+    stack = mx.rnn.SequentialRNNCell()
+    for i in range(args.num_layers):
+        stack.add(mx.rnn.LSTMCell(num_hidden=args.num_hidden,
+                                  prefix=f"lstm_l{i}_"))
+
+    def sym_gen(seq_len):
+        data = sym.var("data")
+        label = sym.var("softmax_label")
+        embed = sym.Embedding(data, input_dim=vocab_size,
+                              output_dim=args.num_embed, name="embed")
+        stack.reset()
+        outputs, _ = stack.unroll(seq_len, inputs=embed, merge_outputs=True)
+        pred = sym.Reshape(outputs, shape=(-1, args.num_hidden))
+        pred = sym.FullyConnected(pred, num_hidden=vocab_size, name="pred")
+        label_flat = sym.Reshape(label, shape=(-1,))
+        out = sym.SoftmaxOutput(pred, label_flat, name="softmax")
+        return out, ("data",), ("softmax_label",)
+
+    model = mx.mod.BucketingModule(
+        sym_gen=sym_gen,
+        default_bucket_key=data_train.default_bucket_key,
+        context=mx.current_context())
+
+    import logging
+    logging.basicConfig(level=logging.INFO, format="%(asctime)s %(message)s")
+    model.fit(
+        train_data=data_train,
+        eval_data=data_val,
+        eval_metric=mx.metric.Perplexity(invalid_label),
+        optimizer="sgd",
+        optimizer_params={"learning_rate": args.lr, "momentum": args.mom,
+                          "wd": args.wd},
+        initializer=mx.initializer.Xavier(factor_type="in", magnitude=2.34),
+        num_epoch=args.num_epochs,
+        batch_end_callback=mx.callback.Speedometer(args.batch_size,
+                                                   args.disp_batches))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,37 @@
+#!/usr/bin/env python
+"""Train a small ResNet on CIFAR-10-shaped data.
+
+reference config: example/image-classification/train_cifar10.py. Run:
+
+    python examples/train_cifar10.py --num-layers 20 --num-epochs 10
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from mxnet_tpu.models import resnet
+from common import data, fit
+
+
+def main():
+    parser = argparse.ArgumentParser(description="train cifar10")
+    parser.add_argument("--num-layers", type=int, default=20)
+    parser.add_argument("--num-classes", type=int, default=10)
+    fit.add_fit_args(parser)
+    parser.set_defaults(batch_size=128, num_epochs=10, lr=0.05,
+                        lr_step_epochs="60,100")
+    args = parser.parse_args()
+
+    net = resnet.get_symbol(num_classes=args.num_classes,
+                            num_layers=args.num_layers,
+                            image_shape="3,32,32")
+    iters = data.cifar_like_iters(args.batch_size,
+                                  num_classes=args.num_classes)
+    fit.fit(args, net, iters)
+
+
+if __name__ == "__main__":
+    main()

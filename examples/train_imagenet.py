@@ -1,0 +1,55 @@
+#!/usr/bin/env python
+"""Train ResNet-50 (or friends) at ImageNet shapes — the flagship
+throughput config.
+
+reference config: example/image-classification/train_imagenet.py (the
+BASELINE.json north-star row). Data is synthetic by default (zero-egress
+environment); throughput numbers are identical either way since decode
+happens off the measured path in NDArrayIter. Run:
+
+    python examples/train_imagenet.py --network resnet --num-layers 50 \
+        --batch-size 64 --num-epochs 1
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from mxnet_tpu.models import resnet, alexnet, vgg, inception_bn
+from common import data, fit
+
+
+def main():
+    parser = argparse.ArgumentParser(description="train imagenet")
+    parser.add_argument("--network", type=str, default="resnet",
+                        choices=("resnet", "alexnet", "vgg", "inception-bn"))
+    parser.add_argument("--num-layers", type=int, default=50)
+    parser.add_argument("--num-classes", type=int, default=1000)
+    parser.add_argument("--num-examples", type=int, default=2560)
+    fit.add_fit_args(parser)
+    parser.set_defaults(batch_size=64, num_epochs=1, lr=0.1,
+                        disp_batches=10)
+    args = parser.parse_args()
+
+    if args.network == "resnet":
+        net = resnet.get_symbol(num_classes=args.num_classes,
+                                num_layers=args.num_layers,
+                                image_shape="3,224,224")
+    elif args.network == "alexnet":
+        net = alexnet.get_symbol(num_classes=args.num_classes)
+    elif args.network == "vgg":
+        net = vgg.get_symbol(num_classes=args.num_classes,
+                             num_layers=args.num_layers)
+    else:
+        net = inception_bn.get_symbol(num_classes=args.num_classes)
+
+    iters = data.imagenet_like_iters(args.batch_size,
+                                     num_classes=args.num_classes,
+                                     num_train=args.num_examples)
+    fit.fit(args, net, iters)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,37 @@
+#!/usr/bin/env python
+"""Train an MLP or LeNet on (possibly synthetic) MNIST.
+
+reference config: example/image-classification/train_mnist.py — the M1
+exit criterion of SURVEY.md §7. Run:
+
+    python examples/train_mnist.py --network mlp --num-epochs 5
+    python examples/train_mnist.py --network lenet
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from mxnet_tpu.models import mlp, lenet
+from common import data, fit
+
+
+def main():
+    parser = argparse.ArgumentParser(description="train mnist")
+    parser.add_argument("--network", choices=("mlp", "lenet"), default="mlp")
+    parser.add_argument("--data-dir", type=str, default="data")
+    fit.add_fit_args(parser)
+    parser.set_defaults(batch_size=64, num_epochs=5, lr=0.05)
+    args = parser.parse_args()
+
+    flat = args.network == "mlp"
+    net = (mlp if flat else lenet).get_symbol(num_classes=10)
+    iters = data.mnist_iters(args.batch_size, data_dir=args.data_dir,
+                             flat=flat)
+    fit.fit(args, net, iters)
+
+
+if __name__ == "__main__":
+    main()

@@ -201,23 +201,32 @@ def _deconvolution(attrs, data, weight, bias=None):
     stride = _ntuple(attrs.get("stride"), nd, 1)
     pad = _ntuple(attrs.get("pad"), nd, 0)
     ng = parse_int(attrs.get("num_group", 1))
-    spec = ("NCHW", "IOHW", "NCHW") if nd == 2 else ("NCH", "IOH", "NCH")
+    # MXNet deconv weight is (cin, nf, k...) — the weight of the *forward*
+    # conv nf->cin, i.e. OIHW with O=cin; transpose_kernel runs its
+    # transpose, mapping cin -> nf
+    spec = ("NCHW", "OIHW", "NCHW") if nd == 2 else ("NCH", "OIH", "NCH")
     dn = lax.conv_dimension_numbers(data.shape, weight.shape, spec)
+    adj = _ntuple(attrs.get("adj"), nd, 0)
+    # conv_transpose's padding is the raw lhs-dilated conv padding:
+    # out = (in-1)*s - k + 2 + lo + hi. MXNet wants (in-1)*s + k - 2p + adj
+    # => lo = k-1-p, hi = k-1-p+adj (adj = output_padding, high side)
+    pads = [(k - 1 - p, k - 1 - p + a)
+            for k, p, a in zip(kernel, pad, adj)]
     out = lax.conv_transpose(
         data, weight.astype(data.dtype), stride,
-        [(p, p) for p in pad], dimension_numbers=dn,
+        pads, dimension_numbers=dn,
         transpose_kernel=True) if ng == 1 else _grouped_deconv(
-            data, weight, stride, pad, dn, ng)
+            data, weight, stride, pads, dn, ng)
     if bias is not None:
         out = out + bias.astype(data.dtype).reshape((1, -1) + (1,) * nd)
     return out
 
 
-def _grouped_deconv(data, weight, stride, pad, dn, ng):
+def _grouped_deconv(data, weight, stride, pads, dn, ng):
     xs = jnp.split(data, ng, axis=1)
     ws = jnp.split(weight, ng, axis=0)
     outs = [lax.conv_transpose(x, w.astype(x.dtype), stride,
-                               [(p, p) for p in pad], dimension_numbers=dn,
+                               pads, dimension_numbers=dn,
                                transpose_kernel=True)
             for x, w in zip(xs, ws)]
     return jnp.concatenate(outs, axis=1)
@@ -600,15 +609,19 @@ def _upsampling(attrs, *xs):
         if attrs.get("multi_input_mode", "concat") == "sum":
             return sum(outs)
         return jnp.concatenate(outs, axis=1)
-    # bilinear: deconvolution with (learnable) bilinear kernel
+    # bilinear: per-channel (grouped) deconvolution with a learnable
+    # bilinear kernel — reference lowers to Deconvolution with
+    # num_group == channels (upsampling-inl.h)
     data, weight = xs
     k = 2 * scale - scale % 2
     pad = int(np.ceil((scale - 1) / 2.0))
-    dn = lax.conv_dimension_numbers(data.shape, weight.shape,
-                                    ("NCHW", "IOHW", "NCHW"))
-    return lax.conv_transpose(data, weight.astype(data.dtype),
-                              (scale, scale), [(pad, pad), (pad, pad)],
-                              dimension_numbers=dn, transpose_kernel=True)
+    c = data.shape[1]
+    dn = lax.conv_dimension_numbers((data.shape[0], 1) + data.shape[2:],
+                                    (1, 1, k, k),
+                                    ("NCHW", "OIHW", "NCHW"))
+    pads = [(k - 1 - pad, k - 1 - pad)] * 2
+    return _grouped_deconv(data, weight.astype(data.dtype),
+                           (scale, scale), pads, dn, c)
 
 
 @register("Crop", inputs=lambda attrs: ["data", "crop_like"][:parse_int(
