@@ -176,6 +176,96 @@ class DataParallelExecutorGroup:
                              for name in self.label_names
                              if name in self.executor.arg_dict]
 
+    # ------------------------------------------------------- fused training
+    def setup_fused_step(self, optimizer):
+        """Compile forward+backward+optimizer-update into ONE jitted XLA
+        program (the TPU-native analog of the reference's bulk train
+        segment, graph_executor.cc:678-756, plus its fused update ops).
+
+        Per-batch work then becomes: slice batch -> async device_put ->
+        one XLA dispatch -> buffer swaps. Returns False when the
+        optimizer or binding can't express it (imperative path remains).
+        """
+        plan = optimizer.fused_plan()
+        if plan is None or not self.for_training or self.inputs_need_grad:
+            return False
+        if any(self.grad_req.get(nm) not in ("write", "null")
+               for nm in self.arg_names):
+            return False
+        init_state, update = plan
+        exe = self.executor
+        watched = [nm for nm in self.param_names
+                   if self.grad_req.get(nm) == "write"]
+        if not watched:
+            return False
+
+        runner = exe._runner
+        loss_mask = exe._loss_mask
+
+        def step(arg_vals, aux_vals, rng, states, lrs, wds):
+            w = {nm: arg_vals[nm] for nm in watched}
+            rest = {nm: v for nm, v in arg_vals.items() if nm not in w}
+
+            def f(wv):
+                return runner({**rest, **wv}, aux_vals, True, rng)
+
+            outs, vjp_fn, new_aux = jax.vjp(f, w, has_aux=True)
+            heads = [jnp.ones(o.shape, o.dtype) if is_loss
+                     else jnp.zeros(o.shape, o.dtype)
+                     for o, is_loss in zip(outs, loss_mask)]
+            (grads,) = vjp_fn(heads)
+            new_w, new_states = {}, {}
+            for nm in watched:
+                nw, ns = update(arg_vals[nm],
+                                grads[nm].astype(arg_vals[nm].dtype),
+                                states[nm], lrs[nm], wds[nm])
+                new_w[nm] = nw
+                new_states[nm] = ns
+            return outs, new_aux, new_w, new_states
+
+        # donate optimizer states: their old buffers die every step
+        self._fused_prog = jax.jit(step, donate_argnums=(3,))
+        self._fused_watched = watched
+        self._fused_states = {}
+        for nm in watched:
+            w = exe.arg_dict[nm].asjax()
+            self._fused_states[nm] = jax.tree.map(
+                lambda x, _w=w: jax.device_put(x, _w.sharding),
+                init_state(w))
+        return True
+
+    def fused_step(self, data_batch, lrs, wds):
+        """Run one fused train step; swap new params/state/outputs in."""
+        from .. import random as _random
+        exe = self.executor
+
+        def load(names, arrays):
+            for name, arr in zip(names, arrays):
+                dst = exe.arg_dict.get(name)
+                if dst is None:
+                    continue
+                val = arr.asjax() if isinstance(arr, NDArray) else \
+                    jnp.asarray(np.asarray(arr))
+                dst._set(self._place(val.astype(dst.dtype), "data"))
+
+        load(self.data_names, data_batch.data)
+        if self.label_names and data_batch.label:
+            load(self.label_names, data_batch.label)
+
+        outs, new_aux, new_w, new_states = self._fused_prog(
+            exe._arg_vals(), exe._aux_vals(), _random.next_key(),
+            self._fused_states, lrs, wds)
+        self._fused_states = new_states
+        ad = exe.arg_dict
+        for nm in self._fused_watched:
+            ad[nm]._set(new_w[nm])
+        if new_aux:
+            xd = exe.aux_dict
+            for nm, val in new_aux.items():
+                xd[nm]._set(val)
+        exe._outputs = [NDArray(o, ctx=self.contexts[0]) for o in outs]
+        exe._pending = None
+
     # -------------------------------------------------------------- params
     def set_params(self, arg_params, aux_params):
         """reference: executor_group.py set_params -> copy into the bound

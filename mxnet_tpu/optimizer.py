@@ -73,6 +73,34 @@ class Optimizer:
     def update(self, index, weight, grad, state):
         raise NotImplementedError
 
+    def fused_plan(self):
+        """Optional fused-train-step support.
+
+        Returns ``(init_state, update)`` of pure jax functions —
+        ``init_state(weight_array) -> state_pytree`` and
+        ``update(weight, grad, state, lr, wd) -> (new_weight, new_state)``
+        with lr/wd as traced scalars — or None when this optimizer can
+        only run imperatively. Used by Module's fused train step, which
+        compiles forward+backward+update into ONE XLA program (the
+        TPU-native analog of the reference's bulk-exec + fused update
+        ops; the imperative ``update()`` path remains for kvstore and
+        custom flows).
+        """
+        return None
+
+    def _fused_grad_prep(self):
+        """Shared grad preprocessing closure for fused_plan impls."""
+        import jax.numpy as jnp
+        rescale = self.rescale_grad
+        clip = self.clip_gradient if self.clip_gradient else -1.0
+
+        def prep(g, w, wd):
+            g = g * rescale
+            if clip > 0:
+                g = jnp.clip(g, -clip, clip)
+            return g + wd * w
+        return prep
+
     def set_lr_mult(self, args_lr_mult):
         """reference: optimizer.py set_lr_mult — reads __lr_mult__ attrs."""
         self.lr_mult = {}
@@ -171,6 +199,22 @@ class SGD(Optimizer):
                               momentum=self.momentum, **kwargs)
         else:
             imperative_invoke("sgd_update", weight, grad, **kwargs)
+
+    def fused_plan(self):
+        import jax.numpy as jnp
+        prep = self._fused_grad_prep()
+        momentum = self.momentum
+
+        def init_state(w):
+            return jnp.zeros_like(w) if momentum else ()
+
+        def update(w, g, s, lr, wd):
+            g = prep(g, w, wd)
+            if momentum:
+                new_s = momentum * s - lr * g
+                return w + new_s, new_s
+            return w - lr * g, ()
+        return init_state, update
 
 
 @register
@@ -276,6 +320,29 @@ class Adam(Optimizer):
                           rescale_grad=self.rescale_grad,
                           clip_gradient=self.clip_gradient
                           if self.clip_gradient else -1.0)
+
+    def fused_plan(self):
+        # bias correction rides on lr, which Module computes per step via
+        # _get_lr + the update count (same as the imperative path above)
+        import jax.numpy as jnp
+        prep = self._fused_grad_prep()
+        b1, b2, eps = self.beta1, self.beta2, self.epsilon
+
+        def init_state(w):
+            return (jnp.zeros_like(w), jnp.zeros_like(w))
+
+        def update(w, g, s, lr, wd):
+            mean, var = s
+            g = prep(g, w, wd)
+            new_mean = b1 * mean + (1 - b1) * g
+            new_var = b2 * var + (1 - b2) * jnp.square(g)
+            new_w = w - lr * new_mean / (jnp.sqrt(new_var) + eps)
+            return new_w, (new_mean, new_var)
+        return init_state, update
+
+    def fused_lr_scale(self, t):
+        """Per-step lr multiplier (bias correction) for the fused path."""
+        return math.sqrt(1.0 - self.beta2 ** t) / (1.0 - self.beta1 ** t)
 
 
 @register
