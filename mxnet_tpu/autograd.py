@@ -36,24 +36,32 @@ class _TapeEntry:
     used. Output cells are recorded by id so later entries (and
     ``backward(outputs)``) can refer to them. ``replay`` is a pure
     function list-of-arrays -> list-of-arrays.
+
+    The entry keeps strong references to the input and output handles:
+    ids are only unique while the object is alive, so without the refs a
+    temporary freed mid-section could have its id reused by a new
+    unrelated array and the tape would silently wire the wrong value.
+    The refs (and the entries) are dropped when the tape is cleared.
     """
 
-    __slots__ = ("replay", "in_ids", "in_consts", "out_ids")
+    __slots__ = ("replay", "in_ids", "in_consts", "out_ids",
+                 "_in_handles", "_out_handles")
 
-    def __init__(self, replay, in_ids, in_consts, out_ids):
+    def __init__(self, replay, in_handles, in_consts, out_handles):
         self.replay = replay
-        self.in_ids = in_ids
+        self._in_handles = list(in_handles)
+        self._out_handles = list(out_handles)
+        self.in_ids = [id(h) for h in self._in_handles]
         self.in_consts = in_consts
-        self.out_ids = out_ids
+        self.out_ids = [id(h) for h in self._out_handles]
 
 
 def _record_fn(replay, input_handles, input_vals, output_handles):
     """Generic tape hook (NDArray operators record through this)."""
     if not _STATE["train"]:
         return
-    _TAPE.append(_TapeEntry(replay, [id(h) for h in input_handles],
-                            list(input_vals),
-                            [id(h) for h in output_handles]))
+    _TAPE.append(_TapeEntry(replay, input_handles, list(input_vals),
+                            output_handles))
 
 
 def _record(opdef, attrs, input_handles, input_vals, output_handles, rng):
@@ -175,7 +183,15 @@ def backward(outputs, out_grads=None, retain_graph=False):
             "a train_section() with variables marked first")
 
     tape = list(_TAPE)
-    leaves = {vid: var.asjax() for vid, (var, _, _) in _MARKED.items()}
+    # Leaves are only the marked variables this tape actually consumed —
+    # computing grads for every variable ever marked would clobber the
+    # grad buffers of unrelated models with zeros (the reference scopes
+    # its tape per recording session, autograd.cc:54-68).
+    used = set()
+    for e in tape:
+        used.update(e.in_ids)
+    leaves = {vid: var.asjax() for vid, (var, _, _) in _MARKED.items()
+              if vid in used}
     leaf_ids = list(leaves)
     out_ids = [id(o) for o in outputs]
 

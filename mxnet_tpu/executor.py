@@ -43,6 +43,37 @@ from . import random as _random
 __all__ = ["Executor"]
 
 
+class _LazyOutputs:
+    """List-like view of an executor's outputs that defers execution.
+
+    ``forward(is_train=True)`` must not force the forward program: the
+    hot path is ``backward()``'s single fused fwd+bwd XLA execution, and
+    materializing here would run the forward twice per training step.
+    Any actual access (len/index/iter) materializes via the ``outputs``
+    property.
+    """
+
+    __slots__ = ("_exe",)
+
+    def __init__(self, exe):
+        self._exe = exe
+
+    def _mat(self):
+        return self._exe.outputs
+
+    def __len__(self):
+        return len(self._mat())
+
+    def __getitem__(self, i):
+        return self._mat()[i]
+
+    def __iter__(self):
+        return iter(self._mat())
+
+    def __repr__(self):
+        return repr(self._mat())
+
+
 def _build_graph_runner(symbol, shape_overrides=None, tap=None, mp_plan=None):
     """Close the symbol graph into run(arg_vals, aux_vals, is_train, rng).
 
@@ -304,7 +335,10 @@ class Executor:
         self._outputs = None
         if not is_train:
             self._materialize_outputs()
-        return self.outputs
+            return self.outputs
+        # training: stay lazy so backward() costs exactly one fused
+        # fwd+bwd execution; the returned view materializes on access
+        return _LazyOutputs(self)
 
     def _arg_vals(self):
         return {nm: a.asjax() for nm, a in zip(self.arg_names,
@@ -314,32 +348,34 @@ class Executor:
         return {nm: a.asjax() for nm, a in zip(self.aux_names,
                                                self.aux_arrays)}
 
+    def _run_tapped(self, is_train, rng):
+        """Monitored execution: walk the graph eagerly (un-jitted) and
+        tap every op's outputs — full parity with the reference's
+        ExecuteMonCallback granularity (graph_executor.cc:758-778), at
+        interpreter speed (it's a debug mode there too: bulk exec must
+        be off for per-op stats, env_var.md:71)."""
+        cb = self._monitor_callback
+
+        def tap(node, outs):
+            out_names = node.output_names() if hasattr(
+                node, "output_names") else None
+            for i, o in enumerate(outs):
+                nm = out_names[i] if out_names and i < len(out_names) \
+                    else (f"{node.name}_output" if len(outs) == 1
+                          else f"{node.name}_output{i}")
+                cb(nm, NDArray(o, ctx=self._ctx))
+
+        runner, *_ = _build_graph_runner(self._symbol,
+                                         self._shape_overrides, tap=tap,
+                                         mp_plan=self._mp_plan)
+        return runner(self._arg_vals(), self._aux_vals(), is_train, rng)
+
     def _materialize_outputs(self):
         if self._outputs is not None or self._pending is None:
             return
         kind, rng = self._pending
         if self._monitor_callback is not None:
-            # monitored execution: walk the graph eagerly (un-jitted) and
-            # tap every op's outputs — full parity with the reference's
-            # ExecuteMonCallback granularity (graph_executor.cc:758-778),
-            # at interpreter speed (it's a debug mode there too: bulk exec
-            # must be off for per-op stats, env_var.md:71)
-            cb = self._monitor_callback
-
-            def tap(node, outs):
-                out_names = node.output_names() if hasattr(
-                    node, "output_names") else None
-                for i, o in enumerate(outs):
-                    nm = out_names[i] if out_names and i < len(out_names) \
-                        else (f"{node.name}_output" if len(outs) == 1
-                              else f"{node.name}_output{i}")
-                    cb(nm, NDArray(o, ctx=self._ctx))
-
-            runner, *_ = _build_graph_runner(self._symbol,
-                                             self._shape_overrides, tap=tap,
-                                             mp_plan=self._mp_plan)
-            outs, new_aux = runner(self._arg_vals(), self._aux_vals(),
-                                   kind == "fwd_train", rng)
+            outs, new_aux = self._run_tapped(kind == "fwd_train", rng)
             self._finish(outs, new_aux, monitored=True)
             return
         prog = self._get_program(kind)
@@ -404,9 +440,19 @@ class Executor:
         else:
             heads = [h.asjax() if isinstance(h, NDArray) else jnp.asarray(h)
                      for h in heads]
+        monitored = self._monitor_callback is not None
+        if monitored and self._outputs is None:
+            # training forward is lazy and the gradient path below runs as
+            # one fused XLA program, so the per-op tap would otherwise
+            # never fire under fit(monitor=...) — replay the forward
+            # eagerly (same rng) purely for the monitor's benefit. Skipped
+            # when outputs already materialized through the tapped path
+            # (a caller that read .outputs after forward) — the taps fired
+            # there.
+            self._run_tapped(True, rng)
         prog = self._get_program("fwd_bwd")
         outs, new_aux, grads = prog(arg_vals, self._aux_vals(), rng, heads)
-        self._finish(outs, new_aux, grads)
+        self._finish(outs, new_aux, grads, monitored=monitored)
         self._pending = None
 
     # ------------------------------------------------------------- utilities

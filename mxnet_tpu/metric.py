@@ -214,8 +214,16 @@ class Perplexity(EvalMetric):
             else:
                 count += lab.size
             nll -= float(_np.log(_np.maximum(p_target, 1e-10)).sum())
-        count = max(count, 1)
-        self._accumulate(math.exp(nll / count) * count, count)
+        # Accumulate raw nll/count so get() returns exp(total_nll/total
+        # count) — averaging per-batch perplexities would be biased high
+        # (Jensen; reference metric.py Perplexity.get). A fully-ignored
+        # batch contributes nothing.
+        self._accumulate(nll, count)
+
+    def get(self):
+        if self.num_inst == 0:
+            return self.name, float("nan")
+        return self.name, math.exp(self.sum_metric / self.num_inst)
 
 
 class _RegressionMetric(EvalMetric):

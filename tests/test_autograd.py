@@ -62,3 +62,40 @@ def test_backward_with_head_grads():
         y = x * 2.0
     ag.backward(y, out_grads=mx.nd.array(np.array([3.0, 5.0], np.float32)))
     np.testing.assert_allclose(gx.asnumpy(), [6.0, 10.0], rtol=1e-6)
+
+
+def test_tape_holds_refs_id_reuse_safe():
+    """ADVICE r2 (high): a temporary freed mid-section must not have its
+    id reused by a later constant — the tape holds strong refs."""
+    x = mx.nd.array(np.array([1.0, 2.0], np.float32))
+    gx = mx.nd.zeros((2,))
+    ag.mark_variables(x, gx)
+    with ag.train_section():
+        t = x * 2.0          # recorded; output handle t
+        del t                # without tape refs, t's id is free for reuse
+        c = mx.nd.array(np.array([7.0, 7.0], np.float32))  # may reuse id
+        y = c * x
+    ag.backward(y)
+    np.testing.assert_allclose(gx.asnumpy(), [7.0, 7.0], rtol=1e-6)
+
+
+def test_backward_does_not_clobber_unrelated_marked_grads():
+    """ADVICE r2 (medium): backward writes only grads of variables the
+    current tape consumed — earlier models' buffers stay untouched."""
+    a = mx.nd.array(np.array([3.0], np.float32))
+    ga = mx.nd.zeros((1,))
+    ag.mark_variables(a, ga)
+    with ag.train_section():
+        ya = a * a
+    ag.backward(ya)
+    np.testing.assert_allclose(ga.asnumpy(), [6.0], rtol=1e-6)
+
+    b = mx.nd.array(np.array([5.0], np.float32))
+    gb = mx.nd.zeros((1,))
+    ag.mark_variables(b, gb)
+    with ag.train_section():
+        yb = b * 3.0
+    ag.backward(yb)
+    np.testing.assert_allclose(gb.asnumpy(), [3.0], rtol=1e-6)
+    # ga must NOT have been zeroed by the second backward
+    np.testing.assert_allclose(ga.asnumpy(), [6.0], rtol=1e-6)

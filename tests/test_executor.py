@@ -128,3 +128,22 @@ def test_multi_output_executor():
     outs = ex.forward()
     assert len(outs) == 3
     assert outs[0].shape == (2, 2)
+
+
+def test_monitor_taps_per_op_during_training():
+    """ADVICE r2 (low): fit-style forward(is_train=True)+backward must
+    still fire the per-op monitor tap (reference ExecuteMonCallback)."""
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data, num_hidden=3, name="fc")
+    out = mx.sym.SoftmaxOutput(fc, mx.sym.Variable("sm_label"), name="sm")
+    exe = out.simple_bind(mx.cpu(), data=(2, 4), sm_label=(2,))
+    seen = []
+    exe.set_monitor_callback(lambda name, arr: seen.append(name))
+    exe.forward(is_train=True,
+                data=mx.nd.array(np.random.rand(2, 4).astype(np.float32)))
+    exe.backward()
+    assert any("fc" in n for n in seen), seen
+    assert any("sm" in n for n in seen), seen
+    # exactly once per op per step — no duplicate taps
+    from collections import Counter
+    assert all(c == 1 for c in Counter(seen).values()), Counter(seen)

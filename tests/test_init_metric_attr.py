@@ -185,3 +185,23 @@ def test_name_manager():
     with mx.Prefix("pre_"):
         s3 = mx.sym.FullyConnected(mx.sym.var("d"), num_hidden=1)
     assert s3.name.startswith("pre_")
+
+
+def test_perplexity_multi_batch_unbiased():
+    """ADVICE r2 (medium): get() must be exp(total_nll/total_count), not
+    the arithmetic mean of per-batch perplexities (biased high)."""
+    import math
+    m = mx.metric.Perplexity(ignore_label=None)
+    rs = np.random.RandomState(7)
+    total_nll, total_n = 0.0, 0
+    for _ in range(3):
+        lab = rs.randint(0, 4, size=(5,)).astype(np.float32)
+        prob = rs.rand(5, 4).astype(np.float32)
+        prob /= prob.sum(axis=1, keepdims=True)
+        m.update([mx.nd.array(lab)], [mx.nd.array(prob)])
+        total_nll -= np.log(np.maximum(
+            prob[np.arange(5), lab.astype(int)], 1e-10)).sum()
+        total_n += 5
+    _, val = m.get()
+    np.testing.assert_allclose(val, math.exp(total_nll / total_n),
+                               rtol=1e-5)
