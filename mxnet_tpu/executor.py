@@ -214,6 +214,7 @@ class Executor:
 
         # compiled program cache: (kind, ) -> jitted fn
         self._jit_cache = {}
+        self._tapped_runner = None   # eager monitored runner (per callback)
         self._pending = None      # recorded inputs awaiting execution
         self._outputs = None      # computed output NDArrays
 
@@ -354,21 +355,21 @@ class Executor:
         ExecuteMonCallback granularity (graph_executor.cc:758-778), at
         interpreter speed (it's a debug mode there too: bulk exec must
         be off for per-op stats, env_var.md:71)."""
-        cb = self._monitor_callback
+        if self._tapped_runner is None:
+            def tap(node, outs):
+                out_names = node.output_names() if hasattr(
+                    node, "output_names") else None
+                for i, o in enumerate(outs):
+                    nm = out_names[i] if out_names and i < len(out_names) \
+                        else (f"{node.name}_output" if len(outs) == 1
+                              else f"{node.name}_output{i}")
+                    self._monitor_callback(nm, NDArray(o, ctx=self._ctx))
 
-        def tap(node, outs):
-            out_names = node.output_names() if hasattr(
-                node, "output_names") else None
-            for i, o in enumerate(outs):
-                nm = out_names[i] if out_names and i < len(out_names) \
-                    else (f"{node.name}_output" if len(outs) == 1
-                          else f"{node.name}_output{i}")
-                cb(nm, NDArray(o, ctx=self._ctx))
-
-        runner, *_ = _build_graph_runner(self._symbol,
-                                         self._shape_overrides, tap=tap,
-                                         mp_plan=self._mp_plan)
-        return runner(self._arg_vals(), self._aux_vals(), is_train, rng)
+            self._tapped_runner, *_ = _build_graph_runner(
+                self._symbol, self._shape_overrides, tap=tap,
+                mp_plan=self._mp_plan)
+        return self._tapped_runner(self._arg_vals(), self._aux_vals(),
+                                   is_train, rng)
 
     def _materialize_outputs(self):
         if self._outputs is not None or self._pending is None:
@@ -501,6 +502,7 @@ class Executor:
 
     def set_monitor_callback(self, callback):
         self._monitor_callback = callback
+        self._tapped_runner = None  # tap closure binds the callback
 
     def debug_str(self):
         lines = [f"Symbol outputs: {self.output_names}"]

@@ -221,7 +221,7 @@ class DataParallelExecutorGroup:
                                 states[nm], lrs[nm], wds[nm])
                 new_w[nm] = nw
                 new_states[nm] = ns
-            return outs, new_aux, new_w, new_states
+            return outs, new_aux, new_w, new_states, grads
 
         # donate optimizer states: their old buffers die every step
         self._fused_prog = jax.jit(step, donate_argnums=(3,))
@@ -235,30 +235,25 @@ class DataParallelExecutorGroup:
         return True
 
     def fused_step(self, data_batch, lrs, wds):
-        """Run one fused train step; swap new params/state/outputs in."""
+        """Run one fused train step; swap new params/state/grads/outputs
+        in (grads are written back so ``grad_dict`` stays truthful for
+        callers that inspect gradients after a step)."""
         from .. import random as _random
         exe = self.executor
+        self._load_batch(data_batch)
 
-        def load(names, arrays):
-            for name, arr in zip(names, arrays):
-                dst = exe.arg_dict.get(name)
-                if dst is None:
-                    continue
-                val = arr.asjax() if isinstance(arr, NDArray) else \
-                    jnp.asarray(np.asarray(arr))
-                dst._set(self._place(val.astype(dst.dtype), "data"))
-
-        load(self.data_names, data_batch.data)
-        if self.label_names and data_batch.label:
-            load(self.label_names, data_batch.label)
-
-        outs, new_aux, new_w, new_states = self._fused_prog(
+        outs, new_aux, new_w, new_states, grads = self._fused_prog(
             exe._arg_vals(), exe._aux_vals(), _random.next_key(),
             self._fused_states, lrs, wds)
         self._fused_states = new_states
         ad = exe.arg_dict
         for nm in self._fused_watched:
             ad[nm]._set(new_w[nm])
+        gd = exe.grad_dict
+        for nm, g in grads.items():
+            dst = gd.get(nm)
+            if dst is not None:
+                dst._set(g.astype(dst.dtype))
         if new_aux:
             xd = exe.aux_dict
             for nm, val in new_aux.items():
@@ -302,7 +297,12 @@ class DataParallelExecutorGroup:
         """
         if is_train is None:
             is_train = self.for_training
+        self._load_batch(data_batch)
+        self.executor.forward(is_train=is_train)
 
+    def _load_batch(self, data_batch):
+        """Shard the batch's data (and labels, which eval graphs read)
+        into the bound input arrays."""
         def load(names, arrays):
             for name, arr in zip(names, arrays):
                 dst = self.executor.arg_dict.get(name)
@@ -313,10 +313,8 @@ class DataParallelExecutorGroup:
                 dst._set(self._place(val.astype(dst.dtype), "data"))
 
         load(self.data_names, data_batch.data)
-        # labels are loaded for inference too: eval graphs (score) read them
         if self.label_names and data_batch.label:
             load(self.label_names, data_batch.label)
-        self.executor.forward(is_train=is_train)
 
     def backward(self, out_grads=None):
         assert self.for_training, "re-bind with for_training=True"

@@ -65,6 +65,8 @@ class Module(BaseModule):
         self._update_on_kvstore = None
         self._updater = None
         self._preload_opt_states = None
+        self._fused_armed = False
+        self._fused_done = False
 
     # ------------------------------------------------------------ checkpoint
     @staticmethod
@@ -269,6 +271,18 @@ class Module(BaseModule):
         self._updater = None if update_on_kvstore \
             else opt.get_updater(optimizer)
 
+        # Fused train step: forward+backward+update as ONE XLA program
+        # (reference bulk-exec segments + fused optimizer_op.cc). Armed
+        # only when the update is single-process local — a dist kvstore
+        # or server-side updater owns the math in those arrangements.
+        self._fused_armed = False
+        self._fused_done = False
+        if (not update_on_kvstore
+                and (kvstore is None or "dist" not in kvstore.type)
+                and self._exec_group.executor._monitor_callback is None):
+            self._fused_armed = bool(
+                self._exec_group.setup_fused_step(optimizer))
+
         if kvstore:
             _initialize_kvstore(kvstore=kvstore,
                                 param_arrays=self._exec_group.param_arrays,
@@ -290,9 +304,73 @@ class Module(BaseModule):
         self._kvstore = shared_module._kvstore
         self._update_on_kvstore = shared_module._update_on_kvstore
         self._updater = shared_module._updater
+        # shared optimizer state lives in the updater — the fused path
+        # keeps per-group device state, so bucketing stays staged
+        self._fused_armed = False
+        self._fused_done = False
         self.optimizer_initialized = True
 
     # ------------------------------------------------------------ train step
+    def forward_backward(self, data_batch):
+        """One training pass; routes through the fused fwd+bwd+update
+        program when armed. The weight update then happens inside this
+        call (the subsequent ``update()`` is a no-op for the batch), so
+        a loop that conditionally skips ``update()`` must first disarm
+        with ``install_monitor`` absent via the staged path — gradients
+        themselves remain readable from ``grad_dict`` either way."""
+        if self._fused_armed and self.optimizer_initialized:
+            if self._exec_group.executor._monitor_callback is not None:
+                # a monitor was installed directly on the executor after
+                # arming — migrate to the staged path for good so the
+                # optimizer state lives in exactly one place
+                self._defuse()
+            else:
+                self._exec_group.fused_step(data_batch,
+                                            *self._fused_lr_wd())
+                self._fused_done = True
+                return
+        self.forward(data_batch, is_train=True)
+        self.backward()
+
+    def _fused_lr_wd(self):
+        """Per-step host-side lr/wd per watched param (scheduler, mults,
+        Adam bias correction) — the traced scalars the fused program
+        takes each dispatch. Ordering matches the staged Optimizer
+        .update: lr/wd are read BEFORE the update count advances, the
+        bias-correction step count after."""
+        o = self._optimizer
+        watched = set(self._exec_group._fused_watched)
+        lrs, wds = {}, {}
+        scale = getattr(o, "fused_lr_scale", None)
+        for i, nm in enumerate(self._param_names):
+            if nm not in watched:
+                continue
+            lr = o._get_lr(i)
+            wds[nm] = o._get_wd(i)
+            o._update_count(i)
+            if scale is not None:
+                lr *= scale(o._index_update_count[i])
+            lrs[nm] = lr
+        return lrs, wds
+
+    def _defuse(self):
+        """Disarm the fused path, migrating its device optimizer state
+        into the staged updater so training numerics continue exactly."""
+        import jax
+        fs = self._exec_group._fused_states
+        for i, nm in enumerate(self._param_names):
+            if nm not in fs:
+                continue
+            leaves = jax.tree.leaves(fs[nm])
+            if not leaves:
+                state = None
+            elif isinstance(fs[nm], (tuple, list)):
+                state = tuple(NDArray(l) for l in leaves)
+            else:
+                state = NDArray(leaves[0])
+            self._updater.states[i] = state
+        self._fused_armed = False
+
     def forward(self, data_batch, is_train=None):
         assert self.binded and self.params_initialized
         self._exec_group.forward(data_batch, is_train)
@@ -312,6 +390,15 @@ class Module(BaseModule):
         assert self.binded and self.params_initialized and \
             self.optimizer_initialized
         self._params_dirty = True
+        if self._fused_done:
+            # weights/state already advanced inside the fused program
+            self._fused_done = False
+            return
+        if self._fused_armed:
+            # caller is driving forward/backward/update manually (e.g.
+            # BucketingModule) — migrate to the staged arrangement so
+            # optimizer state lives in exactly one place
+            self._defuse()
         triples = zip(range(len(self._param_names)),
                       self._exec_group.param_arrays,
                       self._exec_group.grad_arrays)
@@ -354,18 +441,66 @@ class Module(BaseModule):
             if isinstance(v, (tuple, list)):
                 return [host(x) for x in v]
             return v
+        if self._fused_armed:
+            import jax
+            states = {"__fused__": jax.tree.map(np.asarray,
+                                                self._exec_group._fused_states)}
+        else:
+            states = {k: host(v) for k, v in self._updater.states.items()}
         with open(fname, "wb") as fout:
-            pickle.dump({k: host(v) for k, v in
-                         self._updater.states.items()}, fout)
+            pickle.dump(states, fout)
 
     def load_optimizer_states(self, fname):
         assert self.optimizer_initialized
         if self._update_on_kvstore:
             self._kvstore.load_optimizer_states(fname)
+            return
+        with open(fname, "rb") as fin:
+            states = pickle.load(fin)
+        import jax
+        if "__fused__" in states and self._fused_armed:
+            cur = self._exec_group._fused_states
+            self._exec_group._fused_states = jax.tree.map(
+                lambda old, new: jax.device_put(new, old.sharding),
+                cur, states["__fused__"])
+        elif "__fused__" in states:
+            # fused-format checkpoint into a staged module: unwrap to the
+            # updater's per-index states
+            for i, nm in enumerate(self._param_names):
+                if nm not in states["__fused__"]:
+                    continue
+                leaves = jax.tree.leaves(states["__fused__"][nm])
+                if not leaves:
+                    st = None
+                elif isinstance(states["__fused__"][nm], (tuple, list)):
+                    st = tuple(NDArray(jnp_arr) for jnp_arr in
+                               map(np.asarray, leaves))
+                else:
+                    st = NDArray(np.asarray(leaves[0]))
+                self._updater.states[i] = st
+        elif self._fused_armed:
+            # staged-format checkpoint into a fused module: project each
+            # per-index state onto the fused per-name device state
+            # (recursive walk — pickled staged tuples come back as lists)
+            def project(old, new):
+                if isinstance(old, (tuple, list)):
+                    return type(old)(project(o, n)
+                                     for o, n in zip(old, new))
+                arr = new.asnumpy() if isinstance(new, NDArray) \
+                    else np.asarray(new)
+                return jax.device_put(arr, old.sharding)
+
+            fs = self._exec_group._fused_states
+            for i, nm in enumerate(self._param_names):
+                if nm in fs and i in states and jax.tree.leaves(fs[nm]):
+                    fs[nm] = project(fs[nm], states[i])
         else:
-            with open(fname, "rb") as fin:
-                self._updater.states.update(pickle.load(fin))
+            self._updater.states.update(states)
 
     def install_monitor(self, mon):
         assert self.binded
         self._exec_group.install_monitor(mon)
+        if self._fused_armed:
+            # per-op taps need the staged path; carry the optimizer
+            # state over so momentum/moments don't reset
+            self._defuse()

@@ -213,3 +213,96 @@ def test_module_multi_device_matches_single():
         p, _ = mod.get_params()
         params_out.append(p["fc2_weight"].asnumpy())
     assert np.abs(params_out[0] - params_out[1]).max() < 1e-4
+
+
+def _mlp_sym():
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data, num_hidden=8, name="fc1")
+    act = mx.sym.Activation(fc1, act_type="tanh")
+    fc2 = mx.sym.FullyConnected(act, num_hidden=3, name="fc2")
+    return mx.sym.SoftmaxOutput(fc2, mx.sym.Variable("softmax_label"),
+                                name="softmax")
+
+
+def _run_steps(fused, optimizer, opt_params, steps=5):
+    rs = np.random.RandomState(42)
+    init_args = {
+        "fc1_weight": rs.randn(8, 6).astype(np.float32) * 0.1,
+        "fc1_bias": np.zeros(8, np.float32),
+        "fc2_weight": rs.randn(3, 8).astype(np.float32) * 0.1,
+        "fc2_bias": np.zeros(3, np.float32),
+    }
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    mod.bind([("data", (4, 6))], [("softmax_label", (4,))])
+    mod.init_params(arg_params={k: mx.nd.array(v)
+                                for k, v in init_args.items()})
+    mod.init_optimizer(kvstore=None, optimizer=optimizer,
+                       optimizer_params=opt_params)
+    if fused:
+        assert mod._fused_armed, "fused path should arm for " + optimizer
+    else:
+        mod._fused_armed = False
+    for step in range(steps):
+        srs = np.random.RandomState(100 + step)
+        batch = mx.io.DataBatch(
+            data=[mx.nd.array(srs.rand(4, 6).astype(np.float32))],
+            label=[mx.nd.array(srs.randint(0, 3, (4,)).astype(np.float32))])
+        mod.forward_backward(batch)
+        mod.update()
+    args, _ = mod.get_params()
+    return {k: v.asnumpy() for k, v in args.items()}
+
+
+@pytest.mark.parametrize("optimizer,opt_params", [
+    ("sgd", (("learning_rate", 0.1), ("momentum", 0.9), ("wd", 1e-4))),
+    ("adam", (("learning_rate", 0.01), ("wd", 1e-4))),
+])
+def test_fused_step_matches_staged(optimizer, opt_params):
+    """VERDICT r2 #2: the fused fwd+bwd+update program must reproduce the
+    staged forward/backward/update numerics over several steps."""
+    fused = _run_steps(True, optimizer, opt_params)
+    staged = _run_steps(False, optimizer, opt_params)
+    for k in fused:
+        np.testing.assert_allclose(fused[k], staged[k], rtol=2e-5,
+                                   atol=2e-6, err_msg=k)
+
+
+def test_fused_step_optimizer_state_roundtrip():
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    mod.bind([("data", (4, 6))], [("softmax_label", (4,))])
+    mod.init_params(initializer=mx.initializer.Xavier())
+    mod.init_optimizer(kvstore=None, optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.1),
+                                         ("momentum", 0.9)))
+    assert mod._fused_armed
+    rs = np.random.RandomState(3)
+    batch = mx.io.DataBatch(
+        data=[mx.nd.array(rs.rand(4, 6).astype(np.float32))],
+        label=[mx.nd.array(rs.randint(0, 3, (4,)).astype(np.float32))])
+    mod.forward_backward(batch)
+    mod.update()
+    with tempfile.TemporaryDirectory() as d:
+        fname = os.path.join(d, "opt.states")
+        mod.save_optimizer_states(fname)
+        before = {k: np.asarray(v) for k, v in
+                  mod._exec_group._fused_states.items()}
+        mod.forward_backward(batch)
+        mod.update()
+        mod.load_optimizer_states(fname)
+        after = {k: np.asarray(v) for k, v in
+                 mod._exec_group._fused_states.items()}
+    for k in before:
+        np.testing.assert_allclose(before[k], after[k])
+
+
+def test_fused_step_matches_staged_with_scheduler():
+    """lr scheduler must see the same update count in both paths."""
+    def params():
+        return (("learning_rate", 0.2), ("momentum", 0.9),
+                ("lr_scheduler",
+                 mx.lr_scheduler.FactorScheduler(step=2, factor=0.5)))
+    fused = _run_steps(True, "sgd", params())
+    staged = _run_steps(False, "sgd", params())
+    for k in fused:
+        np.testing.assert_allclose(fused[k], staged[k], rtol=2e-5,
+                                   atol=2e-6, err_msg=k)
