@@ -1,0 +1,111 @@
+"""Pure JAX/Flax ResNet-50 training referent for bench.py.
+
+This is the BASELINE.json north-star referent: the throughput a user
+would get writing the model directly against the standard JAX stack
+(flax.linen + optax), with TPU best practices — NHWC layout, bfloat16
+compute over float32 master params, SGD momentum, one fused jitted
+train step with donated state. bench.py compares the framework's
+Module.fit throughput against this on the same chip / batch / dtype.
+
+Architecture: canonical ResNet-50 v1 (7x7/64/s2 stem, 3-4-6-3
+bottleneck stages, expansion 4) — same FLOP class as the framework's
+models/resnet.py symbol (reference example/image-classification).
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import flax.linen as nn
+import optax
+
+STAGE_SIZES = [3, 4, 6, 3]
+STAGE_WIDTHS = [64, 128, 256, 512]
+
+
+class Bottleneck(nn.Module):
+    width: int
+    stride: int
+    dtype: Any
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        bn = partial(nn.BatchNorm, use_running_average=not train,
+                     momentum=0.9, epsilon=1e-5, dtype=self.dtype)
+        residual = x
+        y = conv(self.width, (1, 1))(x)
+        y = nn.relu(bn()(y))
+        y = conv(self.width, (3, 3), strides=(self.stride, self.stride),
+                 padding=[(1, 1), (1, 1)])(y)
+        y = nn.relu(bn()(y))
+        y = conv(self.width * 4, (1, 1))(y)
+        y = bn(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = conv(self.width * 4, (1, 1),
+                            strides=(self.stride, self.stride))(residual)
+            residual = bn()(residual)
+        return nn.relu(y + residual)
+
+
+class ResNet50(nn.Module):
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = x.astype(self.dtype)
+        x = nn.Conv(64, (7, 7), strides=(2, 2), padding=[(3, 3), (3, 3)],
+                    use_bias=False, dtype=self.dtype)(x)
+        x = nn.relu(nn.BatchNorm(use_running_average=not train,
+                                 momentum=0.9, epsilon=1e-5,
+                                 dtype=self.dtype)(x))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=[(1, 1), (1, 1)])
+        for i, (n_blocks, width) in enumerate(zip(STAGE_SIZES,
+                                                  STAGE_WIDTHS)):
+            for b in range(n_blocks):
+                stride = 2 if i > 0 and b == 0 else 1
+                x = Bottleneck(width, stride, self.dtype)(x, train)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+        return x
+
+
+def make_train_step(batch_size, learning_rate=0.1, momentum=0.9,
+                    num_classes=1000):
+    """Returns (jitted_step, initial_state, example_batch_fn).
+
+    state = (params, batch_stats, opt_state); step(state, images,
+    labels) -> (new_state, loss) as one donated jitted XLA program.
+    """
+    model = ResNet50(num_classes=num_classes)
+    tx = optax.sgd(learning_rate, momentum=momentum)
+
+    def init(rng):
+        variables = model.init(rng, jnp.zeros((1, 224, 224, 3),
+                                              jnp.float32), train=False)
+        params = variables["params"]
+        batch_stats = variables["batch_stats"]
+        return params, batch_stats, tx.init(params)
+
+    def loss_fn(params, batch_stats, images, labels):
+        logits, mutated = model.apply(
+            {"params": params, "batch_stats": batch_stats}, images,
+            train=True, mutable=["batch_stats"])
+        one_hot = jax.nn.one_hot(labels, num_classes)
+        loss = optax.softmax_cross_entropy(logits, one_hot).mean()
+        return loss, mutated["batch_stats"]
+
+    def step(state, images, labels):
+        params, batch_stats, opt_state = state
+        (loss, new_stats), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params, batch_stats, images, labels)
+        updates, new_opt = tx.update(grads, opt_state, params)
+        new_params = optax.apply_updates(params, updates)
+        return (new_params, new_stats, new_opt), loss
+
+    return jax.jit(step, donate_argnums=(0,)), init

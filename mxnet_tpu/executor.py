@@ -74,7 +74,8 @@ class _LazyOutputs:
         return repr(self._mat())
 
 
-def _build_graph_runner(symbol, shape_overrides=None, tap=None, mp_plan=None):
+def _build_graph_runner(symbol, shape_overrides=None, tap=None, mp_plan=None,
+                        compute_dtype=None):
     """Close the symbol graph into run(arg_vals, aux_vals, is_train, rng).
 
     Returns (runner, arg_names, aux_names, loss_mask). The runner is pure:
@@ -94,6 +95,12 @@ def _build_graph_runner(symbol, shape_overrides=None, tap=None, mp_plan=None):
     boundary constraints are applied to cross-ctx_group edges, lowering
     the reference's PlaceDevice/_CrossDeviceCopy onto sharding
     constraints that XLA turns into collectives.
+
+    ``compute_dtype`` — mixed precision: float variables are cast to this
+    dtype (normally bfloat16 -> MXU-native matmuls/convs) at graph entry
+    while the bound arrays (master params) stay float32; the cast's vjp
+    upcasts gradients back automatically. Labels feeding a loss head are
+    exempt (class indices above 256 don't survive a bfloat16 roundtrip).
     """
     nodes = symbol._topo_nodes()
     node_index = {id(n): i for i, n in enumerate(nodes)}
@@ -105,15 +112,34 @@ def _build_graph_runner(symbol, shape_overrides=None, tap=None, mp_plan=None):
         loss_mask.append(bool(not node.is_variable and
                               node.opdef().is_loss))
 
+    # variables fed straight into a loss head's label slot keep their
+    # dtype under mixed precision (class ids must stay exact)
+    label_names = set()
+    if compute_dtype is not None:
+        compute_dtype = np.dtype(compute_dtype)
+        for node in nodes:
+            if not node.is_variable and node.opdef().is_loss:
+                for inp, _ in node.inputs[1:]:
+                    if inp.is_variable:
+                        label_names.add(inp.name)
+
+    def _load_var(val, name):
+        if (compute_dtype is not None and name not in label_names
+                and jnp.issubdtype(val.dtype, jnp.floating)):
+            return val.astype(compute_dtype)
+        return val
+
     def run(arg_vals, aux_vals, is_train, rng):
         vals = {}       # id(node) -> list of output arrays
         new_aux = {}
         for node in nodes:
             if node.is_variable:
                 if node._extra.get("__is_aux__"):
-                    vals[id(node)] = [aux_vals[node.name]]
+                    vals[id(node)] = [_load_var(aux_vals[node.name],
+                                                node.name)]
                 else:
-                    vals[id(node)] = [arg_vals[node.name]]
+                    vals[id(node)] = [_load_var(arg_vals[node.name],
+                                                node.name)]
                 continue
             opdef = node.opdef()
             attrs = node.attrs
@@ -147,10 +173,12 @@ class Executor:
     """reference: include/mxnet/executor.h + python/mxnet/executor.py."""
 
     def __init__(self, symbol, ctx, args, args_grad=None, grad_req="write",
-                 aux_states=None, group2ctx=None, shared_exec=None):
+                 aux_states=None, group2ctx=None, shared_exec=None,
+                 compute_dtype=None):
         self._symbol = symbol
         self._ctx = ctx
         self._group2ctx = group2ctx or {}
+        self._compute_dtype = compute_dtype
         self._monitor_callback = None
         self.output_names = symbol.list_outputs()
 
@@ -192,7 +220,8 @@ class Executor:
         self._shape_overrides = shape_overrides
         self._runner, self.arg_names, self.aux_names, self._loss_mask = \
             _build_graph_runner(symbol, shape_overrides,
-                                mp_plan=self._mp_plan)
+                                mp_plan=self._mp_plan,
+                                compute_dtype=compute_dtype)
         self.aux_arrays = self._normalize_args(aux_states, self.aux_names,
                                                "aux_states", allow_none=True)
         self.grad_req = self._normalize_req(grad_req)
@@ -367,7 +396,8 @@ class Executor:
 
             self._tapped_runner, *_ = _build_graph_runner(
                 self._symbol, self._shape_overrides, tap=tap,
-                mp_plan=self._mp_plan)
+                mp_plan=self._mp_plan,
+                compute_dtype=self._compute_dtype)
         return self._tapped_runner(self._arg_vals(), self._aux_vals(),
                                    is_train, rng)
 

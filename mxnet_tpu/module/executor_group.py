@@ -36,8 +36,9 @@ class DataParallelExecutorGroup:
     def __init__(self, symbol, contexts, workload, data_shapes, label_shapes,
                  param_names, for_training, inputs_need_grad,
                  shared_group=None, logger=logging, fixed_param_names=None,
-                 grad_req="write", state_names=None):
+                 grad_req="write", state_names=None, compute_dtype=None):
         self.symbol = symbol
+        self.compute_dtype = compute_dtype
         self.contexts = contexts
         self.workload = workload
         self.for_training = for_training
@@ -158,7 +159,8 @@ class DataParallelExecutorGroup:
                 self._place(jnp.zeros(shape, dtype=np.float32), "param"))
 
         self.executor = Executor(self.symbol, self.contexts[0], args, grads,
-                                 self.grad_req, aux)
+                                 self.grad_req, aux,
+                                 compute_dtype=self.compute_dtype)
         self.execs = [self.executor]  # reference-compat alias
 
         # flat layout — one logical sharded executor, so one array per

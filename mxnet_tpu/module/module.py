@@ -32,8 +32,9 @@ class Module(BaseModule):
     def __init__(self, symbol, data_names=("data",),
                  label_names=("softmax_label",), logger=logging,
                  context=None, work_load_list=None, fixed_param_names=None,
-                 state_names=None):
+                 state_names=None, compute_dtype=None):
         super().__init__(logger=logger)
+        self._compute_dtype = compute_dtype
         context = context if context is not None else [current_context()]
         self._context = list(context) if isinstance(context, (list, tuple)) \
             else [context]
@@ -217,7 +218,8 @@ class Module(BaseModule):
             label_shapes, self._param_names, for_training, inputs_need_grad,
             shared_group, logger=self.logger,
             fixed_param_names=self._fixed_param_names, grad_req=grad_req,
-            state_names=self._state_names)
+            state_names=self._state_names,
+            compute_dtype=self._compute_dtype)
 
         if shared_module is not None:
             self.params_initialized = True
