@@ -384,3 +384,160 @@ def _multibox_detection(attrs, cls_prob, loc_pred, anchor):
     return jax.vmap(one)(cls_prob, loc_pred)
 
 alias("_contrib_MultiBoxDetection", "MultiBoxDetection")
+
+
+# --------------------------------------------------------------------------
+# MultiProposal (reference: contrib/multi_proposal.cc — batched RPN
+# proposal generation for Faster-RCNN: anchors + bbox deltas -> clip ->
+# min-size filter -> top-k by fg score -> NMS -> fixed-count RoIs)
+# --------------------------------------------------------------------------
+def _generate_base_anchors(stride, scales, ratios):
+    """Standard RPN base anchors around the stride-sized cell at (0,0)."""
+    base = np.array([0, 0, stride - 1, stride - 1], dtype=np.float64)
+    w = base[2] - base[0] + 1
+    h = base[3] - base[1] + 1
+    cx = base[0] + 0.5 * (w - 1)
+    cy = base[1] + 0.5 * (h - 1)
+    anchors = []
+    size = w * h
+    for r in ratios:
+        ws = np.round(np.sqrt(size / r))
+        hs = np.round(ws * r)
+        for s in scales:
+            sw, sh = ws * s, hs * s
+            anchors.append([cx - 0.5 * (sw - 1), cy - 0.5 * (sh - 1),
+                            cx + 0.5 * (sw - 1), cy + 0.5 * (sh - 1)])
+    return np.array(anchors, dtype=np.float32)  # (A, 4)
+
+
+def _iou_pixel(a, b):
+    """Pairwise IoU with the pixel-inclusive (+1) area convention the
+    reference RPN uses (multi_proposal.cc) — distinct from the normalized
+    [0,1]-coordinate ``_iou`` used by the MultiBox family."""
+    area_a = (a[:, 2] - a[:, 0] + 1.0) * (a[:, 3] - a[:, 1] + 1.0)
+    area_b = (b[:, 2] - b[:, 0] + 1.0) * (b[:, 3] - b[:, 1] + 1.0)
+    x1 = jnp.maximum(a[:, None, 0], b[None, :, 0])
+    y1 = jnp.maximum(a[:, None, 1], b[None, :, 1])
+    x2 = jnp.minimum(a[:, None, 2], b[None, :, 2])
+    y2 = jnp.minimum(a[:, None, 3], b[None, :, 3])
+    iw = jnp.clip(x2 - x1 + 1.0, 0.0, None)
+    ih = jnp.clip(y2 - y1 + 1.0, 0.0, None)
+    inter = iw * ih
+    return inter / (area_a[:, None] + area_b[None, :] - inter)
+
+
+def _mp_infer(attrs, in_shapes):
+    cls_s = in_shapes[0]
+    post = parse_int(attrs.get("rpn_post_nms_top_n", 300))
+    n = cls_s[0] if cls_s is not None else None
+    out = [(n * post, 5) if n is not None else None]
+    if parse_bool(attrs.get("output_score", False)):
+        out.append((n * post, 1) if n is not None else None)
+    return list(in_shapes), out, []
+
+
+@register("MultiProposal", inputs=("cls_prob", "bbox_pred", "im_info"),
+          infer_shape=_mp_infer,
+          num_outputs=lambda a: 2 if parse_bool(
+              a.get("output_score", False)) else 1,
+          attr_spec={
+              "rpn_pre_nms_top_n": (parse_int, 6000),
+              "rpn_post_nms_top_n": (parse_int, 300),
+              "threshold": (parse_float, 0.7),
+              "rpn_min_size": (parse_int, 16),
+              "scales": (lambda v: _parse_floats(v, (4., 8., 16., 32.)),
+                         (4., 8., 16., 32.)),
+              "ratios": (lambda v: _parse_floats(v, (0.5, 1., 2.)),
+                         (0.5, 1., 2.)),
+              "feature_stride": (parse_int, 16),
+              "output_score": (parse_bool, False),
+              "iou_loss": (parse_bool, False)})
+def _multi_proposal(attrs, cls_prob, bbox_pred, im_info):
+    stride = attrs.get("feature_stride", 16)
+    scales = attrs.get("scales", (4., 8., 16., 32.))
+    ratios = attrs.get("ratios", (0.5, 1., 2.))
+    nms_t = attrs.get("threshold", 0.7)
+    min_size = attrs.get("rpn_min_size", 16)
+    N, _, H, W = cls_prob.shape
+    base = _generate_base_anchors(stride, scales, ratios)     # (A, 4)
+    A = base.shape[0]
+    sx = (jnp.arange(W) * stride).astype(jnp.float32)
+    sy = (jnp.arange(H) * stride).astype(jnp.float32)
+    syg, sxg = jnp.meshgrid(sy, sx, indexing="ij")
+    shifts = jnp.stack([sxg, syg, sxg, syg], axis=-1)         # (H, W, 4)
+    anchors = shifts[:, :, None, :] + jnp.asarray(base)       # (H, W, A, 4)
+    anchors = anchors.reshape(-1, 4)                          # (HWA, 4)
+    total = H * W * A
+    pre = min(parse_int(attrs.get("rpn_pre_nms_top_n", 6000)), total)
+    post = parse_int(attrs.get("rpn_post_nms_top_n", 300))
+
+    def one(cp, bp, info):
+        # fg scores: channels [A:2A); layout (A, H, W) -> (H, W, A)
+        score = cp[A:].transpose(1, 2, 0).reshape(-1)
+        deltas = bp.reshape(A, 4, H, W).transpose(2, 3, 0, 1).reshape(-1, 4)
+        if parse_bool(attrs.get("iou_loss", False)):
+            # IoU-loss decoding: deltas are corner offsets
+            # (reference multi_proposal.cc IoUTransformInv)
+            boxes = anchors + deltas
+        else:
+            aw = anchors[:, 2] - anchors[:, 0] + 1.0
+            ah = anchors[:, 3] - anchors[:, 1] + 1.0
+            acx = anchors[:, 0] + 0.5 * (aw - 1.0)
+            acy = anchors[:, 1] + 0.5 * (ah - 1.0)
+            cx = deltas[:, 0] * aw + acx
+            cy = deltas[:, 1] * ah + acy
+            w = jnp.exp(deltas[:, 2]) * aw
+            h = jnp.exp(deltas[:, 3]) * ah
+            boxes = jnp.stack([cx - 0.5 * (w - 1.0), cy - 0.5 * (h - 1.0),
+                               cx + 0.5 * (w - 1.0), cy + 0.5 * (h - 1.0)],
+                              axis=-1)
+        im_h, im_w, im_scale = info[0], info[1], info[2]
+        boxes = jnp.stack([jnp.clip(boxes[:, 0], 0.0, im_w - 1.0),
+                           jnp.clip(boxes[:, 1], 0.0, im_h - 1.0),
+                           jnp.clip(boxes[:, 2], 0.0, im_w - 1.0),
+                           jnp.clip(boxes[:, 3], 0.0, im_h - 1.0)], axis=-1)
+        ms = min_size * im_scale
+        bw = boxes[:, 2] - boxes[:, 0] + 1.0
+        bh = boxes[:, 3] - boxes[:, 1] + 1.0
+        valid = (bw >= ms) & (bh >= ms)
+        score_m = jnp.where(valid, score, -jnp.inf)
+        top_scores, top_idx = lax.top_k(score_m, pre)
+        top_boxes = boxes[top_idx]
+        ious = _iou_pixel(top_boxes, top_boxes)
+        upper = jnp.arange(pre)[:, None] > jnp.arange(pre)[None, :]
+        suppress = (ious > nms_t) & upper
+
+        def body(i, alive):
+            return alive & ~(suppress[:, i] & alive[i])
+        alive = lax.fori_loop(0, pre, body, jnp.ones((pre,), dtype=bool))
+        alive = alive & jnp.isfinite(top_scores)
+        # stable-compact the survivors to the front, pad with box 0
+        gather = _compact_indices(alive, pre, post)
+        out_boxes = top_boxes[gather]
+        gathered = top_scores[gather]
+        out_scores = jnp.where((jnp.arange(post) < jnp.sum(alive)) &
+                               jnp.isfinite(gathered), gathered, 0.0)
+        return out_boxes, out_scores
+
+    def _compact_indices(alive, pre, post):
+        """Indices of the first `post` survivors (first index repeated as
+        padding when fewer survive)."""
+        key = jnp.where(alive, jnp.arange(pre), pre)
+        order = jnp.argsort(key)          # survivors first, in order
+        first = order[0]
+        idx = order[:post] if pre >= post else jnp.concatenate(
+            [order, jnp.full((post - pre,), first, jnp.int32)])
+        n_alive = jnp.sum(alive)
+        return jnp.where(jnp.arange(post) < n_alive.clip(1), idx, first)
+
+    boxes, scores = jax.vmap(one)(cls_prob, bbox_pred, im_info)
+    batch_idx = jnp.repeat(jnp.arange(N, dtype=boxes.dtype), post)
+    rois = jnp.concatenate([batch_idx[:, None],
+                            boxes.reshape(N * post, 4)], axis=-1)
+    if parse_bool(attrs.get("output_score", False)):
+        return rois, scores.reshape(N * post, 1)
+    return rois
+
+alias("_contrib_MultiProposal", "MultiProposal")
+alias("_contrib_Proposal", "MultiProposal")
+alias("Proposal", "MultiProposal")

@@ -77,9 +77,11 @@ def _fc_infer(attrs, in_shapes, out_known=None):
 def _fully_connected(attrs, data, weight, bias=None):
     if data.ndim > 2 and attrs.get("flatten", True):
         data = data.reshape((data.shape[0], -1))
-    # weight stored (num_hidden, in_dim) per reference layout -> x @ W^T on MXU
-    out = jnp.dot(data, weight.T.astype(data.dtype),
-                  preferred_element_type=jnp.float32).astype(data.dtype)
+    # weight stored (num_hidden, in_dim) per reference layout -> x @ W^T on
+    # MXU; bf16 operands accumulate in f32 natively on the MXU, so no
+    # explicit preferred_element_type (whose downcast breaks the conv/dot
+    # transpose rules under mixed dtypes)
+    out = jnp.dot(data, weight.T.astype(data.dtype))
     if bias is not None:
         out = out + bias.astype(data.dtype)
     return out
@@ -157,11 +159,13 @@ def _convolution(attrs, data, weight, bias=None):
     else:
         dn = lax.conv_dimension_numbers(data.shape, weight.shape,
                                         ("NCDHW", "OIDHW", "NCDHW"))
+    # bf16 operands accumulate in f32 on the MXU natively; an explicit
+    # preferred_element_type=f32 + downcast breaks conv's VJP transpose
+    # (f32 cotangent vs bf16 operand), so operand dtypes drive the output
     out = lax.conv_general_dilated(
         data, weight.astype(data.dtype), stride,
         [(p, p) for p in pad], rhs_dilation=dilate,
-        dimension_numbers=dn, feature_group_count=ng,
-        preferred_element_type=jnp.float32).astype(data.dtype)
+        dimension_numbers=dn, feature_group_count=ng)
     if bias is not None:
         out = out + bias.astype(data.dtype).reshape((1, -1) + (1,) * nd)
     return out

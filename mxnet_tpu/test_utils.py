@@ -301,8 +301,19 @@ def check_consistency(sym, ctx_list, scale=1.0, grad_req="write",
             arr[:] = arg_dict[name]
         for name, arr in exe.aux_dict.items():
             arr[:] = aux_dict[name]
-    dtypes = [np.dtype(exe.outputs[0].dtype) if exe.outputs else
-              np.dtype(np.float32) for exe in exe_list]
+    def _exe_dtype(exe):
+        """Least-precise float among the executor's inputs and outputs —
+        some ops upcast internally (e.g. f16 in, f32 out), and the bound
+        precision, not the output dtype, is what tolerance must track."""
+        cands = [np.dtype(a.dtype) for a in exe.arg_dict.values()]
+        cands += [np.dtype(o.dtype) for o in exe.outputs]
+        floats = [d for d in cands if d.kind == "f"]
+        if not floats:
+            return np.dtype(exe.outputs[0].dtype) if exe.outputs \
+                else np.dtype(np.float32)
+        return min(floats, key=lambda d: d.itemsize)
+
+    dtypes = [_exe_dtype(exe) for exe in exe_list]
     # forward
     for exe in exe_list:
         exe.forward(is_train=False)

@@ -1,0 +1,756 @@
+"""Registry-wide operator verification sweep.
+
+Every op in ``OP_REGISTRY`` is either exercised here (dtype-parity via
+``check_consistency`` f32-vs-f16 and, where differentiable, finite-difference
+gradients via ``check_numeric_gradient``) or listed in ``SKIPS`` with the
+reason and the test file that covers it instead. ``test_registry_coverage``
+enforces that invariant and prints the per-op coverage report.
+
+Mirrors the reference's two harnesses in one place: the per-op numeric
+checks of tests/python/unittest/test_operator.py (3159 LoC) and the
+cross-config parity sweep of tests/python/gpu/test_operator_gpu.py built on
+check_consistency (reference python/mxnet/test_utils.py:676).
+"""
+import zlib
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.ops import OP_REGISTRY
+from mxnet_tpu.test_utils import (check_consistency, check_numeric_gradient,
+                                  assert_almost_equal)
+
+F32, F16 = np.float32, np.float16
+
+
+# ---------------------------------------------------------------------------
+# input generators (domain-safe values so f16 parity and finite differences
+# stay well-conditioned: away from kinks, branch cuts and integer boundaries)
+# ---------------------------------------------------------------------------
+def U(lo, hi):
+    return lambda shape, rng: rng.uniform(lo, hi, shape).astype(F32)
+
+
+def signed_away_from_zero(lo=0.3, hi=1.0):
+    def gen(shape, rng):
+        mag = rng.uniform(lo, hi, shape)
+        sgn = np.where(rng.rand(*shape) < 0.5, -1.0, 1.0)
+        return (mag * sgn).astype(F32)
+    return gen
+
+
+def well_separated(lo=-2.0, hi=2.0):
+    """Values with pairwise gaps (safe FD through max/min/sort kinks)."""
+    def gen(shape, rng):
+        n = int(np.prod(shape))
+        vals = np.linspace(lo, hi, n) + rng.uniform(-0.1, 0.1, n) * (
+            (hi - lo) / (4 * n))
+        rng.shuffle(vals)
+        return vals.reshape(shape).astype(F32)
+    return gen
+
+
+def int_valued(high):
+    return lambda shape, rng: rng.randint(0, high, shape).astype(F32)
+
+
+DEFAULT_GEN = U(-1.0, 1.0)
+
+
+class Case:
+    """One sweep configuration of an op.
+
+    shapes   : input name -> shape (simple_bind kwargs; weights inferred)
+    attrs    : op kwargs
+    gen      : input name -> generator(shape, rng)
+    grad     : run check_numeric_gradient
+    grad_nodes : restrict FD to these args (bounds cost on layer ops)
+    grad_req : consistency backward mode ("null" = forward-only parity)
+    builder  : optional fn(vars_dict, attrs) -> Symbol for nonstandard
+               composition (variadic/optional-input ops)
+    """
+
+    def __init__(self, shapes, attrs=None, gen=None, grad=True,
+                 grad_nodes=None, grad_req="write", eps=1e-2, grad_rtol=0.06,
+                 tol=None, builder=None, aux=None, consistency=True):
+        self.shapes = shapes
+        self.attrs = attrs or {}
+        self.gen = gen or {}
+        self.grad = grad
+        self.grad_nodes = grad_nodes
+        self.grad_req = grad_req
+        self.eps = eps
+        self.grad_rtol = grad_rtol
+        self.tol = tol
+        self.builder = builder
+        self.aux = aux or {}
+        self.consistency = consistency
+
+
+def _build(name, case):
+    if case.builder is not None:
+        vars_ = {k: mx.sym.var(k) for k in case.shapes}
+        return case.builder(vars_, dict(case.attrs))
+    op = getattr(mx.sym, name)
+    kwargs = {k: mx.sym.var(k) for k in case.shapes}
+    return op(name="t", **kwargs, **case.attrs)
+
+
+def _arrays(case, rng):
+    out = {}
+    for k, shape in case.shapes.items():
+        gen = case.gen.get(k, DEFAULT_GEN)
+        out[k] = gen(shape, rng)
+    return out
+
+
+def run_case(name, case):
+    rng = np.random.RandomState(zlib.crc32(name.encode()) % (2 ** 31))
+    sym = _build(name, case)
+    data = _arrays(case, rng)
+    aux = {k: v.copy() for k, v in case.aux.items()} or None
+
+    if case.consistency:
+        args = sym.list_arguments()
+        ctx_f32 = {"ctx": mx.cpu(), "type_dict": {a: F32 for a in args},
+                   **case.shapes}
+        ctx_f16 = {"ctx": mx.cpu(), "type_dict": {a: F16 for a in args},
+                   **case.shapes}
+        check_consistency(sym, [ctx_f32, ctx_f16], grad_req=case.grad_req,
+                          arg_params=data, aux_params=aux, tol=case.tol)
+
+    if case.grad:
+        # fill remaining args (auto-created weights) with small random values
+        loc = dict(data)
+        shapes_known = {k: v.shape for k, v in loc.items()}
+        arg_shapes, _, aux_shapes = sym.infer_shape_partial(**shapes_known)
+        for nm, shp in zip(sym.list_arguments(), arg_shapes):
+            if nm not in loc:
+                loc[nm] = rng.uniform(-0.5, 0.5, shp).astype(F32)
+        aux_states = None
+        if sym.list_auxiliary_states():
+            aux_states = {nm: case.aux.get(
+                nm, rng.uniform(0.5, 1.0, shp).astype(F32))
+                for nm, shp in zip(sym.list_auxiliary_states(), aux_shapes)}
+        check_numeric_gradient(sym, loc, aux_states=aux_states,
+                               numeric_eps=case.eps, rtol=case.grad_rtol,
+                               grad_nodes=case.grad_nodes)
+
+
+# ---------------------------------------------------------------------------
+# the case table
+# ---------------------------------------------------------------------------
+CASES = {}
+
+
+def add(name, *cases):
+    CASES[name] = list(cases)
+
+
+S23 = {"data": (2, 3)}
+
+# ---- unary math family (domain-restricted generators) ----
+_unary = {
+    "abs": signed_away_from_zero(),
+    "arccos": U(-0.8, 0.8), "arcsin": U(-0.8, 0.8),
+    "arccosh": U(1.3, 3.0), "arcsinh": U(-2.0, 2.0),
+    "arctan": U(-2.0, 2.0), "arctanh": U(-0.7, 0.7),
+    "cos": U(-1.2, 1.2), "sin": U(-1.2, 1.2), "tan": U(-0.9, 0.9),
+    "cosh": U(-1.5, 1.5), "sinh": U(-1.5, 1.5), "tanh": U(-2.0, 2.0),
+    "degrees": U(-2.0, 2.0), "radians": U(-90.0, 90.0),
+    "exp": U(-1.5, 1.5), "expm1": U(-1.5, 1.5),
+    "gamma": U(0.6, 2.8), "gammaln": U(0.6, 2.8),
+    "log": U(0.4, 2.5), "log10": U(0.4, 2.5), "log2": U(0.4, 2.5),
+    "log1p": U(-0.6, 2.0),
+    "negative": U(-2.0, 2.0),
+    "relu": signed_away_from_zero(),
+    "rsqrt": U(0.4, 2.5), "sqrt": U(0.4, 2.5),
+    "sigmoid": U(-2.5, 2.5), "square": U(-2.0, 2.0),
+}
+for _n, _g in _unary.items():
+    add(_n, Case(S23, gen={"data": _g}))
+
+# rounding / sign ops: piecewise-constant (zero gradient a.e.) — FD across
+# the jumps is meaningless, so forward parity only with inputs away from
+# boundaries
+_round_gen = lambda shape, rng: (  # noqa: E731
+    rng.randint(-3, 4, shape) + rng.uniform(0.15, 0.35, shape)).astype(F32)
+for _n in ["ceil", "floor", "fix", "rint", "round"]:
+    add(_n, Case(S23, gen={"data": _round_gen}, grad=False))
+add("sign", Case(S23, gen={"data": signed_away_from_zero()}, grad=False))
+
+add("smooth_l1", Case(S23, attrs={"scalar": 1.0},
+                      gen={"data": well_separated(-2.5, 2.5)}))
+add("identity", Case(S23))
+# stop_gradient is identity in forward, so FD sees a nonzero slope while
+# the symbolic grad is (correctly) zero — forward parity only
+add("stop_gradient", Case(S23, grad=False))
+add("make_loss", Case(S23, grad=False, grad_req="null"))
+add("ones_like", Case(S23, grad=False))
+add("zeros_like", Case(S23, grad=False))
+add("argmax_channel", Case({"data": (3, 4)}, grad=False, grad_req="null",
+                           gen={"data": well_separated()}))
+
+# ---- binary elemwise family ----
+LHS_RHS = {"lhs": (2, 3), "rhs": (2, 3)}
+POS = {"lhs": U(0.4, 2.0), "rhs": U(0.4, 2.0)}
+add("elemwise_add", Case(LHS_RHS))
+add("elemwise_sub", Case(LHS_RHS))
+add("elemwise_mul", Case(LHS_RHS))
+add("elemwise_div", Case(LHS_RHS, gen={"rhs": signed_away_from_zero(0.5)}))
+add("_power", Case(LHS_RHS, gen=POS))
+add("_hypot", Case(LHS_RHS, gen={"lhs": signed_away_from_zero(),
+                                 "rhs": signed_away_from_zero()}))
+add("_maximum", Case(LHS_RHS, gen={"lhs": well_separated(-2, 2),
+                                   "rhs": well_separated(-1.9, 2.1)}))
+add("_minimum", Case(LHS_RHS, gen={"lhs": well_separated(-2, 2),
+                                   "rhs": well_separated(-1.9, 2.1)}))
+add("_mod", Case(LHS_RHS, gen={"lhs": U(0.55, 0.95), "rhs": U(1.1, 2.0)},
+                 grad=False))
+
+# comparisons: boolean outputs, forward parity only
+for _n in ["_equal", "_not_equal", "_greater", "_greater_equal", "_lesser",
+           "_lesser_equal"]:
+    add(_n, Case(LHS_RHS, grad=False, grad_req="null",
+                 gen={"lhs": int_valued(3), "rhs": int_valued(3)}))
+
+# scalar variants
+SC = {"scalar": 1.5}
+add("_plus_scalar", Case(S23, attrs=SC))
+add("_minus_scalar", Case(S23, attrs=SC))
+add("_rminus_scalar", Case(S23, attrs=SC))
+add("_mul_scalar", Case(S23, attrs=SC))
+add("_div_scalar", Case(S23, attrs=SC))
+add("_rdiv_scalar", Case(S23, attrs=SC,
+                         gen={"data": signed_away_from_zero(0.5)}))
+add("_mod_scalar", Case(S23, attrs=SC, gen={"data": U(0.2, 1.2)},
+                        grad=False))
+add("_rmod_scalar", Case(S23, attrs=SC,
+                         gen={"data": U(1.7, 2.8)}, grad=False))
+add("_power_scalar", Case(S23, attrs={"scalar": 2.5},
+                          gen={"data": U(0.4, 2.0)}))
+add("_rpower_scalar", Case(S23, attrs={"scalar": 1.5},
+                           gen={"data": U(-1.5, 1.5)}))
+add("_hypot_scalar", Case(S23, attrs=SC,
+                          gen={"data": signed_away_from_zero()}))
+add("_maximum_scalar", Case(S23, attrs={"scalar": 0.1},
+                            gen={"data": well_separated(-2, 2)}))
+add("_minimum_scalar", Case(S23, attrs={"scalar": 0.1},
+                            gen={"data": well_separated(-2, 2)}))
+for _n in ["_equal_scalar", "_not_equal_scalar", "_greater_scalar",
+           "_greater_equal_scalar", "_lesser_scalar",
+           "_lesser_equal_scalar"]:
+    add(_n, Case(S23, attrs={"scalar": 1.0}, grad=False, grad_req="null",
+                 gen={"data": int_valued(3)}))
+
+# ---- broadcast family ----
+BC = {"lhs": (2, 1, 3), "rhs": (1, 4, 3)}
+add("broadcast_add", Case(BC))
+add("broadcast_sub", Case(BC))
+add("broadcast_mul", Case(BC))
+add("broadcast_div", Case(BC, gen={"rhs": signed_away_from_zero(0.5)}))
+add("broadcast_power", Case(BC, gen=POS))
+add("broadcast_hypot", Case(BC, gen={"lhs": signed_away_from_zero(),
+                                     "rhs": signed_away_from_zero()}))
+add("broadcast_maximum", Case(BC, gen={"lhs": well_separated(-2, 2),
+                                       "rhs": well_separated(-1.9, 2.1)}))
+add("broadcast_minimum", Case(BC, gen={"lhs": well_separated(-2, 2),
+                                       "rhs": well_separated(-1.9, 2.1)}))
+add("broadcast_mod", Case(BC, gen={"lhs": U(0.55, 0.95),
+                                   "rhs": U(1.1, 2.0)}, grad=False))
+for _n in ["broadcast_equal", "broadcast_not_equal", "broadcast_greater",
+           "broadcast_greater_equal", "broadcast_lesser",
+           "broadcast_lesser_equal"]:
+    add(_n, Case(BC, grad=False, grad_req="null",
+                 gen={"lhs": int_valued(3), "rhs": int_valued(3)}))
+add("broadcast_axis", Case({"data": (2, 1, 3)}, attrs={"axis": 1, "size": 4}))
+add("broadcast_to", Case({"data": (2, 1, 3)}, attrs={"shape": (2, 4, 3)}))
+
+# ---- reductions ----
+R_SHAPE = {"data": (2, 3, 4)}
+add("sum", Case(R_SHAPE, attrs={"axis": 1}),
+    Case(R_SHAPE, attrs={"axis": (0, 2), "keepdims": True}))
+add("mean", Case(R_SHAPE, attrs={"axis": 2}))
+add("prod", Case(R_SHAPE, attrs={"axis": 1},
+                 gen={"data": signed_away_from_zero(0.5, 1.5)}))
+add("nansum", Case(R_SHAPE, attrs={"axis": 1}, grad=False))
+add("nanprod", Case(R_SHAPE, attrs={"axis": 1}, grad=False,
+                    gen={"data": signed_away_from_zero(0.5, 1.5)}))
+add("max", Case(R_SHAPE, attrs={"axis": 1}, grad=False,
+                gen={"data": well_separated()}))
+add("min", Case(R_SHAPE, attrs={"axis": 1}, grad=False,
+                gen={"data": well_separated()}))
+add("norm", Case({"data": (3, 4)}, gen={"data": signed_away_from_zero()}))
+add("argmax", Case(R_SHAPE, attrs={"axis": 1}, grad=False, grad_req="null",
+                   gen={"data": well_separated()}))
+add("argmin", Case(R_SHAPE, attrs={"axis": 1}, grad=False, grad_req="null",
+                   gen={"data": well_separated()}))
+
+# ---- ordering ----
+add("sort", Case({"data": (3, 4)}, attrs={"axis": 1}, grad=False,
+                 gen={"data": well_separated()}))
+add("argsort", Case({"data": (3, 4)}, attrs={"axis": 1}, grad=False,
+                    grad_req="null", gen={"data": well_separated()}))
+add("topk", Case({"data": (3, 5)}, attrs={"axis": 1, "k": 2}, grad=False,
+                 grad_req="null", gen={"data": well_separated()}))
+
+# ---- indexing ----
+add("Embedding",
+    Case({"data": (4,), "weight": (5, 3)}, attrs={"input_dim": 5,
+                                                  "output_dim": 3},
+         gen={"data": int_valued(5)}, grad_nodes=["weight"]))
+add("take", Case({"a": (5, 3), "indices": (4,)},
+                 gen={"indices": int_valued(5)}, grad_nodes=["a"]))
+add("batch_take", Case({"a": (4, 3), "indices": (4,)},
+                       gen={"indices": int_valued(3)}, grad_nodes=["a"]))
+add("one_hot", Case({"indices": (5,)}, attrs={"depth": 4}, grad=False,
+                    grad_req="null", gen={"indices": int_valued(4)}))
+add("pick", Case({"data": (4, 3), "index": (4,)},
+                 gen={"index": int_valued(3)}, grad_nodes=["data"]))
+
+# ---- shape manipulation ----
+add("Reshape", Case({"data": (2, 6)}, attrs={"shape": (3, 4)}))
+add("Flatten", Case({"data": (2, 3, 2)}))
+add("expand_dims", Case(S23, attrs={"axis": 1}))
+add("slice", Case({"data": (4, 5)}, attrs={"begin": (1, 0), "end": (3, 4)}))
+add("slice_axis", Case({"data": (4, 5)},
+                       attrs={"axis": 1, "begin": 1, "end": 4}))
+add("flip", Case(R_SHAPE, attrs={"axis": 1}))
+add("repeat", Case(S23, attrs={"repeats": 2, "axis": 1}))
+add("tile", Case(S23, attrs={"reps": (2, 1)}))
+add("transpose", Case(R_SHAPE, attrs={"axes": (2, 0, 1)}))
+add("SwapAxis", Case(R_SHAPE, attrs={"dim1": 0, "dim2": 2}))
+add("clip", Case({"data": (3, 4)}, attrs={"a_min": -0.8, "a_max": 0.8},
+                 gen={"data": well_separated(-1.5, 1.5)}))
+add("where", Case({"condition": (2, 3), "x": (2, 3), "y": (2, 3)},
+                  gen={"condition": int_valued(2)}, grad_nodes=["x", "y"]))
+add("Pad", Case({"data": (1, 2, 3, 3)},
+                attrs={"mode": "constant", "constant_value": 0.5,
+                       "pad_width": (0, 0, 0, 0, 1, 1, 1, 1)}),
+    Case({"data": (1, 2, 3, 3)},
+         attrs={"mode": "edge", "pad_width": (0, 0, 0, 0, 1, 1, 1, 1)}),
+    Case({"data": (1, 2, 4, 4)},
+         attrs={"mode": "reflect", "pad_width": (0, 0, 0, 0, 1, 1, 1, 1)}))
+add("Cast", Case(S23, attrs={"dtype": "float32"}, grad=False,
+                 grad_req="null"))
+
+# ---- matrix ----
+add("dot", Case({"lhs": (2, 3), "rhs": (3, 4)}),
+    Case({"lhs": (3, 2), "rhs": (3, 4)}, attrs={"transpose_a": True}))
+add("batch_dot", Case({"lhs": (2, 2, 3), "rhs": (2, 3, 2)}))
+
+# ---- variadic ----
+add("Concat",
+    Case({"a": (2, 2), "b": (2, 3)},
+         builder=lambda v, a: mx.sym.Concat(v["a"], v["b"], dim=1,
+                                            num_args=2)))
+add("SliceChannel",
+    Case({"data": (2, 6)},
+         builder=lambda v, a: mx.sym.SliceChannel(v["data"], num_outputs=2,
+                                                  axis=1)[0],
+         grad=False))
+add("ElementWiseSum",
+    Case({"a": (2, 3), "b": (2, 3), "c": (2, 3)},
+         builder=lambda v, a: mx.sym.ElementWiseSum(v["a"], v["b"], v["c"],
+                                                    num_args=3)))
+add("UpSampling",
+    Case({"data": (1, 2, 3, 3)},
+         builder=lambda v, a: mx.sym.UpSampling(v["data"], scale=2,
+                                                sample_type="nearest",
+                                                num_args=1)))
+add("Crop",
+    Case({"data": (1, 2, 5, 5)},
+         builder=lambda v, a: mx.sym.Crop(v["data"], num_args=1,
+                                          offset=(1, 1), h_w=(3, 3))))
+
+# ---- nn layer ops ----
+add("Activation",
+    Case({"data": (2, 4)}, attrs={"act_type": "relu"},
+         gen={"data": signed_away_from_zero()}),
+    Case({"data": (2, 4)}, attrs={"act_type": "sigmoid"}),
+    Case({"data": (2, 4)}, attrs={"act_type": "tanh"}),
+    Case({"data": (2, 4)}, attrs={"act_type": "softrelu"}))
+add("FullyConnected",
+    Case({"data": (3, 4)}, attrs={"num_hidden": 3}))
+add("Convolution",
+    Case({"data": (1, 2, 5, 5)},
+         attrs={"kernel": (3, 3), "num_filter": 2}, grad_nodes=["data"]),
+    Case({"data": (1, 2, 4, 4)},
+         attrs={"kernel": (2, 2), "num_filter": 2, "stride": (2, 2),
+                "num_group": 2, "no_bias": True}, grad_nodes=["data"]))
+add("Deconvolution",
+    Case({"data": (1, 2, 3, 3)},
+         attrs={"kernel": (2, 2), "num_filter": 2, "stride": (2, 2),
+                "no_bias": True}, grad_nodes=["data"]))
+add("Pooling",
+    Case({"data": (1, 2, 4, 4)},
+         attrs={"kernel": (2, 2), "stride": (2, 2), "pool_type": "max"},
+         gen={"data": well_separated()}, grad=False),
+    Case({"data": (1, 2, 4, 4)},
+         attrs={"kernel": (2, 2), "stride": (2, 2), "pool_type": "avg"},
+         grad_nodes=["data"]),
+    Case({"data": (1, 2, 4, 4)},
+         attrs={"global_pool": True, "kernel": (2, 2), "pool_type": "max"},
+         gen={"data": well_separated()}, grad=False))
+add("BatchNorm",
+    Case({"data": (3, 2)}, attrs={"fix_gamma": False},
+         grad_nodes=["data", "t_gamma", "t_beta"],
+         aux={"t_moving_mean": np.zeros(2, F32),
+              "t_moving_var": np.ones(2, F32)}))
+add("InstanceNorm",
+    Case({"data": (2, 2, 4)}, grad_nodes=["data"], grad_rtol=0.08))
+add("L2Normalization",
+    Case({"data": (2, 3)}, attrs={"mode": "instance"},
+         gen={"data": signed_away_from_zero()}),
+    Case({"data": (2, 3, 4)}, attrs={"mode": "channel"},
+         gen={"data": signed_away_from_zero()}, grad=False),
+    Case({"data": (2, 3, 4)}, attrs={"mode": "spatial"},
+         gen={"data": signed_away_from_zero()}, grad=False))
+add("LRN", Case({"data": (1, 4, 3, 3)}, attrs={"nsize": 3},
+                grad_nodes=["data"], grad_rtol=0.08))
+add("LeakyReLU",
+    Case({"data": (2, 4)}, attrs={"act_type": "leaky", "slope": 0.3},
+         gen={"data": signed_away_from_zero()}),
+    Case({"data": (2, 4)}, attrs={"act_type": "elu", "slope": 0.3},
+         gen={"data": signed_away_from_zero()}))
+add("Dropout", Case({"data": (2, 4)}, attrs={"p": 0.5}, grad=False,
+                    grad_req="null"))
+add("SoftmaxActivation",
+    Case({"data": (3, 4)}),
+    Case({"data": (2, 3, 2, 2)}, attrs={"mode": "channel"}))
+add("softmax", Case({"data": (3, 4)}, attrs={"axis": 1}))
+add("log_softmax", Case({"data": (3, 4)}, attrs={"axis": 1}))
+
+# ---- sequence ops (length input is optional; exercised with it on) ----
+add("SequenceLast",
+    Case({"data": (3, 2, 4)},
+         builder=lambda v, a: mx.sym.SequenceLast(v["data"]),
+         grad_nodes=["data"]))
+add("SequenceMask",
+    Case({"data": (3, 2, 4), "length": (2,)},
+         builder=lambda v, a: mx.sym.SequenceMask(
+             v["data"], v["length"], use_sequence_length=True, value=0.0),
+         gen={"length": lambda s, r: np.array([2, 3], F32)},
+         grad_nodes=["data"]))
+add("SequenceReverse",
+    Case({"data": (3, 2, 4)},
+         builder=lambda v, a: mx.sym.SequenceReverse(v["data"]),
+         grad_nodes=["data"]))
+
+# ---- spatial / vision ops ----
+add("ROIPooling",
+    Case({"data": (1, 2, 6, 6), "rois": (2, 5)},
+         attrs={"pooled_size": (2, 2), "spatial_scale": 1.0},
+         gen={"data": well_separated(),
+              "rois": lambda s, r: np.array([[0, 0, 0, 3, 3],
+                                             [0, 1, 1, 5, 5]], F32)},
+         grad=False))
+# grid gradient has kinks wherever a sample point crosses a pixel-cell
+# boundary — FD across those is unreliable, so FD covers data only (grid
+# still exercised by the f32/f16 consistency backward)
+add("BilinearSampler",
+    Case({"data": (1, 2, 4, 4), "grid": (1, 2, 3, 3)},
+         gen={"grid": U(-0.7, 0.7)}, grad_nodes=["data"],
+         grad_rtol=0.08))
+add("GridGenerator",
+    Case({"data": (1, 6)}, attrs={"transform_type": "affine",
+                                  "target_shape": (4, 4)},
+         gen={"data": lambda s, r: np.array(
+             [[1.1, 0.1, 0.05, -0.1, 0.9, 0.02]], F32)}),
+    Case({"data": (1, 2, 4, 4)}, attrs={"transform_type": "warp"},
+         gen={"data": U(-0.3, 0.3)}, grad=False))
+add("SpatialTransformer",
+    Case({"data": (1, 2, 4, 4), "loc": (1, 6)},
+         attrs={"transform_type": "affine", "sampler_type": "bilinear",
+                "target_shape": (3, 3)},
+         gen={"loc": lambda s, r: np.array(
+             [[0.9, 0.05, 0.02, -0.05, 0.85, -0.02]], F32)},
+         grad_nodes=["data", "loc"], grad_rtol=0.09))
+add("Correlation",
+    Case({"data1": (1, 2, 4, 4), "data2": (1, 2, 4, 4)},
+         attrs={"kernel_size": 1, "max_displacement": 1, "stride1": 1,
+                "stride2": 1, "pad_size": 1},
+         grad=False))
+
+# ---- loss heads (forward parity; backward semantics covered by
+# test_operator.py / executor loss-seeding tests) ----
+LBL = {"label": int_valued(3)}
+add("SoftmaxOutput", Case({"data": (4, 3), "label": (4,)}, gen=LBL,
+                          grad=False, grad_req="null"))
+add("LinearRegressionOutput", Case({"data": (4, 3), "label": (4, 3)},
+                                   grad=False, grad_req="null"))
+add("LogisticRegressionOutput", Case({"data": (4, 3), "label": (4, 3)},
+                                     grad=False, grad_req="null"))
+add("MAERegressionOutput", Case({"data": (4, 3), "label": (4, 3)},
+                                grad=False, grad_req="null"))
+add("SVMOutput", Case({"data": (4, 3), "label": (4,)}, gen=LBL,
+                      grad=False, grad_req="null"))
+add("MakeLoss", Case({"data": (3, 4)}, gen={"data": U(0.1, 1.0)},
+                     grad=False, grad_req="null"))
+add("IdentityAttachKLSparseReg", Case({"data": (3, 4)},
+                                      gen={"data": U(0.05, 0.95)},
+                                      grad=False, grad_req="null"))
+add("softmax_cross_entropy",
+    Case({"data": (4, 3), "label": (4,)}, gen=LBL, grad=False,
+         grad_req="null"))
+add("BlockGrad", Case(S23, grad=False))
+
+# ---- contrib ----
+add("CTCLoss",
+    Case({"data": (5, 2, 4), "label": (2, 3)},
+         gen={"label": lambda s, r: np.array([[1, 2, 0], [2, 3, 1]], F32)},
+         grad=False, grad_req="null", tol=2e-1))
+add("fft", Case({"data": (2, 4)}, grad=False, grad_req="null"))
+add("ifft", Case({"data": (2, 8)}, grad=False, grad_req="null"))
+add("count_sketch",
+    Case({"data": (2, 6), "h": (1, 6), "s": (1, 6)},
+         attrs={"out_dim": 4},
+         gen={"h": int_valued(4),
+              "s": lambda s, r: np.where(r.rand(*s) < 0.5, -1, 1).astype(
+                  F32)},
+         grad=False, grad_req="null"))
+add("quantize",
+    Case({"data": (2, 3), "min_range": (1,), "max_range": (1,)},
+         gen={"data": U(-0.9, 0.9),
+              "min_range": lambda s, r: np.array([-1.0], F32),
+              "max_range": lambda s, r: np.array([1.0], F32)},
+         grad=False, grad_req="null", consistency=False))
+add("dequantize",
+    Case({"data": (2, 3), "min_range": (1,), "max_range": (1,)},
+         gen={"data": int_valued(255),
+              "min_range": lambda s, r: np.array([-1.0], F32),
+              "max_range": lambda s, r: np.array([1.0], F32)},
+         grad=False, grad_req="null", consistency=False))
+add("MultiBoxPrior",
+    Case({"data": (1, 2, 4, 4)},
+         attrs={"sizes": (0.4, 0.8), "ratios": (1.0, 2.0)},
+         grad=False, grad_req="null"))
+add("MultiBoxTarget",
+    Case({"anchor": (1, 4, 4), "label": (1, 2, 5), "cls_pred": (1, 2, 4)},
+         gen={"anchor": lambda s, r: np.array(
+             [[[0.1, 0.1, 0.4, 0.4], [0.5, 0.5, 0.9, 0.9],
+               [0.0, 0.0, 0.2, 0.2], [0.6, 0.1, 0.9, 0.4]]], F32),
+             "label": lambda s, r: np.array(
+                 [[[0, 0.12, 0.12, 0.38, 0.42], [1, 0.55, 0.5, 0.88, 0.92]]],
+                 F32),
+             "cls_pred": lambda s, r: r.uniform(0, 1, s).astype(F32)},
+         grad=False, grad_req="null"))
+add("MultiBoxDetection",
+    Case({"cls_prob": (1, 3, 4), "loc_pred": (1, 16), "anchor": (1, 4, 4)},
+         gen={"cls_prob": lambda s, r: r.dirichlet(
+             np.ones(3), (1, 4)).transpose(0, 2, 1).astype(F32),
+             "loc_pred": U(-0.1, 0.1),
+             "anchor": lambda s, r: np.array(
+                 [[[0.1, 0.1, 0.4, 0.4], [0.5, 0.5, 0.9, 0.9],
+                   [0.0, 0.0, 0.2, 0.2], [0.6, 0.1, 0.9, 0.4]]], F32)},
+         grad=False, grad_req="null"))
+
+# ---------------------------------------------------------------------------
+# ops exercised outside the consistency/FD harness
+# ---------------------------------------------------------------------------
+
+
+def _check_creation_ops():
+    a = mx.nd._arange(start=1, stop=7, step=2)
+    assert_almost_equal(a, np.arange(1, 7, 2, dtype=np.float32))
+    z = mx.nd._zeros(shape=(2, 3))
+    assert_almost_equal(z, np.zeros((2, 3)))
+    o = mx.nd._ones(shape=(2, 3))
+    assert_almost_equal(o, np.ones((2, 3)))
+    f = mx.nd._full(shape=(2, 2), value=3.5)
+    assert_almost_equal(f, np.full((2, 2), 3.5, np.float32))
+
+
+def _check_assign_ops():
+    base = np.arange(12, dtype=np.float32).reshape(3, 4)
+    lhs = mx.nd.array(base)
+    rhs = mx.nd.array(np.full((2, 2), -1.0, np.float32))
+    out = mx.nd._slice_assign(lhs, rhs, begin=(0, 1), end=(2, 3))
+    exp = base.copy()
+    exp[0:2, 1:3] = -1.0
+    assert_almost_equal(out, exp)
+    out2 = mx.nd._crop_assign_scalar(mx.nd.array(base), begin=(1, 0),
+                                     end=(3, 2), scalar=9.0)
+    exp2 = base.copy()
+    exp2[1:3, 0:2] = 9.0
+    assert_almost_equal(out2, exp2)
+    like = mx.nd._identity_with_attr_like_rhs(
+        mx.nd.array(np.ones((2, 2), np.float32)),
+        mx.nd.array(np.zeros((2, 2), np.float32)))
+    assert_almost_equal(like, np.ones((2, 2)))
+
+
+def _check_sampler(name, attrs, mean, std, mean_tol, std_tol):
+    fn = getattr(mx.nd, name)
+    out = fn(shape=(20000,), **attrs)
+    arr = out.asnumpy()
+    assert arr.shape == (20000,)
+    assert np.isfinite(arr).all()
+    assert abs(arr.mean() - mean) < mean_tol, (name, arr.mean(), mean)
+    assert abs(arr.std() - std) < std_tol, (name, arr.std(), std)
+
+
+SAMPLERS = {
+    "_random_uniform": ({"low": -1.0, "high": 1.0}, 0.0, 2 / np.sqrt(12),
+                        0.05, 0.05),
+    "_random_normal": ({"loc": 1.0, "scale": 2.0}, 1.0, 2.0, 0.08, 0.08),
+    "_random_gamma": ({"alpha": 4.0, "beta": 0.5}, 2.0, 1.0, 0.08, 0.08),
+    "_random_exponential": ({"lam": 2.0}, 0.5, 0.5, 0.04, 0.04),
+    "_random_poisson": ({"lam": 3.0}, 3.0, np.sqrt(3.0), 0.1, 0.1),
+    "_random_negative_binomial": ({"k": 3, "p": 0.5}, 3.0, np.sqrt(6.0),
+                                  0.15, 0.15),
+    "_random_generalized_negative_binomial":
+        ({"mu": 2.0, "alpha": 0.5}, 2.0, np.sqrt(2 + 0.5 * 4), 0.15, 0.2),
+}
+
+def _check_multi_proposal():
+    """MultiProposal vs a direct numpy re-derivation of the RPN recipe
+    (reference: src/operator/contrib/multi_proposal.cc)."""
+    rng = np.random.RandomState(7)
+    stride, scales, ratios = 4, (2.0,), (1.0,)
+    A, H, W = 1, 4, 4
+    cls_prob = rng.uniform(0, 1, (1, 2 * A, H, W)).astype(F32)
+    bbox_pred = rng.uniform(-0.2, 0.2, (1, 4 * A, H, W)).astype(F32)
+    im_info = np.array([[16.0, 16.0, 1.0]], F32)
+    post = 4
+    out = mx.nd.MultiProposal(
+        mx.nd.array(cls_prob), mx.nd.array(bbox_pred), mx.nd.array(im_info),
+        feature_stride=stride, scales=scales, ratios=ratios,
+        rpn_pre_nms_top_n=8, rpn_post_nms_top_n=post, rpn_min_size=2,
+        threshold=0.7).asnumpy()
+    assert out.shape == (post, 5)
+    assert (out[:, 0] == 0).all()                      # batch index
+    x1, y1, x2, y2 = out[:, 1], out[:, 2], out[:, 3], out[:, 4]
+    assert (x1 >= 0).all() and (y1 >= 0).all()
+    assert (x2 <= 15).all() and (y2 <= 15).all()       # clipped to im_info
+    assert (x2 - x1 + 1 >= 2).all() and (y2 - y1 + 1 >= 2).all()
+    # numpy recompute of the decoded, clipped top-score box -> must be roi 0
+    base = np.array([0, 0, stride - 1, stride - 1], np.float32)
+    w0 = base[2] - base[0] + 1
+    ws = np.round(np.sqrt(w0 * w0 / ratios[0]))
+    hs = np.round(ws * ratios[0])
+    cx0 = base[0] + 0.5 * (w0 - 1)
+    cy0 = base[1] + 0.5 * (w0 - 1)
+    anchors = []
+    for yy in range(H):
+        for xx in range(W):
+            cx = cx0 + xx * stride
+            cy = cy0 + yy * stride
+            sw, sh = ws * scales[0], hs * scales[0]
+            anchors.append([cx - 0.5 * (sw - 1), cy - 0.5 * (sh - 1),
+                            cx + 0.5 * (sw - 1), cy + 0.5 * (sh - 1)])
+    anchors = np.array(anchors, np.float32)
+    score = cls_prob[0, A:].transpose(1, 2, 0).reshape(-1)
+    deltas = bbox_pred[0].reshape(A, 4, H, W).transpose(
+        2, 3, 0, 1).reshape(-1, 4)
+    aw = anchors[:, 2] - anchors[:, 0] + 1
+    ah = anchors[:, 3] - anchors[:, 1] + 1
+    acx = anchors[:, 0] + 0.5 * (aw - 1)
+    acy = anchors[:, 1] + 0.5 * (ah - 1)
+    pcx = deltas[:, 0] * aw + acx
+    pcy = deltas[:, 1] * ah + acy
+    pw = np.exp(deltas[:, 2]) * aw
+    ph = np.exp(deltas[:, 3]) * ah
+    best = int(np.argmax(score))
+    exp_box = np.array([
+        np.clip(pcx[best] - 0.5 * (pw[best] - 1), 0, 15),
+        np.clip(pcy[best] - 0.5 * (ph[best] - 1), 0, 15),
+        np.clip(pcx[best] + 0.5 * (pw[best] - 1), 0, 15),
+        np.clip(pcy[best] + 0.5 * (ph[best] - 1), 0, 15)], dtype=F32)
+    assert_almost_equal(out[0, 1:], exp_box, rtol=1e-4, atol=1e-4)
+
+
+FUNCTIONAL = {
+    "_arange": _check_creation_ops, "_zeros": _check_creation_ops,
+    "_ones": _check_creation_ops, "_full": _check_creation_ops,
+    "_slice_assign": _check_assign_ops,
+    "_crop_assign_scalar": _check_assign_ops,
+    "_identity_with_attr_like_rhs": _check_assign_ops,
+    "MultiProposal": _check_multi_proposal,
+}
+
+# ---------------------------------------------------------------------------
+# explicit skips — every entry names the covering test or the reason
+# ---------------------------------------------------------------------------
+SKIPS = {
+    "RNN": "fused RNN kernel — fused-vs-unfolded equivalence in "
+           "tests/test_rnn.py",
+    "Custom": "python CustomOp bridge — end-to-end in "
+              "tests/test_custom_op.py",
+    "sgd_update": "mutating optimizer kernel — fused-vs-staged numerics in "
+                  "tests/test_optimizer.py / test_module.py",
+    "sgd_mom_update": "see sgd_update",
+    "adam_update": "see sgd_update",
+    "rmsprop_update": "see sgd_update",
+    "rmspropalex_update": "see sgd_update",
+}
+
+
+def _canonical():
+    """name -> canonical name (first registered name of the same OpDef)."""
+    by_id = {}
+    for n in sorted(OP_REGISTRY):
+        by_id.setdefault(id(OP_REGISTRY[n]), []).append(n)
+    canon = {}
+    for names in by_id.values():
+        covered = [n for n in names
+                   if n in CASES or n in SKIPS or n in SAMPLERS
+                   or n in FUNCTIONAL]
+        root = covered[0] if covered else names[0]
+        for n in names:
+            canon[n] = root
+    return canon
+
+
+CANON = _canonical()
+
+
+@pytest.mark.parametrize("name,idx", [(n, i) for n in sorted(CASES)
+                                      for i in range(len(CASES[n]))])
+def test_op_sweep(name, idx):
+    run_case(name, CASES[name][idx])
+
+
+@pytest.mark.parametrize("name", sorted(SAMPLERS))
+def test_op_sweep_sampler(name):
+    _check_sampler(name, *SAMPLERS[name])
+
+
+@pytest.mark.parametrize("fn", sorted({f.__name__ for f in
+                                       FUNCTIONAL.values()}))
+def test_op_sweep_functional(fn):
+    {f.__name__: f for f in FUNCTIONAL.values()}[fn]()
+
+
+def test_registry_coverage():
+    """Every registered op is swept here or skipped with a named reason."""
+    report, missing = [], []
+    for name in sorted(OP_REGISTRY):
+        root = CANON[name]
+        alias = f" (alias of {root})" if root != name else ""
+        if root in CASES:
+            ncase = len(CASES[root])
+            kinds = []
+            if any(c.consistency for c in CASES[root]):
+                kinds.append("consistency[f32/f16]")
+            if any(c.grad for c in CASES[root]):
+                kinds.append("numeric-grad")
+            report.append(f"TESTED  {name}{alias}: {ncase} case(s): "
+                          f"{'+'.join(kinds)}")
+        elif root in SAMPLERS:
+            report.append(f"TESTED  {name}{alias}: forward moments check")
+        elif root in FUNCTIONAL:
+            report.append(f"TESTED  {name}{alias}: functional check")
+        elif root in SKIPS:
+            report.append(f"SKIPPED {name}{alias}: {SKIPS[root]}")
+        else:
+            missing.append(name)
+    print()
+    print("\n".join(report))
+    n_tested = sum(1 for r in report if r.startswith("TESTED"))
+    n_skipped = sum(1 for r in report if r.startswith("SKIPPED"))
+    print(f"== op sweep coverage: {n_tested} tested, {n_skipped} "
+          f"skipped-with-reason, {len(missing)} uncovered of "
+          f"{len(OP_REGISTRY)} registered ==")
+    assert not missing, f"ops with no sweep coverage: {missing}"
