@@ -20,6 +20,7 @@ parity, for the update_on_kvstore path, and for multi-host grad sync.
 """
 from __future__ import annotations
 
+import os
 import pickle
 
 import numpy as np
@@ -30,7 +31,41 @@ from .base import MXNetError
 from .ndarray import NDArray, zeros
 from . import optimizer as opt
 
-__all__ = ["KVStore", "create"]
+__all__ = ["KVStore", "create", "init_distributed"]
+
+
+def init_distributed():
+    """Connect this process to the training job's coordination service.
+
+    The reference bootstraps its PS cluster from DMLC_* env vars set by
+    tools/launch.py (reference: launch.py:33-75, MXInitPSEnv c_api.h:1196).
+    The same env contract drives the TPU-native runtime: there are no
+    server processes — DMLC_PS_ROOT_URI/PORT name the jax.distributed
+    coordinator (hosted by worker 0) and every worker is a peer in the
+    collective. Idempotent; a single-process run is a no-op.
+    """
+    n = int(os.environ.get("DMLC_NUM_WORKER", "1"))
+    if n <= 1:
+        return
+    import jax._src.distributed as _dist
+    if _dist.global_state.client is not None:
+        return                               # already connected
+    rank = int(os.environ.get("DMLC_WORKER_ID", "0"))
+    uri = os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1")
+    port = os.environ.get("DMLC_PS_ROOT_PORT", "9091")
+    if jax.config.jax_platforms == "cpu" or \
+            os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        # multi-process CPU collectives need the gloo transport; must be
+        # configured before the backend initializes
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    jax.distributed.initialize(coordinator_address=f"{uri}:{port}",
+                               num_processes=n, process_id=rank)
+    if jax.process_count() != n:
+        raise MXNetError(
+            f"distributed init came up with {jax.process_count()} "
+            f"processes, expected {n}: the backend was initialized before "
+            "init_distributed() — create the dist kvstore before touching "
+            "any device")
 
 
 def _ctype_key_value(key, vals):
@@ -149,15 +184,28 @@ class KVStoreDistSync(KVStore):
 
     reference semantics: kvstore_dist.h ZPush/ZPull + server merge-all-then-
     update (kvstore_dist_server.h:164-198). Realization: every process holds
-    a replica; push() all-reduces the gradient across processes (psum over
-    DCN/ICI), then the updater runs identically on every replica — the
-    arithmetic invariant of dist_sync (nightly test formula) holds because
-    sum-then-update on N replicas == server-side update.
+    a replica; push() all-reduces the gradients across processes (one XLA
+    collective over DCN/ICI per bucket), then the updater runs identically
+    on every replica — the arithmetic invariant of dist_sync (nightly test
+    formula) holds because sum-then-update on N replicas == server-side
+    update.
+
+    Unlike the reference's per-key ZPush, a multi-key push() batches every
+    key of the call into large flat buckets (cap: MXNET_KVSTORE_BUCKET_BYTES,
+    default 64 MiB) and all-reduces each bucket as ONE jitted XLA program —
+    the analog of the reference batching gradients into its pinned merge
+    buffers (comm.h InitMergeBuffer).
     """
 
     def __init__(self, kind):
         super().__init__(kind)
+        init_distributed()
         self._nproc = jax.process_count()
+        self._mesh = None
+        self._sum_jit = None
+        # read at use time like the reference's dmlc::GetEnv tuning knobs
+        self.BUCKET_BYTES = int(os.environ.get(
+            "MXNET_KVSTORE_BUCKET_BYTES", 64 << 20))
 
     @property
     def rank(self):
@@ -167,27 +215,108 @@ class KVStoreDistSync(KVStore):
     def num_workers(self):
         return self._nproc
 
+    # ------------------------------------------------------- collective core
+    def _ensure_mesh(self):
+        if self._mesh is not None:
+            return
+        from jax.sharding import Mesh, PartitionSpec, NamedSharding
+        # one device per process: the reduction result is replicated
+        # host-side anyway, and a 1-device-per-proc mesh keeps the
+        # host-local <-> global layout trivial on any pod shape
+        devs = []
+        for p in range(self._nproc):
+            devs.append(next(d for d in jax.devices()
+                             if d.process_index == p))
+        self._mesh = Mesh(np.array(devs), ("proc",))
+        self._pspec = PartitionSpec
+        self._sum_jit = jax.jit(
+            lambda x: jnp.sum(x, axis=0),
+            out_shardings=NamedSharding(self._mesh, PartitionSpec()))
+
+    def _allreduce_flat(self, flat):
+        """All-reduce one 1-D buffer across processes (jitted psum)."""
+        from jax.experimental import multihost_utils
+        self._ensure_mesh()
+        glob = multihost_utils.host_local_array_to_global_array(
+            flat[None], self._mesh, self._pspec("proc"))
+        red = self._sum_jit(glob)
+        return multihost_utils.global_array_to_host_local_array(
+            red, self._mesh, self._pspec())
+
+    def _allreduce(self, arrs):
+        """Batched all-reduce: bucket same-dtype arrays into flat buffers
+        up to BUCKET_BYTES, one collective per bucket."""
+        out = [None] * len(arrs)
+        by_dtype = {}
+        for i, a in enumerate(arrs):
+            by_dtype.setdefault(jnp.asarray(a).dtype, []).append(i)
+        for dt, idxs in by_dtype.items():
+            bucket, nbytes = [], 0
+            buckets = []
+            for i in idxs:
+                sz = arrs[i].size * dt.itemsize
+                if bucket and nbytes + sz > self.BUCKET_BYTES:
+                    buckets.append(bucket)
+                    bucket, nbytes = [], 0
+                bucket.append(i)
+                nbytes += sz
+            if bucket:
+                buckets.append(bucket)
+            for bucket in buckets:
+                flat = jnp.concatenate(
+                    [jnp.ravel(arrs[i]) for i in bucket]) if len(bucket) > 1 \
+                    else jnp.ravel(arrs[bucket[0]])
+                red = self._allreduce_flat(flat)
+                off = 0
+                for i in bucket:
+                    n = arrs[i].size
+                    out[i] = red[off:off + n].reshape(arrs[i].shape)
+                    off += n
+        return out
+
+    # ----------------------------------------------------------------- push
     def push(self, key, value, priority=0):
         keys, vals = _ctype_key_value(key, value)
+        merged = []
         for k, vlist in zip(keys, vals):
             if k not in self._store:
                 raise MXNetError(f"key {k!r} not initialized")
             acc = vlist[0].asjax()
             for v in vlist[1:]:
                 acc = acc + v.asjax()
-            if self._nproc > 1:
-                from jax.experimental import multihost_utils
-                acc = multihost_utils.process_allgather(acc).sum(axis=0)
-            merged = NDArray(acc, ctx=vlist[0].context)
+            merged.append((k, vlist[0].context, acc))
+        if self._nproc > 1:
+            reduced = self._allreduce([a for _, _, a in merged])
+        else:
+            reduced = [a for _, _, a in merged]
+        for (k, ctx, _), red in zip(merged, reduced):
+            nd_val = NDArray(red, ctx=ctx)
             if self._updater is not None:
-                self._updater(k, merged, self._store[k])
+                self._updater(k, nd_val, self._store[k])
             else:
-                self._store[k]._set(merged.asjax())
+                self._store[k]._set(nd_val.asjax())
 
     def _barrier(self):
         if self._nproc > 1:
             from jax.experimental import multihost_utils
             multihost_utils.sync_global_devices("kvstore_barrier")
+
+    # ------------------------------------------------------ failure surface
+    def get_num_dead_node(self, node_id=0, timeout_ms=2000):
+        """Count dead workers (reference: kvstore_dist.h:159-168
+        GetDeadNodes over ps-lite heartbeats). One-sided: queries the
+        coordination service's own liveness tracking — any single rank can
+        call this at any time, no peer cooperation needed. ``timeout_ms``
+        is accepted for reference API parity; the coordination service
+        applies its own heartbeat timeout."""
+        if self._nproc <= 1:
+            return 0
+        import jax._src.distributed as _dist
+        client = _dist.global_state.client
+        if client is None:
+            return 0
+        live = client.get_live_nodes(list(range(self._nproc)))
+        return self._nproc - len(live)
 
 
 def create(name="local"):

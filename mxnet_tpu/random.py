@@ -11,7 +11,9 @@ from __future__ import annotations
 
 import jax
 
-_STATE = {"key": jax.random.PRNGKey(0)}
+# lazy: materializing a key initializes the XLA backend, which must not
+# happen at import time (jax.distributed.initialize comes after import)
+_STATE = {"key": None}
 
 
 def seed(seed_state):
@@ -21,5 +23,7 @@ def seed(seed_state):
 
 def next_key():
     """Split and return a fresh subkey (host-side, stateful)."""
+    if _STATE["key"] is None:
+        _STATE["key"] = jax.random.PRNGKey(0)
     _STATE["key"], sub = jax.random.split(_STATE["key"])
     return sub

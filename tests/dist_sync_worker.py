@@ -1,0 +1,63 @@
+"""dist_sync arithmetic-invariant worker (launched N-way by launch.py).
+
+Port of the reference nightly gate (reference:
+tests/nightly/dist_sync_kvstore.py:1-47): after nrepeat synchronized
+pushes from nworker workers, where worker w pushes ones*(w+1) and the
+store runs the Test optimizer (w += rate * grad), every pulled value must
+equal  (nworker+1)*nworker/2 * rate * nrepeat + 1  — including a large
+key that spans multiple all-reduce buckets, proving the bucketed batched
+collective preserves the per-key arithmetic.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+
+import jax
+
+# same platform forcing as tests/conftest.py: the site plugin ignores
+# JAX_PLATFORMS, the config update does not
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import mxnet_tpu as mx  # noqa: E402
+
+
+def check(val, expected):
+    arr = val.asnumpy()
+    assert np.allclose(arr, expected, rtol=1e-5), (arr.ravel()[:4], expected)
+
+
+def main():
+    # small bucket cap so the big key exercises multi-bucket batching
+    os.environ["MXNET_KVSTORE_BUCKET_BYTES"] = str(1 << 18)   # 256 KiB
+    kv = mx.kv.create("dist_sync")
+    nworker = kv.num_workers
+    rank = kv.rank
+    rate = 2.0
+    shapes = {3: (4, 4), 9: (4, 5), 99: (300, 300)}       # 99: 360 KB > cap
+    kv.set_optimizer(mx.optimizer.create("test", rescale_grad=rate))
+    for k, s in shapes.items():
+        kv.init(k, mx.nd.ones(s))
+
+    nrepeat = 3
+    for _ in range(nrepeat):
+        kv.push(list(shapes), [mx.nd.ones(s) * (rank + 1)
+                               for s in shapes.values()])
+
+    expected = (nworker + 1) * nworker / 2 * rate * nrepeat + 1
+    for k, s in shapes.items():
+        out = mx.nd.empty(s)
+        kv.pull(k, out=out)
+        check(out, expected)
+
+    assert kv.get_num_dead_node(timeout_ms=5000) == 0
+    kv._barrier()
+    print(f"DIST_SYNC_OK rank={rank} nworker={nworker} "
+          f"expected={expected}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
+    sys.exit(0)

@@ -1,0 +1,36 @@
+"""Multi-process dist_sync: the launcher + the nightly arithmetic gate.
+
+Mirrors the reference's `tools/launch.py -n 4 python dist_sync_kvstore.py`
+(reference: tests/nightly/test_all.sh:36) — multi-node simulated by
+multi-process on one host, real collectives between the processes.
+"""
+import os
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _launch(nworkers, timeout=600):
+    env = dict(os.environ)
+    env.pop("DMLC_NUM_WORKER", None)  # never inherit stale cluster env
+    env.pop("DMLC_WORKER_ID", None)
+    return subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "launch.py"),
+         "-n", str(nworkers), sys.executable,
+         os.path.join(ROOT, "tests", "dist_sync_worker.py")],
+        capture_output=True, text=True, timeout=timeout, env=env, cwd=ROOT)
+
+
+@pytest.mark.parametrize("nworkers", [2, 4])
+def test_dist_sync_invariant_multiprocess(nworkers):
+    res = _launch(nworkers)
+    assert res.returncode == 0, (res.stdout[-2000:], res.stderr[-2000:])
+    # workers share the stdout pipe, so lines can interleave — count
+    # whole-marker occurrences, not line prefixes
+    assert res.stdout.count("DIST_SYNC_OK") == nworkers, (
+        res.stdout[-2000:], res.stderr[-2000:])
+    for rank in range(nworkers):
+        assert f"rank={rank} nworker={nworkers}" in res.stdout

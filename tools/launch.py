@@ -1,0 +1,98 @@
+#!/usr/bin/env python
+"""Local cluster launcher for distributed training.
+
+Reference counterpart: tools/launch.py (dmlc-tracker: ssh/mpi/sge/yarn
+backends starting scheduler + N workers + S servers). The TPU-native
+runtime has no servers and no scheduler process — workers are symmetric
+collective peers coordinated by the jax.distributed service hosted on
+worker 0 — so this launcher covers the `local` backend: spawn N worker
+processes on this host with the DMLC_* env contract the framework's
+``mxnet_tpu.kvstore.init_distributed`` consumes:
+
+    DMLC_NUM_WORKER   total workers
+    DMLC_WORKER_ID    this worker's rank
+    DMLC_PS_ROOT_URI  coordinator host (worker 0)
+    DMLC_PS_ROOT_PORT coordinator port
+
+Multi-host launches belong to the cluster scheduler (GKE/slurm/xpk set
+the same variables per host); `-s` is accepted for command-line parity
+with the reference and ignored with a note.
+
+Usage (matches reference tests/nightly/test_all.sh:36):
+    python tools/launch.py -n 4 python my_training_script.py
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+
+def _free_port():
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="launch N local distributed workers")
+    parser.add_argument("-n", "--num-workers", type=int, required=True,
+                        help="number of worker processes")
+    parser.add_argument("-s", "--num-servers", type=int, default=0,
+                        help="ignored: the all-reduce design has no "
+                             "server processes (reference parity flag)")
+    parser.add_argument("--launcher", default="local",
+                        choices=["local"],
+                        help="only 'local' is supported; multi-host "
+                             "launches come from the cluster scheduler")
+    parser.add_argument("command", nargs=argparse.REMAINDER,
+                        help="worker command line")
+    args = parser.parse_args(argv)
+    if not args.command:
+        parser.error("no worker command given")
+    if args.num_servers:
+        print("launch.py: note: -s ignored (no server processes in the "
+              "all-reduce kvstore)", file=sys.stderr)
+
+    port = _free_port()
+    procs = []
+    for rank in range(args.num_workers):
+        env = dict(os.environ)
+        env.update({
+            "DMLC_ROLE": "worker",
+            "DMLC_NUM_WORKER": str(args.num_workers),
+            "DMLC_WORKER_ID": str(rank),
+            "DMLC_PS_ROOT_URI": "127.0.0.1",
+            "DMLC_PS_ROOT_PORT": str(port),
+        })
+        procs.append(subprocess.Popen(args.command, env=env))
+
+    # poll ALL workers: a high-rank crash must tear the job down even while
+    # low ranks are blocked in a collective (rank-order wait() would hang)
+    rc = 0
+    try:
+        while True:
+            codes = [p.poll() for p in procs]
+            failed = [c for c in codes if c not in (None, 0)]
+            if failed and rc == 0:
+                rc = failed[0]
+                for q in procs:  # one worker died: tear the job down
+                    if q.poll() is None:
+                        q.send_signal(signal.SIGTERM)
+            if all(c is not None for c in codes):
+                return rc
+            time.sleep(0.2)
+    except KeyboardInterrupt:
+        for q in procs:
+            if q.poll() is None:
+                q.send_signal(signal.SIGTERM)
+        return 130
+
+
+if __name__ == "__main__":
+    sys.exit(main())
