@@ -23,13 +23,31 @@ reference docs/how_to/perf.md:179-188 is kept as context only).
 from __future__ import annotations
 
 import json
+import os
+import sys
 import time
 
 import numpy as np
 
+# persistent XLA compile cache: the two ResNet-50 programs dominate wall
+# time through the remote-chip tunnel; repeated runs (driver reruns) hit
+# the cache and finish in minutes instead
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      os.path.join(os.path.dirname(
+                          os.path.abspath(__file__)), ".jax_cache"))
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "2")
+
+
+def _log(msg):
+    print(f"[bench +{time.perf_counter() - _T0:.0f}s] {msg}",
+          file=sys.stderr, flush=True)
+
+
+_T0 = time.perf_counter()
+
 BATCH = 256
-N_BATCHES = 8          # synthetic epoch size (per timed epoch)
-TIMED_EPOCHS = 3
+N_BATCHES = 4          # synthetic epoch size (per timed epoch)
+TIMED_EPOCHS = 2
 FLAX_STEPS = N_BATCHES * TIMED_EPOCHS
 NUM_CLASSES = 1000
 LR, MOMENTUM = 0.1, 0.9
@@ -67,9 +85,11 @@ def bench_ours(imgs, labels):
     opt_params = {"learning_rate": LR, "momentum": MOMENTUM}
 
     # epoch 1: bind + compile + warm caches
+    _log("ours: bind+compile+warm epoch")
     mod.fit(it, num_epoch=1, initializer=mx.initializer.Xavier(),
             optimizer_params=opt_params)
     assert mod._fused_armed, "bench must measure the fused train step"
+    _log("ours: warm done, timing")
 
     it.reset()
     tic = time.perf_counter()
@@ -108,15 +128,18 @@ def bench_flax(imgs, labels):
 
     flops = None
     try:
+        _log("flax: lower+compile")
         cost = step.lower(state, *batch(0)).compile().cost_analysis()
         if cost and "flops" in cost:
             flops = float(cost["flops"])
     except Exception:
         pass
 
+    _log("flax: warm steps")
     for i in range(3):                      # compile + warm
         state, loss = step(state, *batch(i))
     jax.block_until_ready(loss)
+    _log("flax: timing")
 
     tic = time.perf_counter()
     for i in range(FLAX_STEPS):

@@ -46,8 +46,9 @@ from . import test_utils
 from . import autograd
 from . import parallel
 from . import contrib
-# contrib registers its ops after the first autogen pass — pick them up so
-# mx.nd.fft / mx.sym.MultiBoxPrior etc. exist like every other registry op
+from . import rtc
+# contrib/rtc register their ops after the first autogen pass — pick them
+# up so mx.nd.fft / mx.sym.MultiBoxPrior etc. exist like every registry op
 _op_gen.init_ndarray_module(ndarray.__dict__)
 symbol._init_symbol_module(symbol.__dict__)
 from . import image

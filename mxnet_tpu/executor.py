@@ -75,7 +75,7 @@ class _LazyOutputs:
 
 
 def _build_graph_runner(symbol, shape_overrides=None, tap=None, mp_plan=None,
-                        compute_dtype=None):
+                        compute_dtype=None, remat_segments=0):
     """Close the symbol graph into run(arg_vals, aux_vals, is_train, rng).
 
     Returns (runner, arg_names, aux_names, loss_mask). The runner is pure:
@@ -101,6 +101,18 @@ def _build_graph_runner(symbol, shape_overrides=None, tap=None, mp_plan=None,
     while the bound arrays (master params) stay float32; the cast's vjp
     upcasts gradients back automatically. Labels feeding a loss head are
     exempt (class indices above 256 don't survive a bfloat16 roundtrip).
+
+    ``remat_segments`` — gradient mirroring (reference:
+    MXNET_BACKWARD_DO_MIRROR, graph_executor.cc:210-223): when > 1, the
+    compute nodes are split into that many contiguous segments and each is
+    wrapped in ``jax.checkpoint``, so backward stores only segment-boundary
+    activations and recomputes the interior — sqrt(N)-checkpointing bounds
+    activation memory for deep unrolled graphs.
+
+    Every op executes under ``jax.named_scope(node.name)``, so compiled
+    HLO instructions carry Symbol node names into xplane/profiler traces —
+    the analog of the reference's PROFILER_MESSAGE per-op naming
+    (threaded_engine.h:296-307).
     """
     nodes = symbol._topo_nodes()
     node_index = {id(n): i for i, n in enumerate(nodes)}
@@ -129,44 +141,153 @@ def _build_graph_runner(symbol, shape_overrides=None, tap=None, mp_plan=None,
             return val.astype(compute_dtype)
         return val
 
-    def run(arg_vals, aux_vals, is_train, rng):
-        vals = {}       # id(node) -> list of output arrays
-        new_aux = {}
-        for node in nodes:
-            if node.is_variable:
-                if node._extra.get("__is_aux__"):
-                    vals[id(node)] = [_load_var(aux_vals[node.name],
-                                                node.name)]
+    def _exec_node(i, get_in, arg_vals, aux_vals, is_train, rng, new_aux):
+        """Run compute node i; inputs via get_in((producer_idx, out_idx))."""
+        node = nodes[i]
+        opdef = node.opdef()
+        attrs = node.attrs
+        if id(node) in shape_overrides:
+            attrs = {**attrs, "shape": shape_overrides[id(node)]}
+        aux_n = len(opdef.aux_names(attrs))
+        in_entries = []
+        for inp, idx in node.inputs:
+            if inp.is_variable:
+                if inp._extra.get("__is_aux__"):
+                    in_entries.append(_load_var(aux_vals[inp.name],
+                                                inp.name))
                 else:
-                    vals[id(node)] = [_load_var(arg_vals[node.name],
-                                                node.name)]
-                continue
-            opdef = node.opdef()
-            attrs = node.attrs
-            if id(node) in shape_overrides:
-                attrs = {**attrs, "shape": shape_overrides[id(node)]}
-            aux_n = len(opdef.aux_names(attrs))
-            in_entries = [vals[id(inp)][idx] for inp, idx in node.inputs]
-            regular = in_entries[:len(in_entries) - aux_n] if aux_n \
-                else in_entries
-            aux = in_entries[len(in_entries) - aux_n:] if aux_n else []
-            krng = jax.random.fold_in(rng, node_index[id(node)]) \
-                if opdef.need_rng else None
+                    in_entries.append(_load_var(arg_vals[inp.name],
+                                                inp.name))
+            else:
+                in_entries.append(get_in((node_index[id(inp)], idx)))
+        regular = in_entries[:len(in_entries) - aux_n] if aux_n \
+            else in_entries
+        aux = in_entries[len(in_entries) - aux_n:] if aux_n else []
+        krng = jax.random.fold_in(rng, i) if opdef.need_rng else None
+        with jax.named_scope(node.name):
             outs, aux_out = opdef.forward(attrs, regular, aux,
                                           is_train, krng)
-            if mp_plan is not None:
-                outs = mp_plan.constrain(id(node), outs)
-            vals[id(node)] = outs
-            if tap is not None:
-                tap(node, outs)
-            if aux_n and is_train:
-                for (inp, _), new_val in zip(
-                        node.inputs[len(node.inputs) - aux_n:], aux_out):
-                    new_aux[inp.name] = new_val
-        outputs = [vals[id(n)][i] for n, i in symbol._outputs]
+        if mp_plan is not None:
+            outs = mp_plan.constrain(id(node), outs)
+        if tap is not None:
+            tap(node, outs)
+        if aux_n and is_train:
+            for (inp, _), new_val in zip(
+                    node.inputs[len(node.inputs) - aux_n:], aux_out):
+                new_aux[inp.name] = new_val
+        return outs
+
+    out_entries = []
+    for n, i in symbol._outputs:
+        if n.is_variable:
+            out_entries.append(("var", n.name,
+                                bool(n._extra.get("__is_aux__"))))
+        else:
+            out_entries.append(("node", node_index[id(n)], i))
+
+    def _emit_outputs(get_entry, arg_vals, aux_vals):
+        outs = []
+        for ent in out_entries:
+            if ent[0] == "var":
+                src = aux_vals if ent[2] else arg_vals
+                outs.append(_load_var(src[ent[1]], ent[1]))
+            else:
+                outs.append(get_entry((ent[1], ent[2])))
+        return outs
+
+    compute_idx = [i for i, n in enumerate(nodes) if not n.is_variable]
+
+    def run(arg_vals, aux_vals, is_train, rng):
+        vals = {}       # (node_idx, out_idx) -> array
+        new_aux = {}
+        for i in compute_idx:
+            outs = _exec_node(i, vals.__getitem__, arg_vals, aux_vals,
+                              is_train, rng, new_aux)
+            for j, o in enumerate(outs):
+                vals[(i, j)] = o
+        outputs = _emit_outputs(vals.__getitem__, arg_vals, aux_vals)
         return outputs, new_aux
 
+    if remat_segments and remat_segments > 1 and len(compute_idx) > 2:
+        run = _segmented_runner(
+            nodes, node_index, compute_idx, out_entries, _exec_node,
+            _emit_outputs, min(int(remat_segments), len(compute_idx)))
+
     return run, arg_names, aux_names, loss_mask
+
+
+def _segmented_runner(nodes, node_index, compute_idx, out_entries,
+                      exec_node, emit_outputs, n_seg):
+    """sqrt(N)-style remat: contiguous node segments under jax.checkpoint.
+
+    Only segment-boundary entries (values consumed by a later segment or
+    emitted as outputs) thread through the carry; everything interior to a
+    segment is recomputed during backward instead of stored. The carry is
+    a dict keyed "i:j" (producer node index : output index) so it stays a
+    plain jittable pytree.
+    """
+    seg_size = -(-len(compute_idx) // n_seg)
+    segments = [compute_idx[k:k + seg_size]
+                for k in range(0, len(compute_idx), seg_size)]
+    seg_of = {}
+    for s, seg in enumerate(segments):
+        for i in seg:
+            seg_of[i] = s
+
+    # liveness: last segment that still reads each escaping entry
+    # (outputs live to the very end); dead entries drop out of the carry
+    # at each boundary so the stored set stays minimal
+    last_use = {}
+    for i in compute_idx:
+        for inp, idx in nodes[i].inputs:
+            if not inp.is_variable:
+                p = node_index[id(inp)]
+                if seg_of[p] != seg_of[i]:
+                    key = (p, idx)
+                    last_use[key] = max(last_use.get(key, -1), seg_of[i])
+    for ent in out_entries:
+        if ent[0] == "node":
+            last_use[(ent[1], ent[2])] = len(segments)
+
+    def run(arg_vals, aux_vals, is_train, rng):
+        def make_seg(s, seg_nodes):
+            def seg_fn(carry, rng_in):
+                local = {}
+                new_aux_loc = {}
+
+                def get_in(key):
+                    if key in local:
+                        return local[key]
+                    return carry[f"{key[0]}:{key[1]}"]
+
+                for i in seg_nodes:
+                    outs = exec_node(i, get_in, arg_vals, aux_vals,
+                                     is_train, rng_in, new_aux_loc)
+                    for j, o in enumerate(outs):
+                        local[(i, j)] = o
+                out = {}
+                for k, v in carry.items():
+                    if k.startswith("aux:") or \
+                            last_use[tuple(map(int, k.split(":")))] > s:
+                        out[k] = v
+                for key, lu in last_use.items():
+                    if key in local and lu > s:
+                        out[f"{key[0]}:{key[1]}"] = local[key]
+                for nm, v in new_aux_loc.items():
+                    out[f"aux:{nm}"] = v
+                return out
+            return seg_fn
+
+        carry = {}
+        for s, seg_nodes in enumerate(segments):
+            carry = jax.checkpoint(make_seg(s, seg_nodes))(carry, rng)
+        new_aux = {k[4:]: v for k, v in carry.items()
+                   if k.startswith("aux:")}
+        outputs = emit_outputs(
+            lambda key: carry[f"{key[0]}:{key[1]}"], arg_vals, aux_vals)
+        return outputs, new_aux
+
+    return run
 
 
 class Executor:
@@ -174,13 +295,29 @@ class Executor:
 
     def __init__(self, symbol, ctx, args, args_grad=None, grad_req="write",
                  aux_states=None, group2ctx=None, shared_exec=None,
-                 compute_dtype=None):
+                 compute_dtype=None, mirror=None):
         self._symbol = symbol
         self._ctx = ctx
         self._group2ctx = group2ctx or {}
         self._compute_dtype = compute_dtype
         self._monitor_callback = None
         self.output_names = symbol.list_outputs()
+
+        # gradient mirroring (reference: MXNET_BACKWARD_DO_MIRROR,
+        # graph_executor.cc:210-223): True -> sqrt(N) segments under
+        # jax.checkpoint; an int picks the segment count explicitly
+        if mirror is None:
+            import os as _os
+            mirror = _os.environ.get("MXNET_BACKWARD_DO_MIRROR",
+                                     "0").lower() in ("1", "true")
+        if mirror is True:
+            n_compute = sum(1 for n in symbol._topo_nodes()
+                            if not n.is_variable)
+            self._remat_segments = max(2, int(np.ceil(np.sqrt(n_compute))))
+        elif mirror:
+            self._remat_segments = int(mirror)
+        else:
+            self._remat_segments = 0
 
         # ---- normalize arg arrays -------------------------------------
         arg_names_all = symbol.list_arguments()
@@ -221,7 +358,8 @@ class Executor:
         self._runner, self.arg_names, self.aux_names, self._loss_mask = \
             _build_graph_runner(symbol, shape_overrides,
                                 mp_plan=self._mp_plan,
-                                compute_dtype=compute_dtype)
+                                compute_dtype=compute_dtype,
+                                remat_segments=self._remat_segments)
         self.aux_arrays = self._normalize_args(aux_states, self.aux_names,
                                                "aux_states", allow_none=True)
         self.grad_req = self._normalize_req(grad_req)
@@ -528,7 +666,9 @@ class Executor:
             new_aux[nm] = old if tuple(old.shape) == tuple(s) else \
                 nd_zeros(s, ctx=self._ctx, dtype=old.dtype)
         return Executor(self._symbol, self._ctx, new_args, new_grads,
-                        self.grad_req, new_aux, self._group2ctx)
+                        self.grad_req, new_aux, self._group2ctx,
+                        compute_dtype=self._compute_dtype,
+                        mirror=self._remat_segments or 0)
 
     def set_monitor_callback(self, callback):
         self._monitor_callback = callback
@@ -543,7 +683,8 @@ class Executor:
 
     # ----------------------------------------------------------- simple_bind
     @staticmethod
-    def _simple_bind(symbol, ctx, grad_req, type_dict, group2ctx, shapes):
+    def _simple_bind(symbol, ctx, grad_req, type_dict, group2ctx, shapes,
+                     mirror=None):
         arg_shapes, out_shapes, aux_shapes = symbol.infer_shape(**shapes)
         arg_names = symbol.list_arguments()
         aux_names = symbol.list_auxiliary_states()
@@ -559,4 +700,5 @@ class Executor:
                  if req.get(nm, "null") != "null"}
         aux = {nm: nd_zeros(s, ctx=ctx)
                for nm, s in zip(aux_names, aux_shapes)}
-        return Executor(symbol, ctx, args, grads, grad_req, aux, group2ctx)
+        return Executor(symbol, ctx, args, grads, grad_req, aux, group2ctx,
+                        mirror=mirror)

@@ -1,0 +1,275 @@
+"""mx.rtc: user-supplied accelerator kernels at runtime.
+
+Reference counterpart: ``mx.rtc.Rtc`` compiles CUDA C source through nvrtc
+and pushes it onto NDArrays (reference: src/common/mxrtc.cc:1-141,
+c_api.h:1471-1491, python/mxnet/rtc.py). The TPU has no user-facing
+runtime-compiled C — the native kernel language is **Pallas** (Mosaic), so
+here a "kernel" is a Python Pallas function compiled for the TPU at trace
+time (interpret mode on CPU keeps kernels testable everywhere):
+
+  * ``Rtc(name, inputs, outputs, kernel)`` — imperative push, API-shaped
+    like the reference class;
+  * ``register_pallas_op(...)`` — the deeper integration the reference
+    never had: a user kernel becomes a first-class registry op, visible as
+    ``mx.nd.<name>`` / ``mx.sym.<name>``, optionally differentiable via a
+    user VJP kernel, and fusable into jitted executor graphs.
+
+A built-in fused SGD-momentum update kernel doubles as the reference
+implementation and the numerics test target (vs the XLA composition in
+ops/optimizer_op.py).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from .base import MXNetError
+from .ndarray import NDArray
+from .ops.registry import register as _register_op, OP_REGISTRY
+
+__all__ = ["Rtc", "register_pallas_op", "pallas_call"]
+
+
+def _interpret():
+    """Mosaic-compile on TPU; interpret elsewhere (CPU test mesh)."""
+    return jax.default_backend() != "tpu"
+
+
+def pallas_call(kernel, out_shape, **kwargs):
+    """``pl.pallas_call`` with backend-appropriate compile/interpret mode."""
+    kwargs.setdefault("interpret", _interpret())
+    return pl.pallas_call(kernel, out_shape=out_shape, **kwargs)
+
+
+class Rtc:
+    """Imperative kernel handle (reference API: mx.rtc.Rtc(name, inputs,
+    outputs, kernel); push(ins, outs, grid, block)).
+
+    ``inputs``/``outputs`` are (name, NDArray) example pairs fixing
+    shapes/dtypes like the reference; ``kernel`` is a Pallas kernel
+    function taking one ref per input followed by one ref per output.
+    Grid/block dims are Pallas grid/BlockSpecs — pass ``grid=`` if the
+    kernel tiles; the default maps whole arrays into VMEM.
+    """
+
+    def __init__(self, name, inputs, outputs, kernel, grid=None,
+                 in_specs=None, out_specs=None):
+        self.name = name
+        self._in_shapes = [(nm, tuple(a.shape), a.dtype)
+                           for nm, a in inputs]
+        self._out_struct = [jax.ShapeDtypeStruct(tuple(a.shape), a.dtype)
+                            for _, a in outputs]
+        kwargs = {}
+        if grid is not None:
+            kwargs["grid"] = grid
+        if in_specs is not None:
+            kwargs["in_specs"] = in_specs
+        if out_specs is not None:
+            kwargs["out_specs"] = out_specs
+        self._fn = jax.jit(pallas_call(kernel, out_shape=self._out_struct,
+                                       **kwargs))
+
+    def push(self, ins, outs, grid_dims=None, block_dims=None):
+        """Run the kernel. grid/block dims are fixed at construction in
+        Pallas (they shape the compiled program); passing different ones
+        here raises, matching the spirit of the reference's checks."""
+        if grid_dims is not None or block_dims is not None:
+            raise MXNetError("Pallas grids are fixed at Rtc construction; "
+                             "rebuild the Rtc to change tiling")
+        if len(ins) != len(self._in_shapes):
+            raise MXNetError(f"{self.name}: expected "
+                             f"{len(self._in_shapes)} inputs")
+        if len(outs) != len(self._out_struct):
+            raise MXNetError(f"{self.name}: expected "
+                             f"{len(self._out_struct)} outputs, "
+                             f"got {len(outs)}")
+        vals = [a.asjax() for a in ins]
+        for v, (nm, shp, dt) in zip(vals, self._in_shapes):
+            if tuple(v.shape) != shp:
+                raise MXNetError(f"{self.name}: input {nm!r} shape "
+                                 f"{v.shape} != declared {shp}")
+        results = self._fn(*vals)
+        if not isinstance(results, (list, tuple)):
+            results = [results]
+        for dst, r in zip(outs, results):
+            dst._set(r)
+        return outs
+
+
+def register_pallas_op(name, kernel, out_shapes, inputs=("data",),
+                       vjp_kernel=None, grid=None, in_specs=None,
+                       out_specs=None, vjp_grid=None, vjp_in_specs=None,
+                       vjp_out_specs=None, attr_spec=None):
+    """Register a Pallas kernel as a graph operator.
+
+    Parameters
+    ----------
+    kernel : fn(attrs) -> pallas kernel fn(*in_refs, *out_refs). Attrs are
+        closed over so hyper-parameters stay compile-time scalars.
+    out_shapes : fn(attrs, in_shapes) -> list of (shape, dtype-str|None);
+        None dtype inherits input 0's dtype.
+    vjp_kernel : optional fn(attrs) -> pallas kernel for the backward:
+        fn(*in_refs, *cotangent_refs, *grad_refs). When given, the op is
+        differentiable and the executor's jax.vjp sees a custom_vjp.
+    grid / in_specs / out_specs : tiling for the forward call; each may be
+        a value or fn(attrs, in_shapes). A tiled op MUST also tile its
+        backward: vjp_grid/vjp_in_specs/vjp_out_specs (the vjp kernel's
+        inputs are *vals + *cotangents, outputs one grad per input);
+        omitting them for a gridded forward raises at registration.
+    """
+    if vjp_kernel is not None and grid is not None and vjp_grid is None:
+        raise MXNetError(
+            f"pallas op {name!r}: forward is tiled (grid=...) but the vjp "
+            "has no vjp_grid — a whole-array backward would overflow VMEM "
+            "or misread tile-shaped refs; pass vjp_grid/vjp_in_specs/"
+            "vjp_out_specs")
+
+    def _resolve(spec, attrs, in_shapes):
+        return spec(attrs, in_shapes) if callable(spec) else spec
+
+    def _build_call(attrs, in_vals):
+        in_shapes = [tuple(v.shape) for v in in_vals]
+        outs = []
+        for shp, dt in out_shapes(attrs, in_shapes):
+            outs.append(jax.ShapeDtypeStruct(
+                tuple(shp), np.dtype(dt) if dt else in_vals[0].dtype))
+        kwargs = {}
+        for k, spec in (("grid", grid), ("in_specs", in_specs),
+                        ("out_specs", out_specs)):
+            if spec is not None:
+                kwargs[k] = _resolve(spec, attrs, in_shapes)
+        return pallas_call(kernel(attrs), out_shape=outs, **kwargs), outs
+
+    def simple_forward(attrs, *in_vals):
+        if vjp_kernel is None:
+            call, _ = _build_call(attrs, in_vals)
+            out = call(*in_vals)
+            return tuple(out) if isinstance(out, (list, tuple)) else out
+
+        @jax.custom_vjp
+        def op(*vals):
+            call, _ = _build_call(attrs, vals)
+            out = call(*vals)
+            return tuple(out) if isinstance(out, (list, tuple)) else out
+
+        def fwd(*vals):
+            return op(*vals), vals
+
+        def bwd(vals, cts):
+            if not isinstance(cts, (list, tuple)):
+                cts = (cts,)
+            in_shapes = [tuple(v.shape) for v in vals]
+            grads_struct = [jax.ShapeDtypeStruct(v.shape, v.dtype)
+                            for v in vals]
+            kwargs = {}
+            for k, spec in (("grid", vjp_grid),
+                            ("in_specs", vjp_in_specs),
+                            ("out_specs", vjp_out_specs)):
+                if spec is not None:
+                    kwargs[k] = _resolve(spec, attrs, in_shapes)
+            bw = pallas_call(vjp_kernel(attrs), out_shape=grads_struct,
+                             **kwargs)
+            return tuple(bw(*vals, *cts))
+
+        op.defvjp(fwd, bwd)
+        return op(*in_vals)
+
+    return _register_op(name, inputs=inputs, simple=simple_forward,
+                        attr_spec=attr_spec or {})
+
+
+# --------------------------------------------------------------------------
+# built-in: fused SGD-momentum update (the reference ships this fused on
+# the GPU as sgd_mom_update, optimizer_op.cc:17-60; here it is the
+# resident example of a Pallas kernel in the op graph). Same convention as
+# ops/optimizer_op.py: g = wd*w + clip(rescale*grad);
+# mom' = momentum*mom - lr*g; weight' = weight + mom'.
+# --------------------------------------------------------------------------
+_TILE_ROWS = 256
+_LANES = 128
+
+
+def _sgd_mom_kernel(attrs):
+    lr = float(attrs.get("lr"))
+    momentum = float(attrs.get("momentum", 0.0))
+    wd = float(attrs.get("wd", 0.0))
+    rescale = float(attrs.get("rescale_grad", 1.0))
+    clip = attrs.get("clip_gradient")
+    clip = float(clip) if clip is not None and float(clip) > 0 else None
+
+    def kernel(w_ref, g_ref, m_ref, ow_ref, om_ref):
+        g = g_ref[...] * rescale
+        if clip is not None:
+            g = jnp.clip(g, -clip, clip)
+        g = g + wd * w_ref[...]
+        m = momentum * m_ref[...] - lr * g
+        om_ref[...] = m
+        ow_ref[...] = w_ref[...] + m
+    return kernel
+
+
+def _pad_to_tiles(v):
+    n = v.size
+    cols = _LANES
+    rows = -(-n // cols)
+    rows_pad = -(-rows // 8) * 8          # float32 sublane multiple
+    flat = jnp.ravel(v)
+    flat = jnp.pad(flat, (0, rows_pad * cols - n))
+    return flat.reshape(rows_pad, cols), n
+
+
+def pallas_sgd_mom_update(weight, grad, mom, lr, momentum=0.0, wd=0.0,
+                          rescale_grad=1.0, clip_gradient=None):
+    """Functional fused update on jax arrays: returns (weight', mom')."""
+    attrs = {"lr": lr, "momentum": momentum, "wd": wd,
+             "rescale_grad": rescale_grad, "clip_gradient": clip_gradient}
+    w2, n = _pad_to_tiles(weight)
+    g2, _ = _pad_to_tiles(grad)
+    m2, _ = _pad_to_tiles(mom)
+    rows = w2.shape[0]
+    block = min(_TILE_ROWS, rows)
+    # rows is a multiple of 8; use a divisor block so the grid tiles evenly
+    while rows % block:
+        block -= 8
+    spec = pl.BlockSpec((block, _LANES), lambda i: (i, 0))
+    out = pallas_call(
+        _sgd_mom_kernel(attrs),
+        out_shape=[jax.ShapeDtypeStruct(w2.shape, w2.dtype)] * 2,
+        grid=(rows // block,),
+        in_specs=[spec, spec, spec], out_specs=[spec, spec])(w2, g2, m2)
+    new_w = out[0].reshape(-1)[:n].reshape(weight.shape)
+    new_m = out[1].reshape(-1)[:n].reshape(mom.shape)
+    return new_w, new_m
+
+
+def _nd(x):
+    return x.asjax() if isinstance(x, NDArray) else jnp.asarray(x)
+
+
+def _register_builtin():
+    if "pallas_sgd_mom_update" in OP_REGISTRY:
+        return
+
+    def forward(attrs, weight, grad, mom):
+        return pallas_sgd_mom_update(
+            weight, grad, mom,
+            lr=float(attrs["lr"]),
+            momentum=float(attrs.get("momentum", 0.0)),
+            wd=float(attrs.get("wd", 0.0)),
+            rescale_grad=float(attrs.get("rescale_grad", 1.0)),
+            clip_gradient=attrs.get("clip_gradient"))
+
+    _register_op("pallas_sgd_mom_update",
+                 inputs=("weight", "grad", "mom"),
+                 simple=forward, num_outputs=2,
+                 output_names=["weight_out", "mom_out"],
+                 attr_spec={"lr": (float, None),
+                            "momentum": (float, 0.0),
+                            "wd": (float, 0.0),
+                            "rescale_grad": (float, 1.0),
+                            "clip_gradient": (lambda v: float(v), None)})
+
+
+_register_builtin()

@@ -385,16 +385,18 @@ class Symbol:
 
     # ----------------------------------------------------------------- binding
     def simple_bind(self, ctx=None, grad_req="write", type_dict=None,
-                    group2ctx=None, **kwargs):
+                    group2ctx=None, mirror=None, **kwargs):
         from .executor import Executor
         return Executor._simple_bind(self, ctx or current_context(), grad_req,
-                                     type_dict, group2ctx, kwargs)
+                                     type_dict, group2ctx, kwargs,
+                                     mirror=mirror)
 
     def bind(self, ctx=None, args=None, args_grad=None, grad_req="write",
-             aux_states=None, group2ctx=None, shared_exec=None):
+             aux_states=None, group2ctx=None, shared_exec=None, mirror=None):
         from .executor import Executor
         return Executor(self, ctx or current_context(), args, args_grad,
-                        grad_req, aux_states, group2ctx, shared_exec)
+                        grad_req, aux_states, group2ctx, shared_exec,
+                        mirror=mirror)
 
     # ------------------------------------------------------------ eval helper
     def eval(self, ctx=None, **kwargs):

@@ -5,6 +5,7 @@ Mirrors the reference's `tools/launch.py -n 4 python dist_sync_kvstore.py`
 multi-process on one host, real collectives between the processes.
 """
 import os
+import signal
 import subprocess
 import sys
 
@@ -17,11 +18,25 @@ def _launch(nworkers, timeout=600):
     env = dict(os.environ)
     env.pop("DMLC_NUM_WORKER", None)  # never inherit stale cluster env
     env.pop("DMLC_WORKER_ID", None)
-    return subprocess.run(
+    # own session so a timeout can kill the whole tree: worker
+    # grandchildren inherit the stdout pipe, and killing only the
+    # launcher would leave communicate() blocked on the open write ends
+    proc = subprocess.Popen(
         [sys.executable, os.path.join(ROOT, "tools", "launch.py"),
          "-n", str(nworkers), sys.executable,
          os.path.join(ROOT, "tests", "dist_sync_worker.py")],
-        capture_output=True, text=True, timeout=timeout, env=env, cwd=ROOT)
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        env=env, cwd=ROOT, start_new_session=True)
+    try:
+        stdout, stderr = proc.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+        stdout, stderr = proc.communicate()
+        raise AssertionError(
+            f"distributed job wedged past {timeout}s; tail:\n"
+            f"{stdout[-1500:]}\n{stderr[-1500:]}")
+    return subprocess.CompletedProcess(proc.args, proc.returncode,
+                                       stdout, stderr)
 
 
 @pytest.mark.parametrize("nworkers", [2, 4])

@@ -674,6 +674,8 @@ FUNCTIONAL = {
 # explicit skips — every entry names the covering test or the reason
 # ---------------------------------------------------------------------------
 SKIPS = {
+    "pallas_sgd_mom_update": "built-in Pallas kernel — numerics vs XLA "
+                             "composition in tests/test_rtc.py",
     "RNN": "fused RNN kernel — fused-vs-unfolded equivalence in "
            "tests/test_rnn.py",
     "Custom": "python CustomOp bridge — end-to-end in "
@@ -687,10 +689,15 @@ SKIPS = {
 }
 
 
+# snapshot at import: ops registered later (e.g. by test_rtc's
+# register_pallas_op cases) are out of scope for the coverage gate
+_REGISTRY_SNAPSHOT = sorted(OP_REGISTRY)
+
+
 def _canonical():
     """name -> canonical name (first registered name of the same OpDef)."""
     by_id = {}
-    for n in sorted(OP_REGISTRY):
+    for n in _REGISTRY_SNAPSHOT:
         by_id.setdefault(id(OP_REGISTRY[n]), []).append(n)
     canon = {}
     for names in by_id.values():
@@ -726,7 +733,7 @@ def test_op_sweep_functional(fn):
 def test_registry_coverage():
     """Every registered op is swept here or skipped with a named reason."""
     report, missing = [], []
-    for name in sorted(OP_REGISTRY):
+    for name in _REGISTRY_SNAPSHOT:
         root = CANON[name]
         alias = f" (alias of {root})" if root != name else ""
         if root in CASES:
