@@ -1,0 +1,36 @@
+"""Synthetic-shapes detection dataset.
+
+Stands in for VOC in the reference's example/ssd: images contain 1-3
+colored objects — squares (class 0) and disks (class 1) — on a noisy
+background, with normalized [cls, x1, y1, x2, y2] box labels. Convergence
+on it proves the full SSD pipeline (augmenters -> anchors -> matching ->
+losses -> NMS decode) end to end without external data.
+"""
+import numpy as np
+
+
+def make_shapes_dataset(n_images, size=96, rng=None, max_objects=3):
+    rng = rng or np.random.RandomState(0)
+    images, labels = [], []
+    for _ in range(n_images):
+        img = rng.randint(0, 40, (size, size, 3)).astype(np.uint8)
+        n_obj = rng.randint(1, max_objects + 1)
+        rows = []
+        for _ in range(n_obj):
+            side = rng.randint(size // 5, size // 2)
+            x0 = rng.randint(0, size - side)
+            y0 = rng.randint(0, size - side)
+            color = rng.randint(120, 255, 3)
+            cls = rng.randint(0, 2)
+            if cls == 0:                    # filled square
+                img[y0:y0 + side, x0:x0 + side] = color
+            else:                           # filled disk
+                yy, xx = np.mgrid[0:size, 0:size]
+                cy, cx = y0 + side / 2, x0 + side / 2
+                mask = (yy - cy) ** 2 + (xx - cx) ** 2 <= (side / 2) ** 2
+                img[mask] = color
+            rows.append([cls, x0 / size, y0 / size,
+                         (x0 + side) / size, (y0 + side) / size])
+        images.append(img)
+        labels.append(np.array(rows, dtype=np.float32))
+    return images, labels

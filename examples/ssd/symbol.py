@@ -1,0 +1,116 @@
+"""SSD detection symbol (reference: example/ssd/symbol_factory.py +
+symbol/symbol_builder.py — multi-scale heads over a conv body, driving the
+MultiBoxPrior/Target/Detection op trio).
+
+The body here is a compact conv net sized for the synthetic-shapes task
+(the reference's VGG16-reduced fills the same role for VOC); the head
+wiring — per-scale loc/cls convs, channel-last flatten, anchor concat,
+target matching, SoftmaxOutput with valid-normalization + hard-negative
+ignore, smooth-L1 MakeLoss — follows the reference construction.
+"""
+import mxnet_tpu as mx
+
+# per-scale anchor config: (sizes, ratios) -> A = len(sizes)+len(ratios)-1
+SCALES = [
+    ((0.15, 0.25), (1.0, 2.0, 0.5)),
+    ((0.4, 0.55), (1.0, 2.0, 0.5)),
+    ((0.7, 0.85), (1.0, 2.0, 0.5)),
+]
+
+
+def _conv_block(data, num_filter, name, stride=(1, 1)):
+    c = mx.sym.Convolution(data, kernel=(3, 3), pad=(1, 1), stride=stride,
+                           num_filter=num_filter, name=f"{name}_conv")
+    b = mx.sym.BatchNorm(c, fix_gamma=False, name=f"{name}_bn")
+    return mx.sym.Activation(b, act_type="relu", name=f"{name}_relu")
+
+
+def _body(data, width=32):
+    """Three detection scales at /8, /16, /32."""
+    x = _conv_block(data, width, "b1a")
+    x = mx.sym.Pooling(x, kernel=(2, 2), stride=(2, 2), pool_type="max",
+                       name="p1")
+    x = _conv_block(x, width * 2, "b2a")
+    x = mx.sym.Pooling(x, kernel=(2, 2), stride=(2, 2), pool_type="max",
+                       name="p2")
+    x = _conv_block(x, width * 2, "b3a")
+    f1 = _conv_block(x, width * 2, "b3b")
+    x = mx.sym.Pooling(f1, kernel=(2, 2), stride=(2, 2), pool_type="max",
+                       name="p3")
+    f2 = _conv_block(x, width * 4, "b4a")
+    x = mx.sym.Pooling(f2, kernel=(2, 2), stride=(2, 2), pool_type="max",
+                       name="p4")
+    f3 = _conv_block(x, width * 4, "b5a")
+    return [f1, f2, f3]
+
+
+def multibox_layer(features, num_classes):
+    """Per-scale heads -> (loc_preds, cls_preds, anchors), the exact
+    contract the MultiBox ops expect (reference:
+    symbol/common.py multibox_layer)."""
+    loc_layers, cls_layers, anchor_layers = [], [], []
+    for i, (feat, (sizes, ratios)) in enumerate(zip(features, SCALES)):
+        num_anchors = len(sizes) + len(ratios) - 1
+        loc = mx.sym.Convolution(feat, kernel=(3, 3), pad=(1, 1),
+                                 num_filter=num_anchors * 4,
+                                 name=f"loc_pred{i}_conv")
+        loc = mx.sym.transpose(loc, axes=(0, 2, 3, 1))
+        loc_layers.append(mx.sym.Flatten(loc))
+        cls = mx.sym.Convolution(
+            feat, kernel=(3, 3), pad=(1, 1),
+            num_filter=num_anchors * (num_classes + 1),
+            name=f"cls_pred{i}_conv")
+        cls = mx.sym.transpose(cls, axes=(0, 2, 3, 1))
+        cls = mx.sym.Reshape(cls, shape=(0, -1, num_classes + 1))
+        cls_layers.append(cls)
+        anchor_layers.append(mx.sym.MultiBoxPrior(
+            feat, sizes=sizes, ratios=ratios, clip=True,
+            name=f"anchors{i}"))
+    loc_preds = mx.sym.Concat(*loc_layers, dim=1, num_args=len(loc_layers),
+                              name="loc_preds")
+    cls_concat = mx.sym.Concat(*cls_layers, dim=1,
+                               num_args=len(cls_layers))
+    cls_preds = mx.sym.transpose(cls_concat, axes=(0, 2, 1),
+                                 name="cls_preds")   # (N, C+1, A)
+    anchors = mx.sym.Concat(*anchor_layers, dim=1,
+                            num_args=len(anchor_layers), name="anchors")
+    return loc_preds, cls_preds, anchors
+
+
+def get_train_symbol(num_classes=2, width=32):
+    data = mx.sym.var("data")
+    label = mx.sym.var("label")
+    loc_preds, cls_preds, anchors = multibox_layer(_body(data, width),
+                                                   num_classes)
+    tmp = mx.sym.MultiBoxTarget(anchors, label, cls_preds,
+                                overlap_threshold=0.5,
+                                ignore_label=-1,
+                                negative_mining_ratio=3,
+                                name="multibox_target")
+    loc_target, loc_mask, cls_target = tmp[0], tmp[1], tmp[2]
+    cls_prob = mx.sym.SoftmaxOutput(cls_preds, cls_target,
+                                    ignore_label=-1, use_ignore=True,
+                                    multi_output=True,
+                                    normalization="valid",
+                                    name="cls_prob")
+    loc_diff = loc_mask * (loc_preds - loc_target)
+    loc_loss = mx.sym.MakeLoss(mx.sym.smooth_l1(loc_diff, scalar=1.0),
+                               grad_scale=1.0, normalization="valid",
+                               name="loc_loss")
+    # stop-gradient views give metrics the matching targets
+    cls_label = mx.sym.MakeLoss(mx.sym.BlockGrad(cls_target), grad_scale=0,
+                                name="cls_label")
+    return mx.sym.Group([cls_prob, loc_loss, cls_label])
+
+
+def get_detect_symbol(num_classes=2, width=32, nms_threshold=0.45,
+                      score_threshold=0.1):
+    data = mx.sym.var("data")
+    loc_preds, cls_preds, anchors = multibox_layer(_body(data, width),
+                                                   num_classes)
+    cls_prob = mx.sym.softmax(cls_preds, axis=1, name="cls_prob_det")
+    return mx.sym.MultiBoxDetection(cls_prob, loc_preds, anchors,
+                                    nms_threshold=nms_threshold,
+                                    threshold=score_threshold,
+                                    force_suppress=False, clip=True,
+                                    name="detection")

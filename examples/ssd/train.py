@@ -1,0 +1,137 @@
+"""Train SSD on the synthetic-shapes detection task.
+
+reference: example/ssd/train.py — same flow: det iterator with box-aware
+augmenters -> multibox training symbol -> Module.fit with a composite
+cls/loc metric, then decode detections with the inference symbol.
+
+    python examples/ssd/train.py --epochs 8 --batch-size 16
+"""
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+import mxnet_tpu as mx  # noqa: E402
+from examples.ssd import data as shapes_data  # noqa: E402
+from examples.ssd import symbol as ssd_symbol  # noqa: E402
+
+
+class MultiBoxMetric(mx.metric.EvalMetric):
+    """Composite cls-CE / loc-smoothL1 metric (reference:
+    example/ssd/evaluate/eval_metric.py MultiBoxMetric)."""
+
+    def __init__(self):
+        super().__init__("MultiBox")
+        self.num = 2
+        self.reset()
+
+    def reset(self):
+        self.sum_metric = [0.0, 0.0]
+        self.num_inst = [0, 0]
+
+    def update(self, labels, preds):
+        cls_prob = preds[0].asnumpy()        # (N, C+1, A)
+        loc_loss = preds[1].asnumpy()
+        cls_label = preds[2].asnumpy()       # (N, A)
+        valid = cls_label >= 0
+        idx = cls_label.astype(int)
+        n, _, a = cls_prob.shape
+        picked = cls_prob[np.arange(n)[:, None], idx, np.arange(a)[None, :]]
+        ce = -np.log(np.maximum(picked, 1e-12)) * valid
+        self.sum_metric[0] += ce.sum()
+        self.num_inst[0] += int(valid.sum())
+        self.sum_metric[1] += loc_loss.sum()
+        self.num_inst[1] += max(int(valid.sum()), 1)
+
+    def get(self):
+        return (["cross_entropy", "smooth_l1"],
+                [self.sum_metric[i] / max(self.num_inst[i], 1)
+                 for i in range(2)])
+
+
+def build_iters(args, rng=None):
+    rng = rng or np.random.RandomState(42)
+    imgs, labs = shapes_data.make_shapes_dataset(
+        args.num_images, size=args.data_size, rng=rng)
+    vimgs, vlabs = shapes_data.make_shapes_dataset(
+        max(args.num_images // 4, args.batch_size), size=args.data_size,
+        rng=rng)
+    shape = (3, args.data_size, args.data_size)
+    train_aug = mx.image.CreateDetAugmenter(
+        shape, rand_crop=0.5, rand_pad=0.5, rand_mirror=True,
+        mean=np.zeros(3), std=np.full(3, 255.0))
+    val_aug = mx.image.CreateDetAugmenter(shape, mean=np.zeros(3),
+                                          std=np.full(3, 255.0))
+    train = mx.image.ImageDetIter(args.batch_size, shape, imgs, labs,
+                                  shuffle=True, aug_list=train_aug,
+                                  max_objects=3)
+    val = mx.image.ImageDetIter(args.batch_size, shape, vimgs, vlabs,
+                                aug_list=val_aug, max_objects=3)
+    return train, val
+
+
+def train(args):
+    train_iter, val_iter = build_iters(args)
+    net = ssd_symbol.get_train_symbol(num_classes=2, width=args.width)
+    mod = mx.mod.Module(net, data_names=("data",), label_names=("label",),
+                        context=mx.context.current_context())
+    metric = MultiBoxMetric()
+    mod.fit(train_iter, eval_data=val_iter, eval_metric=metric,
+            num_epoch=args.epochs,
+            initializer=mx.initializer.Xavier(),
+            optimizer="sgd",
+            optimizer_params={"learning_rate": args.lr, "momentum": 0.9,
+                              "wd": 5e-4},
+            batch_end_callback=mx.callback.Speedometer(args.batch_size,
+                                                       args.log_every))
+    return mod
+
+
+def detect(mod, args, images):
+    """Decode detections with the trained weights (reference:
+    example/ssd/detect/detector.py)."""
+    det_sym = ssd_symbol.get_detect_symbol(num_classes=2, width=args.width)
+    shape = (len(images), 3, args.data_size, args.data_size)
+    exe = det_sym.simple_bind(ctx=mx.context.current_context(),
+                              grad_req="null", data=shape)
+    arg_params, aux_params = mod.get_params()
+    exe.copy_params_from(arg_params, aux_params, allow_extra_params=True)
+    batch = np.stack([img.astype(np.float32).transpose(2, 0, 1) / 255.0
+                      for img in images])
+    exe.forward(is_train=False, data=batch)
+    return exe.outputs[0].asnumpy()    # (N, A, 6)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=8)
+    p.add_argument("--batch-size", type=int, default=16)
+    p.add_argument("--num-images", type=int, default=128)
+    p.add_argument("--data-size", type=int, default=96)
+    p.add_argument("--width", type=int, default=32)
+    p.add_argument("--lr", type=float, default=0.01)
+    p.add_argument("--log-every", type=int, default=10)
+    args = p.parse_args()
+    import logging
+    logging.basicConfig(level=logging.INFO)
+    mod = train(args)
+    imgs, labs = shapes_data.make_shapes_dataset(
+        4, size=args.data_size, rng=np.random.RandomState(7))
+    dets = detect(mod, args, imgs)
+    for i, det in enumerate(dets):
+        kept = det[det[:, 0] >= 0]
+        best = kept[np.argsort(-kept[:, 1])][:3] if len(kept) else []
+        print(f"image {i}: gt={labs[i][:, 0].astype(int).tolist()} "
+              f"top detections:")
+        for row in best:
+            print(f"  cls={int(row[0])} score={row[1]:.2f} "
+                  f"box=({row[2]:.2f},{row[3]:.2f},{row[4]:.2f},"
+                  f"{row[5]:.2f})")
+
+
+if __name__ == "__main__":
+    main()

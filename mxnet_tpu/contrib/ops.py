@@ -284,13 +284,16 @@ def _multibox_target(attrs, anchor, label, cls_pred):
     A = anchors.shape[0]
     thresh = attrs.get("overlap_threshold", 0.5)
     variances = attrs.get("variances", (0.1, 0.1, 0.2, 0.2))
+    neg_ratio = parse_float(attrs.get("negative_mining_ratio", -1.0))
+    ignore_label = parse_float(attrs.get("ignore_label", -1.0))
+    min_neg = parse_int(attrs.get("minimum_negative_samples", 0))
 
     acx = (anchors[:, 0] + anchors[:, 2]) / 2
     acy = (anchors[:, 1] + anchors[:, 3]) / 2
     aw = anchors[:, 2] - anchors[:, 0]
     ah = anchors[:, 3] - anchors[:, 1]
 
-    def one(lab):
+    def one(lab, cp):
         valid = lab[:, 0] >= 0
         gt = lab[:, 1:5]
         ious = _iou(anchors, gt) * valid[None, :].astype(anchors.dtype)
@@ -313,10 +316,32 @@ def _multibox_target(attrs, anchor, label, cls_pred):
         loc_t = jnp.stack([tx, ty, tw, th], axis=-1)
         loc_t = loc_t * pos[:, None].astype(loc_t.dtype)
         loc_m = jnp.tile(pos[:, None].astype(loc_t.dtype), (1, 4))
-        cls_t = jnp.where(pos, lab[best_gt, 0] + 1.0, 0.0)
+        if neg_ratio > 0:
+            # hard-negative mining (reference: multibox_target-inl.h
+            # NegativeMining): candidates are anchors whose best IoU is
+            # below negative_mining_thresh (moderate-overlap anchors stay
+            # ignored); keep the ratio*|pos| candidates with the lowest
+            # predicted background confidence, the rest get ignore_label
+            # so SoftmaxOutput(use_ignore) skips them
+            neg_thresh = parse_float(
+                attrs.get("negative_mining_thresh", 0.5))
+            neg_cand = (~pos) & (best_iou < neg_thresh)
+            p = jax.nn.softmax(cp, axis=0)          # (C+1, A)
+            hardness = jnp.where(neg_cand, -jnp.log(p[0] + 1e-12),
+                                 -jnp.inf)
+            order = jnp.argsort(-hardness)
+            rank = jnp.zeros((A,), jnp.int32).at[order].set(
+                jnp.arange(A, dtype=jnp.int32))
+            n_neg = jnp.maximum(
+                (neg_ratio * jnp.sum(pos)).astype(jnp.int32), min_neg)
+            neg_sel = neg_cand & (rank < n_neg)
+            cls_t = jnp.where(pos, lab[best_gt, 0] + 1.0,
+                              jnp.where(neg_sel, 0.0, ignore_label))
+        else:
+            cls_t = jnp.where(pos, lab[best_gt, 0] + 1.0, 0.0)
         return loc_t.reshape(-1), loc_m.reshape(-1), cls_t
 
-    loc_target, loc_mask, cls_target = jax.vmap(one)(label)
+    loc_target, loc_mask, cls_target = jax.vmap(one)(label, cls_pred)
     return loc_target, loc_mask, cls_target
 
 alias("_contrib_MultiBoxTarget", "MultiBoxTarget")
@@ -376,8 +401,12 @@ def _multibox_detection(attrs, cls_prob, loc_pred, anchor):
         alive = lax.fori_loop(0, A, body,
                               jnp.ones((A,), dtype=bool))
         kept = keep[order] & alive
+        # reported ids are 0-based with background removed
+        # (reference: multibox_detection-inl.h TransformLocations)
+        report_id = cls_id[order].astype(boxes.dtype) - \
+            (cls_id[order] > bg).astype(boxes.dtype)
         out = jnp.concatenate([
-            jnp.where(kept, cls_id[order].astype(boxes.dtype), -1.0)[:, None],
+            jnp.where(kept, report_id, -1.0)[:, None],
             (score[order] * kept)[:, None], boxes_o], axis=-1)
         return out
 

@@ -406,3 +406,281 @@ def ImageRecordIter(path_imgrec, data_shape, batch_size, path_imgidx=None,
         from .io import PrefetchingIter
         return PrefetchingIter(it)
     return it
+
+
+# ---------------------------------------------------------------------------
+# detection-aware augmenters + iterator (reference:
+# src/io/image_det_aug_default.cc:1-667, iter_image_det_recordio.cc:578).
+# Det augmenters transform (image, label) together; label is a (num_obj, 5)
+# float array of rows [cls_id, x1, y1, x2, y2] with coordinates normalized
+# to [0, 1] and cls_id = -1 marking padding rows.
+# ---------------------------------------------------------------------------
+def _det_valid(label):
+    return label[:, 0] >= 0
+
+
+def DetHorizontalFlipAug(p):
+    """Mirror image and boxes together (reference: DefaultImageDetAugmenter
+    rand_mirror_prob)."""
+    def aug(src, label):
+        if pyrandom.random() < p:
+            img = _asnp(src)[:, ::-1]
+            lab = label.copy()
+            v = _det_valid(lab)
+            x1 = lab[:, 1].copy()
+            lab[:, 1] = np.where(v, 1.0 - lab[:, 3], lab[:, 1])
+            lab[:, 3] = np.where(v, 1.0 - x1, lab[:, 3])
+            return array(img), lab
+        return src, label
+    return aug
+
+
+def DetRandomCropAug(min_object_covered=0.3, aspect_ratio_range=(0.75, 1.33),
+                     area_range=(0.3, 1.0), max_attempts=25):
+    """Box-aware random crop: a sampled crop is accepted only if it keeps
+    at least one object center and covers >= min_object_covered of each
+    kept object (reference: det_aug crop_strategies)."""
+    def aug(src, label):
+        img = _asnp(src)
+        h, w = img.shape[:2]
+        valid = _det_valid(label)
+        if not valid.any():
+            return src, label
+        for _ in range(max_attempts):
+            area = pyrandom.uniform(*area_range)
+            aspect = pyrandom.uniform(*aspect_ratio_range)
+            cw = min(1.0, np.sqrt(area * aspect))
+            ch = min(1.0, np.sqrt(area / aspect))
+            cx0 = pyrandom.uniform(0, 1.0 - cw)
+            cy0 = pyrandom.uniform(0, 1.0 - ch)
+            cx1, cy1 = cx0 + cw, cy0 + ch
+            centers_x = (label[:, 1] + label[:, 3]) / 2
+            centers_y = (label[:, 2] + label[:, 4]) / 2
+            keep = valid & (centers_x > cx0) & (centers_x < cx1) & \
+                (centers_y > cy0) & (centers_y < cy1)
+            if not keep.any():
+                continue
+            # coverage of each kept box by the crop
+            ix1 = np.maximum(label[:, 1], cx0)
+            iy1 = np.maximum(label[:, 2], cy0)
+            ix2 = np.minimum(label[:, 3], cx1)
+            iy2 = np.minimum(label[:, 4], cy1)
+            inter = np.clip(ix2 - ix1, 0, None) * np.clip(iy2 - iy1, 0,
+                                                          None)
+            box_area = (label[:, 3] - label[:, 1]) * \
+                (label[:, 4] - label[:, 2])
+            cov = np.where(box_area > 0, inter / np.maximum(box_area, 1e-8),
+                           0.0)
+            if (cov[keep] < min_object_covered).any():
+                continue
+            px0, py0 = int(cx0 * w), int(cy0 * h)
+            px1, py1 = max(px0 + 1, int(cx1 * w)), max(py0 + 1, int(cy1 * h))
+            out = img[py0:py1, px0:px1]
+            lab = label.copy()
+            lab[:, 0] = np.where(keep, lab[:, 0], -1.0)
+            for c, (lo, span) in ((1, (cx0, cw)), (3, (cx0, cw)),
+                                  (2, (cy0, ch)), (4, (cy0, ch))):
+                lab[:, c] = np.clip((lab[:, c] - lo) / span, 0.0, 1.0)
+            return array(out), lab
+        return src, label
+    return aug
+
+
+def DetRandomPadAug(aspect_ratio_range=(0.75, 1.33), area_range=(1.0, 2.0),
+                    max_attempts=25, fill=127):
+    """Place the image on a larger filled canvas, shrinking boxes
+    accordingly (reference: det_aug rand_pad_prob/pad strategies)."""
+    def aug(src, label):
+        img = _asnp(src)
+        h, w = img.shape[:2]
+        for _ in range(max_attempts):
+            area = pyrandom.uniform(*area_range)
+            aspect = pyrandom.uniform(*aspect_ratio_range)
+            nw = np.sqrt(area * aspect)
+            nh = np.sqrt(area / aspect)
+            if nw < 1.0 or nh < 1.0:
+                continue
+            ph, pw = int(round(h * nh)), int(round(w * nw))
+            y0 = pyrandom.randint(0, ph - h)
+            x0 = pyrandom.randint(0, pw - w)
+            canvas = np.full((ph, pw) + img.shape[2:], fill,
+                             dtype=img.dtype)
+            canvas[y0:y0 + h, x0:x0 + w] = img
+            lab = label.copy()
+            v = _det_valid(lab)
+            lab[:, 1] = np.where(v, (lab[:, 1] * w + x0) / pw, lab[:, 1])
+            lab[:, 3] = np.where(v, (lab[:, 3] * w + x0) / pw, lab[:, 3])
+            lab[:, 2] = np.where(v, (lab[:, 2] * h + y0) / ph, lab[:, 2])
+            lab[:, 4] = np.where(v, (lab[:, 4] * h + y0) / ph, lab[:, 4])
+            return array(canvas), lab
+        return src, label
+    return aug
+
+
+def DetResizeAug(size, interp=2):
+    """Force resize to (w, h) = size — boxes are normalized, unchanged."""
+    def aug(src, label):
+        img = _asnp(src)
+        cv2 = _cv2()
+        if cv2 is not None:
+            out = cv2.resize(img, size, interpolation=interp)
+        else:
+            ys = (np.linspace(0, img.shape[0] - 1, size[1])).astype(int)
+            xs = (np.linspace(0, img.shape[1] - 1, size[0])).astype(int)
+            out = img[ys][:, xs]
+        return array(out), label
+    return aug
+
+
+def _det_wrap(color_aug):
+    """Lift a classification (image-only) augmenter to det signature."""
+    def aug(src, label):
+        return color_aug(src)[0], label
+    return aug
+
+
+def CreateDetAugmenter(data_shape, resize=0, rand_crop=0, rand_pad=0,
+                       rand_mirror=False, mean=None, std=None, brightness=0,
+                       contrast=0, saturation=0, pca_noise=0,
+                       min_object_covered=0.3,
+                       aspect_ratio_range=(0.75, 1.33),
+                       area_range=(0.3, 3.0), max_attempts=25,
+                       pad_val=127, inter_method=2):
+    """reference: CreateDetAugmenter (image_det_aug_default.cc params)."""
+    auglist = []
+    if resize > 0:
+        # shorter-edge resize BEFORE crops/pads, like the reference —
+        # boxes are normalized so only the pixels change
+        def shorter_edge(src, label, _s=resize, _i=inter_method):
+            return resize_short(src, _s, _i), label
+        auglist.append(shorter_edge)
+    if rand_crop > 0:
+        crop = DetRandomCropAug(min_object_covered, aspect_ratio_range,
+                                (min(area_range[0], 1.0),
+                                 min(area_range[1], 1.0)), max_attempts)
+        p = rand_crop
+
+        def maybe_crop(src, label, _crop=crop, _p=p):
+            if pyrandom.random() < _p:
+                return _crop(src, label)
+            return src, label
+        auglist.append(maybe_crop)
+    if rand_pad > 0:
+        pad = DetRandomPadAug(aspect_ratio_range,
+                              (max(area_range[0], 1.0),
+                               max(area_range[1], 1.0)),
+                              max_attempts, pad_val)
+        p = rand_pad
+
+        def maybe_pad(src, label, _pad=pad, _p=p):
+            if pyrandom.random() < _p:
+                return _pad(src, label)
+            return src, label
+        auglist.append(maybe_pad)
+    auglist.append(DetResizeAug((data_shape[2], data_shape[1]),
+                                inter_method))
+    if rand_mirror:
+        auglist.append(DetHorizontalFlipAug(0.5))
+    auglist.append(_det_wrap(CastAug()))
+    if brightness or contrast or saturation:
+        auglist.append(_det_wrap(ColorJitterAug(brightness, contrast,
+                                                saturation)))
+    if pca_noise > 0:
+        eigval = np.array([55.46, 4.794, 1.148])
+        eigvec = np.array([[-0.5675, 0.7192, 0.4009],
+                           [-0.5808, -0.0045, -0.8140],
+                           [-0.5836, -0.6948, 0.4203]])
+        auglist.append(_det_wrap(LightingAug(pca_noise, eigval, eigvec)))
+    if mean is True:
+        mean = np.array([123.68, 116.28, 103.53])
+    if std is True:
+        std = np.array([58.395, 57.12, 57.375])
+    if mean is not None:
+        auglist.append(_det_wrap(ColorNormalizeAug(mean, std)))
+    return auglist
+
+
+class ImageDetIter(DataIter):
+    """Detection iterator (reference: ImageDetRecordIter,
+    iter_image_det_recordio.cc:578): yields data (N, C, H, W) and padded
+    label (N, max_obj, 5). Sources: in-memory (images, labels) lists or a
+    RecordIO pack via ``path_imgrec`` where each record's label is a flat
+    [cls, x1, y1, x2, y2] * k vector."""
+
+    def __init__(self, batch_size, data_shape, images=None, labels=None,
+                 path_imgrec=None, shuffle=False, aug_list=None,
+                 max_objects=None, data_name="data", label_name="label",
+                 **kwargs):
+        super().__init__(batch_size)
+        self._data_shape = tuple(data_shape)
+        if path_imgrec is not None:
+            # hold compressed buffers, decode per batch (a full detection
+            # pack decoded up front would not fit in host memory; the
+            # classification ImageIter streams the same way)
+            rec = recordio.MXRecordIO(path_imgrec, "r")
+            images, labels = [], []
+            while True:
+                item = rec.read()
+                if item is None:
+                    break
+                header, img_buf = recordio.unpack(item)
+                flat = np.asarray(header.label, dtype=np.float32).reshape(
+                    -1, 5)
+                images.append(img_buf)
+                labels.append(flat)
+            rec.close()
+        if images is None or labels is None:
+            raise MXNetError("ImageDetIter needs images+labels or "
+                             "path_imgrec")
+        self._images = list(images)
+        self._labels = [np.asarray(l, dtype=np.float32).reshape(-1, 5)
+                        for l in labels]
+        self._shuffle = shuffle
+        self._aug = aug_list if aug_list is not None else \
+            CreateDetAugmenter(data_shape)
+        self._max_obj = max_objects or max(
+            (l.shape[0] for l in self._labels), default=1)
+        self._order = list(range(len(self._images)))
+        self._pos = 0
+        self.data_name, self.label_name = data_name, label_name
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return [DataDesc(self.data_name,
+                         (self.batch_size,) + self._data_shape)]
+
+    @property
+    def provide_label(self):
+        return [DataDesc(self.label_name,
+                         (self.batch_size, self._max_obj, 5))]
+
+    def reset(self):
+        self._pos = 0
+        if self._shuffle:
+            pyrandom.shuffle(self._order)
+
+    def next(self):
+        if self._pos >= len(self._order):
+            raise StopIteration
+        n = self.batch_size
+        data = np.zeros((n,) + self._data_shape, dtype=np.float32)
+        label = np.full((n, self._max_obj, 5), -1.0, dtype=np.float32)
+        pad = 0
+        for i in range(n):
+            if self._pos >= len(self._order):
+                pad += 1
+                continue
+            idx = self._order[self._pos]
+            self._pos += 1
+            img = self._images[idx]
+            if isinstance(img, (bytes, bytearray)):
+                img = imdecode(img).asnumpy()
+            lab = self._labels[idx].copy()
+            for aug in self._aug:
+                img, lab = aug(img, lab)
+            img = _asnp(img).astype(np.float32)
+            data[i] = img.transpose(2, 0, 1)
+            k = min(lab.shape[0], self._max_obj)
+            label[i, :k] = lab[:k]
+        return DataBatch([array(data)], [array(label)], pad=pad)

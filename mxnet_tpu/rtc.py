@@ -90,6 +90,11 @@ class Rtc:
             if tuple(v.shape) != shp:
                 raise MXNetError(f"{self.name}: input {nm!r} shape "
                                  f"{v.shape} != declared {shp}")
+        for i, (o, st) in enumerate(zip(outs, self._out_struct)):
+            if tuple(o.shape) != tuple(st.shape):
+                raise MXNetError(f"{self.name}: output {i} shape "
+                                 f"{tuple(o.shape)} != declared "
+                                 f"{tuple(st.shape)}")
         results = self._fn(*vals)
         if not isinstance(results, (list, tuple)):
             results = [results]
@@ -142,11 +147,27 @@ def register_pallas_op(name, kernel, out_shapes, inputs=("data",),
                 kwargs[k] = _resolve(spec, attrs, in_shapes)
         return pallas_call(kernel(attrs), out_shape=outs, **kwargs), outs
 
-    def simple_forward(attrs, *in_vals):
+    # cache compiled callables per (attrs, input shapes/dtypes): eager
+    # call sites would otherwise re-trace the kernel (and rebuild the
+    # custom_vjp wrapper) on every invocation
+    _cache = {}
+
+    def _cache_key(attrs, in_vals):
+        try:
+            akey = tuple(sorted(attrs.items()))
+            hash(akey)
+        except TypeError:
+            return None
+        return (akey, tuple((tuple(v.shape), str(v.dtype))
+                            for v in in_vals))
+
+    def _make_op(attrs):
         if vjp_kernel is None:
-            call, _ = _build_call(attrs, in_vals)
-            out = call(*in_vals)
-            return tuple(out) if isinstance(out, (list, tuple)) else out
+            def op(*vals):
+                call, _ = _build_call(attrs, vals)
+                out = call(*vals)
+                return tuple(out) if isinstance(out, (list, tuple)) else out
+            return op
 
         @jax.custom_vjp
         def op(*vals):
@@ -174,6 +195,15 @@ def register_pallas_op(name, kernel, out_shapes, inputs=("data",),
             return tuple(bw(*vals, *cts))
 
         op.defvjp(fwd, bwd)
+        return op
+
+    def simple_forward(attrs, *in_vals):
+        key = _cache_key(attrs, in_vals)
+        op = _cache.get(key) if key is not None else None
+        if op is None:
+            op = jax.jit(_make_op(attrs))
+            if key is not None:
+                _cache[key] = op
         return op(*in_vals)
 
     return _register_op(name, inputs=inputs, simple=simple_forward,

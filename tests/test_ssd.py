@@ -1,0 +1,140 @@
+"""SSD end-to-end + detection augmenters.
+
+Gates the last uncovered BASELINE config (reference: example/ssd/): the
+MultiBox op trio driven by a real training loop on synthetic shapes, and
+the box-aware augmenters (reference: image_det_aug_default.cc:1-667).
+"""
+import os
+import sys
+import types
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+from examples.ssd import data as shapes_data  # noqa: E402
+from examples.ssd import symbol as ssd_symbol  # noqa: E402
+from examples.ssd import train as ssd_train  # noqa: E402
+
+
+# ------------------------------------------------------------- augmenters
+def test_det_flip_box_math():
+    img = np.zeros((10, 20, 3), np.uint8)
+    img[:, :10] = 255
+    label = np.array([[0, 0.1, 0.2, 0.4, 0.6],
+                      [-1, 0, 0, 0, 0]], np.float32)
+    aug = mx.image.DetHorizontalFlipAug(1.0)
+    out, lab = aug(img, label)
+    assert np.allclose(lab[0], [0, 0.6, 0.2, 0.9, 0.6], atol=1e-6)
+    assert lab[1, 0] == -1
+    assert out.asnumpy()[:, 10:].max() == 255  # image mirrored too
+
+
+def test_det_crop_keeps_centers_and_renormalizes():
+    np.random.seed(0)
+    import random as pyrandom
+    pyrandom.seed(0)
+    img = np.random.randint(0, 255, (40, 40, 3)).astype(np.uint8)
+    label = np.array([[1, 0.4, 0.4, 0.6, 0.6]], np.float32)
+    aug = mx.image.DetRandomCropAug(min_object_covered=0.5,
+                                    area_range=(0.5, 0.9))
+    for _ in range(5):
+        out, lab = aug(img, label)
+        if lab[0, 0] >= 0:
+            assert 0.0 <= lab[0, 1] < lab[0, 3] <= 1.0
+            assert 0.0 <= lab[0, 2] < lab[0, 4] <= 1.0
+
+
+def test_det_pad_shrinks_boxes():
+    import random as pyrandom
+    pyrandom.seed(1)
+    img = np.full((20, 20, 3), 200, np.uint8)
+    label = np.array([[0, 0.0, 0.0, 1.0, 1.0]], np.float32)
+    aug = mx.image.DetRandomPadAug(area_range=(1.5, 2.0))
+    out, lab = aug(img, label)
+    w = lab[0, 3] - lab[0, 1]
+    h = lab[0, 4] - lab[0, 2]
+    assert w < 1.0 and h < 1.0          # box shrank on the canvas
+    assert w * h > 0.3                  # but not degenerately
+
+
+def test_det_iter_shapes_and_padding():
+    imgs, labs = shapes_data.make_shapes_dataset(10, size=48)
+    it = mx.image.ImageDetIter(4, (3, 48, 48), imgs, labs, max_objects=3)
+    b = next(it)
+    assert b.data[0].shape == (4, 3, 48, 48)
+    assert b.label[0].shape == (4, 3, 5)
+    lab = b.label[0].asnumpy()
+    valid = lab[:, :, 0] >= 0
+    assert valid.any()
+    assert (lab[~valid] == -1).all()
+
+
+# ------------------------------------------------------------- end to end
+def test_ssd_trains_and_detects():
+    """Loss must fall and decoded detections must localize objects on the
+    training distribution (synthetic shapes)."""
+    args = types.SimpleNamespace(epochs=6, batch_size=16, num_images=96,
+                                 data_size=96, width=16, lr=0.02,
+                                 log_every=50)
+    train_iter, _ = ssd_train.build_iters(args,
+                                          rng=np.random.RandomState(1))
+    net = ssd_symbol.get_train_symbol(num_classes=2, width=args.width)
+    mod = mx.mod.Module(net, data_names=("data",), label_names=("label",),
+                        context=mx.cpu())
+    metric = ssd_train.MultiBoxMetric()
+    first_ce, last_ce = [], []
+
+    class Grab:
+        def __init__(self, store):
+            self.store = store
+
+        def __call__(self, param):
+            names, vals = param.eval_metric.get()
+            self.store.append(vals[0])
+
+    for epoch in range(args.epochs):
+        train_iter.reset()
+        metric.reset()
+        for batch in train_iter:
+            if not mod.binded:
+                mod.bind(train_iter.provide_data, train_iter.provide_label,
+                         for_training=True)
+                mod.init_params(mx.initializer.Xavier())
+                mod.init_optimizer(optimizer="sgd", optimizer_params={
+                    "learning_rate": args.lr, "momentum": 0.9, "wd": 5e-4})
+            mod.forward_backward(batch)
+            mod.update()
+            mod.update_metric(metric, batch.label)
+        ce = metric.get()[1][0]
+        (first_ce if epoch == 0 else last_ce).append(ce)
+    assert last_ce[-1] < 0.6 * first_ce[0], (first_ce, last_ce)
+
+    # detection sanity on the training distribution
+    imgs, labs = shapes_data.make_shapes_dataset(
+        4, size=args.data_size, rng=np.random.RandomState(9))
+    dets = ssd_train.detect(mod, args, imgs)
+    assert dets.shape[0] == 4 and dets.shape[2] == 6
+    hits = 0
+    for det, lab in zip(dets, labs):
+        kept = det[det[:, 0] >= 0]
+        if not len(kept):
+            continue
+        top = kept[np.argsort(-kept[:, 1])][: len(lab)]
+        for gt in lab:
+            gx1, gy1, gx2, gy2 = gt[1:5]
+            for row in top:
+                x1, y1, x2, y2 = row[2:6]
+                ix = max(0, min(x2, gx2) - max(x1, gx1))
+                iy = max(0, min(y2, gy2) - max(y1, gy1))
+                inter = ix * iy
+                union = (x2 - x1) * (y2 - y1) + \
+                    (gx2 - gx1) * (gy2 - gy1) - inter
+                if union > 0 and inter / union > 0.3:
+                    hits += 1
+                    break
+    total_gt = sum(len(l) for l in labs)
+    assert hits >= total_gt * 0.5, (hits, total_gt)
