@@ -80,7 +80,10 @@ def bench_ours(imgs, labels):
     sym = resnet.get_symbol(num_classes=NUM_CLASSES, num_layers=50,
                             image_shape="3,224,224")
     it = mx.io.NDArrayIter(imgs, labels, batch_size=BATCH)
-    mod = mx.mod.Module(sym, context=mx.context.current_context(),
+    # pin the accelerator explicitly: the default context is cpu (reference
+    # semantics), which on this host would strand params on the CPU backend
+    # while jnp ops land on the chip — every node a cross-device transfer
+    mod = mx.mod.Module(sym, context=mx.tpu(),
                         compute_dtype=jnp.bfloat16)
     opt_params = {"learning_rate": LR, "momentum": MOMENTUM}
 
@@ -95,7 +98,9 @@ def bench_ours(imgs, labels):
     tic = time.perf_counter()
     mod.fit(it, num_epoch=TIMED_EPOCHS, optimizer_params=opt_params)
     exe = mod._exec_group.executor
-    jax.block_until_ready(exe.arg_dict["fc1_weight"].asjax())
+    # scalar fetch forces the full chain (block_until_ready is unreliable
+    # through the tunnel); fit's per-batch metric pulls already force most
+    float(jax.device_get(exe.arg_dict["fc1_weight"].asjax().ravel()[0]))
     toc = time.perf_counter()
     img_s = N_BATCHES * TIMED_EPOCHS * BATCH / (toc - tic)
 
@@ -138,13 +143,16 @@ def bench_flax(imgs, labels):
     _log("flax: warm steps")
     for i in range(3):                      # compile + warm
         state, loss = step(state, *batch(i))
-    jax.block_until_ready(loss)
+    float(jax.device_get(loss))
     _log("flax: timing")
 
+    # force real completion with a scalar fetch: through the remote-chip
+    # tunnel block_until_ready returns before execution finishes, which
+    # would time async dispatch instead of the train step
     tic = time.perf_counter()
     for i in range(FLAX_STEPS):
         state, loss = step(state, *batch(i))
-    jax.block_until_ready(loss)
+    float(jax.device_get(loss))             # chained state forces all steps
     toc = time.perf_counter()
     return FLAX_STEPS * BATCH / (toc - tic), flops
 
@@ -177,6 +185,10 @@ def main():
         "flops_per_step_flax": flax_flops,
         "device": dev.device_kind,
         "vs_p100_context": round(ours_img_s / REFERENCE_P100_IMG_S, 1),
+        "env_note": "remote-tunneled chip: per-execution RPC latency "
+                    "dominates absolute img/s (device-side matmuls hit "
+                    "67 TFLOP/s; D2H ~12 MB/s); both sides timed with "
+                    "forced completion, so the ratio is the signal",
     }))
 
 

@@ -29,9 +29,9 @@ def _cv2():
         return None
 
 
-def imdecode(buf, flag=1, to_rgb=True, **kwargs):
-    """Decode an image byte buffer -> (H, W, C) ndarray.
-    reference: image.py imdecode (mx.img)."""
+def _imdecode_np(buf, flag=1, to_rgb=True):
+    """Decode to a host numpy array (the pipeline-internal path: the hot
+    decode loop must never bounce pixels through device buffers)."""
     cv2 = _cv2()
     if cv2 is not None:
         img = cv2.imdecode(np.frombuffer(buf, dtype=np.uint8), flag)
@@ -39,29 +39,39 @@ def imdecode(buf, flag=1, to_rgb=True, **kwargs):
             raise MXNetError("cannot decode image")
         if to_rgb and img.ndim == 3:
             img = img[..., ::-1]
-        return array(img)
+        return np.ascontiguousarray(img)
     try:
         from PIL import Image
         import io as _io
         img = np.asarray(Image.open(_io.BytesIO(buf)).convert("RGB"))
-        return array(img)
+        return img
     except ImportError:
         raise MXNetError("imdecode requires cv2 or PIL")
+
+
+def imdecode(buf, flag=1, to_rgb=True, **kwargs):
+    """Decode an image byte buffer -> (H, W, C) NDArray.
+    reference: image.py imdecode (mx.img)."""
+    return array(_imdecode_np(buf, flag, to_rgb))
 
 
 def _asnp(img):
     return img.asnumpy() if isinstance(img, NDArray) else np.asarray(img)
 
 
-def resize_short(src, size, interp=2):
-    """Resize shorter edge to `size`. reference: image.py resize_short."""
+def _resize_short_np(src, size, interp=2):
     img = _asnp(src)
     h, w = img.shape[:2]
     if h > w:
         new_h, new_w = size * h // w, size
     else:
         new_h, new_w = size, size * w // h
-    return array(_resize(img, new_w, new_h, interp))
+    return _resize(img, new_w, new_h, interp)
+
+
+def resize_short(src, size, interp=2):
+    """Resize shorter edge to `size`. reference: image.py resize_short."""
+    return array(_resize_short_np(src, size, interp))
 
 
 def _resize(img, w, h, interp=2):
@@ -72,36 +82,52 @@ def _resize(img, w, h, interp=2):
     return np.asarray(Image.fromarray(img.astype(np.uint8)).resize((w, h)))
 
 
-def fixed_crop(src, x0, y0, w, h, size=None, interp=2):
+def _fixed_crop_np(src, x0, y0, w, h, size=None, interp=2):
     img = _asnp(src)
     out = img[y0:y0 + h, x0:x0 + w]
     if size is not None and (w, h) != size:
         out = _resize(out, size[0], size[1], interp)
-    return array(out)
+    return out
 
 
-def random_crop(src, size, interp=2):
+def fixed_crop(src, x0, y0, w, h, size=None, interp=2):
+    return array(_fixed_crop_np(src, x0, y0, w, h, size, interp))
+
+
+def _random_crop_np(src, size, interp=2):
     img = _asnp(src)
     h, w = img.shape[:2]
     new_w, new_h = size
     x0 = pyrandom.randint(0, max(w - new_w, 0))
     y0 = pyrandom.randint(0, max(h - new_h, 0))
-    out = fixed_crop(img, x0, y0, min(new_w, w), min(new_h, h), size, interp)
+    out = _fixed_crop_np(img, x0, y0, min(new_w, w), min(new_h, h), size,
+                         interp)
     return out, (x0, y0, new_w, new_h)
 
 
-def center_crop(src, size, interp=2):
+def random_crop(src, size, interp=2):
+    out, coords = _random_crop_np(src, size, interp)
+    return array(out), coords
+
+
+def _center_crop_np(src, size, interp=2):
     img = _asnp(src)
     h, w = img.shape[:2]
     new_w, new_h = size
     x0 = max((w - new_w) // 2, 0)
     y0 = max((h - new_h) // 2, 0)
-    out = fixed_crop(img, x0, y0, min(new_w, w), min(new_h, h), size, interp)
+    out = _fixed_crop_np(img, x0, y0, min(new_w, w), min(new_h, h), size,
+                         interp)
     return out, (x0, y0, new_w, new_h)
 
 
-def random_size_crop(src, size, min_area=0.08, ratio=(3 / 4.0, 4 / 3.0),
-                     interp=2):
+def center_crop(src, size, interp=2):
+    out, coords = _center_crop_np(src, size, interp)
+    return array(out), coords
+
+
+def _random_size_crop_np(src, size, min_area=0.08, ratio=(3 / 4.0, 4 / 3.0),
+                         interp=2):
     img = _asnp(src)
     h, w = img.shape[:2]
     area = h * w
@@ -114,42 +140,46 @@ def random_size_crop(src, size, min_area=0.08, ratio=(3 / 4.0, 4 / 3.0),
         if new_w <= w and new_h <= h:
             x0 = pyrandom.randint(0, w - new_w)
             y0 = pyrandom.randint(0, h - new_h)
-            return fixed_crop(img, x0, y0, new_w, new_h, size, interp), \
-                (x0, y0, new_w, new_h)
-    return center_crop(src, size, interp)
+            return _fixed_crop_np(img, x0, y0, new_w, new_h, size,
+                                  interp), (x0, y0, new_w, new_h)
+    return _center_crop_np(src, size, interp)
 
 
-def color_normalize(src, mean, std=None):
+def _color_normalize_np(src, mean, std=None):
     img = _asnp(src).astype(np.float32)
     img = img - _asnp(mean)
     if std is not None:
         img = img / _asnp(std)
-    return array(img)
+    return img
+
+
+def color_normalize(src, mean, std=None):
+    return array(_color_normalize_np(src, mean, std))
 
 
 # ------------------------------------------------------------- augmenters
 def ResizeAug(size, interp=2):
     def aug(src):
-        return [resize_short(src, size, interp)]
+        return [_resize_short_np(src, size, interp)]
     return aug
 
 
 def RandomCropAug(size, interp=2):
     def aug(src):
-        return [random_crop(src, size, interp)[0]]
+        return [_random_crop_np(src, size, interp)[0]]
     return aug
 
 
 def RandomSizedCropAug(size, min_area=0.08, ratio=(3 / 4.0, 4 / 3.0),
                        interp=2):
     def aug(src):
-        return [random_size_crop(src, size, min_area, ratio, interp)[0]]
+        return [_random_size_crop_np(src, size, min_area, ratio, interp)[0]]
     return aug
 
 
 def CenterCropAug(size, interp=2):
     def aug(src):
-        return [center_crop(src, size, interp)[0]]
+        return [_center_crop_np(src, size, interp)[0]]
     return aug
 
 
@@ -180,7 +210,7 @@ def ColorJitterAug(brightness, contrast, saturation):
             alpha = 1.0 + pyrandom.uniform(-saturation, saturation)
             gray = (img * coef).sum(axis=2, keepdims=True)
             img = img * alpha + gray * (1 - alpha)
-        return [array(img)]
+        return [img]
     return aug
 
 
@@ -189,27 +219,27 @@ def LightingAug(alphastd, eigval, eigvec):
         img = _asnp(src).astype(np.float32)
         alpha = np.random.normal(0, alphastd, size=(3,))
         rgb = np.dot(_asnp(eigvec) * alpha, _asnp(eigval))
-        return [array(img + rgb)]
+        return [img + rgb]
     return aug
 
 
 def ColorNormalizeAug(mean, std):
     def aug(src):
-        return [color_normalize(src, mean, std)]
+        return [_color_normalize_np(src, mean, std)]
     return aug
 
 
 def HorizontalFlipAug(p):
     def aug(src):
         if pyrandom.random() < p:
-            return [array(_asnp(src)[:, ::-1])]
+            return [_asnp(src)[:, ::-1]]
         return [src]
     return aug
 
 
 def CastAug():
     def aug(src):
-        return [array(_asnp(src).astype(np.float32))]
+        return [_asnp(src).astype(np.float32)]
     return aug
 
 
@@ -342,7 +372,7 @@ class ImageIter(DataIter):
 
     def _decode_augment(self, item):
         label, img_bytes = item
-        img = imdecode(img_bytes)
+        img = _imdecode_np(img_bytes)
         for aug in self.aug_list:
             img = aug(img)[0]
         arr = _asnp(img).astype(np.float32)
@@ -430,7 +460,7 @@ def DetHorizontalFlipAug(p):
             x1 = lab[:, 1].copy()
             lab[:, 1] = np.where(v, 1.0 - lab[:, 3], lab[:, 1])
             lab[:, 3] = np.where(v, 1.0 - x1, lab[:, 3])
-            return array(img), lab
+            return img, lab
         return src, label
     return aug
 
@@ -481,7 +511,7 @@ def DetRandomCropAug(min_object_covered=0.3, aspect_ratio_range=(0.75, 1.33),
             for c, (lo, span) in ((1, (cx0, cw)), (3, (cx0, cw)),
                                   (2, (cy0, ch)), (4, (cy0, ch))):
                 lab[:, c] = np.clip((lab[:, c] - lo) / span, 0.0, 1.0)
-            return array(out), lab
+            return out, lab
         return src, label
     return aug
 
@@ -512,7 +542,7 @@ def DetRandomPadAug(aspect_ratio_range=(0.75, 1.33), area_range=(1.0, 2.0),
             lab[:, 3] = np.where(v, (lab[:, 3] * w + x0) / pw, lab[:, 3])
             lab[:, 2] = np.where(v, (lab[:, 2] * h + y0) / ph, lab[:, 2])
             lab[:, 4] = np.where(v, (lab[:, 4] * h + y0) / ph, lab[:, 4])
-            return array(canvas), lab
+            return canvas, lab
         return src, label
     return aug
 
@@ -528,7 +558,7 @@ def DetResizeAug(size, interp=2):
             ys = (np.linspace(0, img.shape[0] - 1, size[1])).astype(int)
             xs = (np.linspace(0, img.shape[1] - 1, size[0])).astype(int)
             out = img[ys][:, xs]
-        return array(out), label
+        return out, label
     return aug
 
 
@@ -552,7 +582,7 @@ def CreateDetAugmenter(data_shape, resize=0, rand_crop=0, rand_pad=0,
         # shorter-edge resize BEFORE crops/pads, like the reference —
         # boxes are normalized so only the pixels change
         def shorter_edge(src, label, _s=resize, _i=inter_method):
-            return resize_short(src, _s, _i), label
+            return _resize_short_np(src, _s, _i), label
         auglist.append(shorter_edge)
     if rand_crop > 0:
         crop = DetRandomCropAug(min_object_covered, aspect_ratio_range,
@@ -675,7 +705,7 @@ class ImageDetIter(DataIter):
             self._pos += 1
             img = self._images[idx]
             if isinstance(img, (bytes, bytearray)):
-                img = imdecode(img).asnumpy()
+                img = _imdecode_np(img)
             lab = self._labels[idx].copy()
             for aug in self._aug:
                 img, lab = aug(img, lab)
@@ -684,3 +714,9 @@ class ImageDetIter(DataIter):
             k = min(lab.shape[0], self._max_obj)
             label[i, :k] = lab[:k]
         return DataBatch([array(data)], [array(label)], pad=pad)
+
+
+def random_size_crop(src, size, min_area=0.08, ratio=(3 / 4.0, 4 / 3.0),
+                     interp=2):
+    out, coords = _random_size_crop_np(src, size, min_area, ratio, interp)
+    return array(out), coords

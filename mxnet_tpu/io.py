@@ -158,7 +158,8 @@ class PrefetchingIter(DataIter):
     host decode overlaps device compute.
     """
 
-    def __init__(self, iters, rename_data=None, rename_label=None):
+    def __init__(self, iters, rename_data=None, rename_label=None,
+                 device=None):
         super().__init__()
         if not isinstance(iters, list):
             iters = [iters]
@@ -167,6 +168,11 @@ class PrefetchingIter(DataIter):
         self.iters = iters
         self.rename_data = rename_data
         self.rename_label = rename_label
+        # prefetch-to-device double buffering (the C++ pipeline's pinned
+        # staging + async H2D copy, iter_prefetcher.h): the producer
+        # thread lands each batch in HBM while the consumer computes on
+        # the previous one, so the train step never waits on the copy
+        self._device = device
         self.batch_size = self.provide_data[0].shape[0]
         self._queue = _queue.Queue(maxsize=2)
         self._stop = threading.Event()
@@ -198,7 +204,20 @@ class PrefetchingIter(DataIter):
             except StopIteration:
                 self._queue.put(None)
                 return
+            if self._device is not None:
+                batches = [self._to_device(b) for b in batches]
             self._queue.put(batches)
+
+    def _to_device(self, batch):
+        import jax
+        dev = self._device.jax_device() if hasattr(
+            self._device, "jax_device") else self._device
+
+        def put(arr):
+            return NDArray(jax.device_put(arr.asjax(), dev))
+        return DataBatch([put(d) for d in batch.data],
+                         [put(l) for l in (batch.label or [])],
+                         pad=batch.pad, index=batch.index)
 
     def _start(self):
         self._stop.clear()

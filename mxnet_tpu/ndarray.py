@@ -48,9 +48,14 @@ class NDArray:
         if isinstance(data, NDArray):
             data = data._data
         if not isinstance(data, jax.Array):
-            data = jnp.asarray(data)
             if ctx is not None:
-                data = jax.device_put(data, ctx.jax_device())
+                # host data goes straight to the target device — going
+                # through jnp.asarray first would land it on the DEFAULT
+                # device and turn this into a cross-device round-trip
+                # (catastrophic when the default device is a remote chip)
+                data = jax.device_put(np.asarray(data), ctx.jax_device())
+            else:
+                data = jnp.asarray(data)
         elif ctx is not None and not _placement_matches(data, ctx):
             # move only across platforms; within a platform keep the
             # array's existing (possibly mesh-sharded) placement — a
@@ -292,7 +297,7 @@ def array(source_array, ctx=None, dtype=None):
     arr = np.asarray(source_array)
     if dtype is None:
         dtype = arr.dtype if arr.dtype != np.float64 else np.float32
-    return NDArray(jnp.asarray(arr, dtype=np.dtype(dtype)),
+    return NDArray(arr.astype(np.dtype(dtype), copy=False),
                    ctx=ctx or current_context())
 
 

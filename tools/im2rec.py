@@ -1,0 +1,141 @@
+#!/usr/bin/env python
+"""im2rec: pack an image dataset into RecordIO (reference: tools/im2rec.py
++ tools/im2rec.cc — same .lst / .rec / .idx formats).
+
+Two modes, like the reference:
+
+  list generation (one class per sub-directory of root):
+      python tools/im2rec.py --list prefix root
+
+  packing (reads prefix.lst, writes prefix.rec + prefix.idx):
+      python tools/im2rec.py prefix root [--resize N] [--quality Q]
+                                          [--num-thread T]
+
+.lst rows are "index\\tlabel(s...)\\trelative_path"; records are packed
+with IRHeader(label) + JPEG bytes, readable by ImageIter /
+ImageRecordIter / ImageDetIter.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from concurrent.futures import ThreadPoolExecutor
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+
+import numpy as np  # noqa: E402
+
+from mxnet_tpu import recordio  # noqa: E402
+from mxnet_tpu.image import _imdecode_np, _resize_short_np  # noqa: E402
+
+EXTS = (".jpg", ".jpeg", ".png", ".bmp")
+
+
+def make_list(prefix, root, recursive=True):
+    classes = sorted(
+        d for d in os.listdir(root)
+        if os.path.isdir(os.path.join(root, d))) if recursive else []
+    rows = []
+    if classes:
+        for lab, cls in enumerate(classes):
+            cdir = os.path.join(root, cls)
+            for fn in sorted(os.listdir(cdir)):
+                if fn.lower().endswith(EXTS):
+                    rows.append((float(lab), os.path.join(cls, fn)))
+    else:
+        for fn in sorted(os.listdir(root)):
+            if fn.lower().endswith(EXTS):
+                rows.append((0.0, fn))
+    lst = prefix + ".lst"
+    with open(lst, "w") as f:
+        for i, (lab, path) in enumerate(rows):
+            f.write(f"{i}\t{lab}\t{path}\n")
+    print(f"wrote {lst}: {len(rows)} images, "
+          f"{len(classes)} classes")
+    return lst
+
+
+def read_list(path):
+    with open(path) as f:
+        for line in f:
+            parts = line.strip().split("\t")
+            if len(parts) < 3:
+                continue
+            idx = int(parts[0])
+            labels = [float(x) for x in parts[1:-1]]
+            yield idx, labels, parts[-1]
+
+
+def _encode(img, quality, img_fmt=".jpg"):
+    try:
+        import cv2
+        ok, buf = cv2.imencode(img_fmt, img[:, :, ::-1],
+                               [cv2.IMWRITE_JPEG_QUALITY, quality])
+        if not ok:
+            raise RuntimeError("imencode failed")
+        return buf.tobytes()
+    except ImportError:
+        import io as _io
+        from PIL import Image
+        bio = _io.BytesIO()
+        Image.fromarray(img).save(bio, format="JPEG", quality=quality)
+        return bio.getvalue()
+
+
+def _load(path, resize):
+    with open(path, "rb") as f:
+        img = _imdecode_np(f.read()).astype(np.uint8)
+    if resize:
+        img = np.asarray(_resize_short_np(img, resize), dtype=np.uint8)
+    return img
+
+
+def pack(prefix, root, resize=0, quality=95, num_thread=4):
+    lst = prefix + ".lst"
+    if not os.path.exists(lst):
+        raise SystemExit(f"{lst} not found — run --list first")
+    items = list(read_list(lst))
+    rec = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
+
+    def job(item):
+        idx, labels, path = item
+        img = _load(os.path.join(root, path), resize)
+        buf = _encode(img, quality)
+        label = labels[0] if len(labels) == 1 else np.asarray(
+            labels, dtype=np.float32)
+        header = recordio.IRHeader(0, label, idx, 0)
+        return idx, recordio.pack(header, buf)
+
+    n = 0
+    with ThreadPoolExecutor(num_thread) as pool:
+        for idx, packed in pool.map(job, items):
+            rec.write_idx(idx, packed)
+            n += 1
+            if n % 1000 == 0:
+                print(f"packed {n}/{len(items)}")
+    rec.close()
+    print(f"wrote {prefix}.rec / {prefix}.idx: {n} records")
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("prefix")
+    p.add_argument("root")
+    p.add_argument("--list", action="store_true",
+                   help="generate prefix.lst from root instead of packing")
+    p.add_argument("--resize", type=int, default=0,
+                   help="resize shorter edge before packing")
+    p.add_argument("--quality", type=int, default=95)
+    p.add_argument("--num-thread", type=int, default=4)
+    args = p.parse_args()
+    if args.list:
+        make_list(args.prefix, args.root)
+    else:
+        pack(args.prefix, args.root, args.resize, args.quality,
+             args.num_thread)
+
+
+if __name__ == "__main__":
+    main()
