@@ -201,11 +201,14 @@ class PrefetchingIter(DataIter):
         while not self._stop.is_set():
             try:
                 batches = [i.next() for i in self.iters]
+                if self._device is not None:
+                    batches = [self._to_device(b) for b in batches]
             except StopIteration:
                 self._queue.put(None)
                 return
-            if self._device is not None:
-                batches = [self._to_device(b) for b in batches]
+            except BaseException as exc:  # surface in the consumer, don't
+                self._queue.put(("__error__", exc))  # die into a hang
+                return
             self._queue.put(batches)
 
     def _to_device(self, batch):
@@ -245,6 +248,9 @@ class PrefetchingIter(DataIter):
         batches = self._queue.get()
         if batches is None:
             raise StopIteration
+        if isinstance(batches, tuple) and batches and \
+                batches[0] == "__error__":
+            raise batches[1]
         data = sum([b.data for b in batches], [])
         label = sum([(b.label or []) for b in batches], [])
         return DataBatch(data=data, label=label, pad=batches[0].pad,

@@ -1,212 +1,165 @@
-"""Network visualization (reference: python/mxnet/visualization.py)."""
-from __future__ import annotations
+"""Network visualization (reference surface: python/mxnet/visualization.py
+— print_summary + plot_network).
 
-import json
+Implementation walks this framework's native node graph
+(``Symbol._topo_nodes``) instead of re-parsing JSON: node attrs are
+already typed, and parameter counts come from the shape-inference pass
+itself — every op's learnable-input sizes are summed exactly, rather than
+re-deriving Conv/FC formulas per op type.
+"""
+from __future__ import annotations
 
 from .symbol import Symbol
 
 __all__ = ["print_summary", "plot_network"]
 
+def _is_param(name):
+    return name.rsplit("_", 1)[-1] in ("weight", "bias", "gamma", "beta",
+                                       "mean", "var")
 
-def print_summary(symbol, shape=None, line_length=120, positions=(.44, .64,
-                                                                  .74, 1.)):
-    """Layer-by-layer summary table. reference: visualization.py:21."""
-    show_shape = False
-    shape_dict = {}
+
+def _graph_info(symbol, shape):
+    """Per-node rows: (node, out_shape|None, param_count, input_names).
+    Non-parameter variables (the graph inputs, e.g. ``data``) get their
+    own rows so the summary starts at the network input like the
+    reference's table."""
+    arg_shape_of = out_shape_of = None
     if shape is not None:
-        show_shape = True
-        interals = symbol.get_internals()
-        _, out_shapes, _ = interals.infer_shape(**shape)
-        if out_shapes is None:
-            raise ValueError("Input shape is incomplete")
-        shape_dict = dict(zip(interals.list_outputs(), out_shapes))
-    conf = json.loads(symbol.tojson())
-    nodes = conf["nodes"]
-    if positions[-1] <= 1:
-        positions = [int(line_length * p) for p in positions]
-    to_display = ["Layer (type)", "Output Shape", "Param #",
-                  "Previous Layer"]
+        internals = symbol.get_internals()
+        arg_shapes, out_shapes, aux_shapes = internals.infer_shape(**shape)
+        names = internals.list_outputs()
+        out_shape_of = dict(zip(names, out_shapes))
+        arg_shape_of = dict(zip(symbol.list_arguments(), arg_shapes))
+        arg_shape_of.update(zip(symbol.list_auxiliary_states(), aux_shapes))
+    rows = []
+    for node in symbol._topo_nodes():
+        if node.is_variable:
+            if not _is_param(node.name):
+                shp = arg_shape_of.get(node.name) if arg_shape_of else None
+                rows.append((node, shp, 0, []))
+            continue
+        params = 0
+        inputs = []
+        for inp, _ in node.inputs:
+            if inp.is_variable and _is_param(inp.name):
+                if shape is not None and inp.name in arg_shape_of:
+                    n = 1
+                    for d in arg_shape_of[inp.name]:
+                        n *= d
+                    params += n
+            else:
+                inputs.append(inp.name)
+        out = None
+        if shape is not None:
+            out = out_shape_of.get(f"{node.name}_output")
+            if out is None:  # multi-output ops expose indexed names
+                out = out_shape_of.get(f"{node.name}_output0")
+        rows.append((node, out, params, inputs))
+    return rows
 
-    def print_row(fields, positions):
+
+def print_summary(symbol, shape=None, line_length=98,
+                  positions=(0.42, 0.66, 0.80, 1.0)):
+    """Layer table: name(op) / output shape / #params / feeds-from.
+    reference surface: visualization.py print_summary."""
+    cols = [int(line_length * p) for p in positions]
+    header = ["Layer (type)", "Output Shape", "Param #", "Previous Layer"]
+
+    def emit(fields):
         line = ""
-        for i, field in enumerate(fields):
-            line += str(field)
-            line = line[:positions[i]]
-            line += " " * (positions[i] - len(line))
+        for text, stop in zip(fields, cols):
+            line = (line + str(text))[:stop].ljust(stop)
         print(line)
 
-    print("_" * line_length)
-    print_row(to_display, positions)
     print("=" * line_length)
+    emit(header)
+    print("=" * line_length)
+    total = 0
+    rows = _graph_info(symbol, shape)
+    for node, out, params, inputs in rows:
+        total += params
+        shape_txt = "x".join(str(d) for d in out[1:]) if out else ""
+        emit([f"{node.name} ({node.op})", shape_txt, params,
+              inputs[0] if inputs else ""])
+        for extra in inputs[1:]:
+            emit(["", "", "", extra])
+        print("-" * line_length)
+    print(f"Total params: {total}")
+    print("=" * line_length)
+    return total
 
-    total_params = [0]
 
-    def print_layer_summary(node, out_shape):
-        op = node["op"]
-        pre_node = []
-        pre_filter = 0
-        if op != "null":
-            inputs = node["inputs"]
-            for item in inputs:
-                input_node = nodes[item[0]]
-                input_name = input_node["name"]
-                if input_node["op"] != "null" or item[0] in heads:
-                    pre_node.append(input_name)
-                    if show_shape:
-                        key = input_name + "_output" \
-                            if input_node["op"] != "null" else input_name
-                        if key in shape_dict:
-                            pre_filter = pre_filter + int(
-                                shape_dict[key][1]
-                                if len(shape_dict[key]) > 1 else 0)
-        cur_param = 0
-        attrs = node.get("attrs", {})
-        if op == "Convolution":
-            num_filter = int(attrs["num_filter"])
-            import ast
-            kernel = ast.literal_eval(attrs["kernel"])
-            num_group = int(attrs.get("num_group", "1"))
-            cur_param = pre_filter * num_filter // num_group
-            for k in kernel:
-                cur_param *= k
-            cur_param += num_filter
-        elif op == "FullyConnected":
-            if attrs.get("no_bias", "False") == "True":
-                cur_param = pre_filter * int(attrs["num_hidden"])
-            else:
-                cur_param = (pre_filter + 1) * int(attrs["num_hidden"])
-        elif op == "BatchNorm":
-            key = node["name"] + "_output"
-            if show_shape and key in shape_dict:
-                cur_param = int(shape_dict[key][1]) * 4
-        first_connection = "" if not pre_node else pre_node[0]
-        fields = [f"{node['name']}({op})",
-                  "x".join([str(x) for x in out_shape]),
-                  cur_param, first_connection]
-        print_row(fields, positions)
-        if len(pre_node) > 1:
-            for i in range(1, len(pre_node)):
-                fields = ["", "", "", pre_node[i]]
-                print_row(fields, positions)
-        return cur_param
+_FILL = {
+    "input": "#8dd3c7", "compute": "#fb8072", "act": "#ffffb3",
+    "norm": "#bebada", "pool": "#80b1d3", "shape": "#fdb462",
+    "loss": "#b3de69", "other": "#fccde5",
+}
 
-    heads = set(conf["arg_nodes"])
-    for i, node in enumerate(nodes):
-        out_shape = []
-        op = node["op"]
-        if op == "null" and i > 0:
-            continue
-        if op != "null" or i in heads:
-            if show_shape:
-                key = node["name"] + "_output" if op != "null" \
-                    else node["name"]
-                if key in shape_dict:
-                    out_shape = shape_dict[key][1:]
-        total_params[0] += print_layer_summary(node, out_shape)
-        if i == len(nodes) - 1:
-            print("=" * line_length)
-        else:
-            print("_" * line_length)
-    print(f"Total params: {total_params[0]}")
-    print("_" * line_length)
+
+def _node_style(node):
+    op = node.op
+    attrs = node.attrs
+    if op == "Convolution":
+        k = "x".join(str(v) for v in attrs.get("kernel", ()))
+        return f"Convolution {k}\nfilters={attrs.get('num_filter')}", \
+            _FILL["compute"]
+    if op == "FullyConnected":
+        return f"FullyConnected\n{attrs.get('num_hidden')}", \
+            _FILL["compute"]
+    if op == "Pooling":
+        k = "x".join(str(v) for v in attrs.get("kernel", ()))
+        return f"Pooling {attrs.get('pool_type', 'max')}\n{k}", \
+            _FILL["pool"]
+    if op in ("Activation", "LeakyReLU", "SoftmaxActivation"):
+        return f"{op}\n{attrs.get('act_type', '')}", _FILL["act"]
+    if op in ("BatchNorm", "InstanceNorm", "L2Normalization", "LRN"):
+        return op, _FILL["norm"]
+    if op in ("Concat", "Flatten", "Reshape", "SliceChannel", "transpose"):
+        return op, _FILL["shape"]
+    if node.opdef().is_loss:
+        return op, _FILL["loss"]
+    return op, _FILL["other"]
 
 
 def plot_network(symbol, title="plot", save_format="pdf", shape=None,
                  node_attrs=None, hide_weights=True):
-    """Graphviz rendering. reference: visualization.py:150. Gated on the
-    graphviz package being available."""
+    """Graphviz diagram of the symbol graph. reference surface:
+    visualization.py plot_network (requires the graphviz package)."""
     try:
         from graphviz import Digraph
     except ImportError:
-        raise ImportError("Draw network requires graphviz library")
+        raise ImportError("plot_network requires the graphviz package")
     if not isinstance(symbol, Symbol):
         raise TypeError("symbol must be a Symbol")
-    node_attrs = node_attrs or {}
-    draw_shape = False
-    shape_dict = {}
-    if shape is not None:
-        draw_shape = True
-        interals = symbol.get_internals()
-        _, out_shapes, _ = interals.infer_shape(**shape)
-        if out_shapes is None:
-            raise ValueError("Input shape is incomplete")
-        shape_dict = dict(zip(interals.list_outputs(), out_shapes))
-    conf = json.loads(symbol.tojson())
-    nodes = conf["nodes"]
-    node_attr = {"shape": "box", "fixedsize": "true", "width": "1.3",
-                 "height": "0.8034", "style": "filled"}
-    node_attr.update(node_attrs)
+    rows = _graph_info(symbol, shape)
+    edge_shape = {node.name: out for node, out, _, _ in rows}  # vars incl.
     dot = Digraph(name=title, format=save_format)
-    cm = ("#8dd3c7", "#fb8072", "#ffffb3", "#bebada", "#80b1d3", "#fdb462",
-          "#b3de69", "#fccde5")
+    base = {"shape": "box", "style": "filled", "fixedsize": "false"}
+    base.update(node_attrs or {})
 
-    hidden_nodes = set()
-    for node in nodes:
-        op = node["op"]
-        name = node["name"]
-        attrs = {"shape": "box", "fixedsize": "false", "style": "filled"}
-        label = name
-        if op == "null":
-            if name.endswith("weight") or name.endswith("bias") or \
-                    name.endswith("gamma") or name.endswith("beta") or \
-                    name.endswith("moving_mean") or \
-                    name.endswith("moving_var"):
-                if hide_weights:
-                    hidden_nodes.add(name)
+    shown = set()
+    for node in symbol._topo_nodes():
+        if node.is_variable:
+            if hide_weights and _is_param(node.name):
                 continue
-            attrs["fillcolor"] = cm[0]
-        elif op == "Convolution":
-            import ast
-            a = node.get("attrs", {})
-            label = "Convolution\n{kernel}/{stride}, {filter}".format(
-                kernel="x".join(map(str, ast.literal_eval(a["kernel"]))),
-                stride="x".join(map(str, ast.literal_eval(
-                    a.get("stride", "(1,1)")))),
-                filter=a["num_filter"])
-            attrs["fillcolor"] = cm[1]
-        elif op == "FullyConnected":
-            label = f"FullyConnected\n{node['attrs']['num_hidden']}"
-            attrs["fillcolor"] = cm[1]
-        elif op == "BatchNorm":
-            attrs["fillcolor"] = cm[3]
-        elif op == "Activation" or op == "LeakyReLU":
-            label = f"{op}\n{node.get('attrs', {}).get('act_type', '')}"
-            attrs["fillcolor"] = cm[2]
-        elif op == "Pooling":
-            import ast
-            a = node.get("attrs", {})
-            label = "Pooling\n{pooltype}, {kernel}/{stride}".format(
-                pooltype=a.get("pool_type", "max"),
-                kernel="x".join(map(str, ast.literal_eval(
-                    a.get("kernel", "(1,1)")))),
-                stride="x".join(map(str, ast.literal_eval(
-                    a.get("stride", "(1,1)")))))
-            attrs["fillcolor"] = cm[4]
-        elif op in ("Concat", "Flatten", "Reshape"):
-            attrs["fillcolor"] = cm[5]
-        elif op == "Softmax" or op == "SoftmaxOutput":
-            attrs["fillcolor"] = cm[6]
-        else:
-            attrs["fillcolor"] = cm[7]
-        dot.node(name=name, label=label, **attrs)
-
-    for node in nodes:
-        op = node["op"]
-        name = node["name"]
-        if op == "null":
+            dot.node(node.name, label=node.name,
+                     fillcolor=_FILL["input"], **base)
+            shown.add(node.name)
             continue
-        inputs = node["inputs"]
-        for item in inputs:
-            input_node = nodes[item[0]]
-            input_name = input_node["name"]
-            if input_name not in hidden_nodes:
-                attrs = {"dir": "back", "arrowtail": "open"}
-                if draw_shape:
-                    key = input_name + "_output" \
-                        if input_node["op"] != "null" else input_name
-                    if key in shape_dict:
-                        shape_ = shape_dict[key]
-                        label = "x".join([str(x) for x in shape_[1:]])
-                        attrs["label"] = label
-                dot.edge(tail_name=name, head_name=input_name, **attrs)
+        label, fill = _node_style(node)
+        dot.node(node.name, label=f"{node.name}\n{label}"
+                 if "\n" not in label else label, fillcolor=fill, **base)
+        shown.add(node.name)
+
+    for node in symbol._topo_nodes():
+        if node.is_variable:
+            continue
+        for inp, _ in node.inputs:
+            if inp.name not in shown:
+                continue
+            attrs = {"dir": "back", "arrowtail": "open"}
+            out = edge_shape.get(inp.name)
+            if out:
+                attrs["label"] = "x".join(str(d) for d in out[1:])
+            dot.edge(tail_name=node.name, head_name=inp.name, **attrs)
     return dot

@@ -85,6 +85,16 @@ def test_checkpoint_resume_continues_training():
                            "--num-embed", "32", "--num-layers", "1"]),
     ("dcgan.py", ["--num-epochs", "1", "--batches-per-epoch", "4",
                   "--batch-size", "8"]),
+    ("train_mnist.py", ["--num-epochs", "1", "--batch-size", "32",
+                        "--network", "mlp"]),
+    ("train_cifar10.py", ["--num-epochs", "1", "--batch-size", "16",
+                          "--num-layers", "20", "--num-classes", "4"]),
+    ("train_imagenet.py", ["--num-epochs", "1", "--batch-size", "8",
+                           "--num-layers", "18", "--num-classes", "4",
+                           "--num-examples", "32"]),
+    ("ssd/train.py", ["--epochs", "1", "--batch-size", "8",
+                      "--num-images", "16", "--width", "8",
+                      "--data-size", "64"]),
 ])
 def test_example_scripts_smoke(script, args):
     """Every shipped example must run end-to-end (tiny settings)."""

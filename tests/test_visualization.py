@@ -1,0 +1,56 @@
+"""Visualization (reference: tests/python/unittest/test_viz.py +
+print_summary contract)."""
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def _small_net():
+    data = mx.sym.var("data")
+    c = mx.sym.Convolution(data, kernel=(3, 3), num_filter=4, name="c1")
+    a = mx.sym.Activation(c, act_type="relu", name="a1")
+    b = mx.sym.BatchNorm(a, name="bn1")
+    f = mx.sym.Flatten(b, name="fl")
+    fc = mx.sym.FullyConnected(f, num_hidden=5, name="fc1")
+    return mx.sym.SoftmaxOutput(fc, name="softmax")
+
+
+def test_print_summary_param_count_matches_executor(capsys):
+    sym = _small_net()
+    total = mx.viz.print_summary(sym, shape={"data": (2, 3, 8, 8)})
+    out = capsys.readouterr().out
+    assert "data" in out.splitlines()[3]   # input row leads the table
+    assert "c1 (Convolution)" in out
+    assert "fc1 (FullyConnected)" in out
+    assert f"Total params: {total}" in out
+    # ground truth: sum of learnable arg + aux element counts when bound
+    exe = sym.simple_bind(ctx=mx.cpu(), data=(2, 3, 8, 8))
+    expected = sum(int(np.prod(a.shape)) for nm, a in exe.arg_dict.items()
+                   if nm not in ("data", "softmax_label"))
+    expected += sum(int(np.prod(a.shape)) for a in exe.aux_dict.values())
+    assert total == expected, (total, expected)
+
+
+def test_print_summary_without_shapes():
+    total = mx.viz.print_summary(_small_net())
+    assert total == 0          # no shapes -> no param counting
+
+
+def test_plot_network_gated_or_renders():
+    sym = _small_net()
+    try:
+        import graphviz  # noqa: F401
+        have = True
+    except ImportError:
+        have = False
+    if not have:
+        import pytest
+        with pytest.raises(ImportError):
+            mx.viz.plot_network(sym)
+    else:
+        dot = mx.viz.plot_network(sym, shape={"data": (2, 3, 8, 8)})
+        src = dot.source
+        assert "c1" in src and "fc1" in src
+        assert "c1_weight" not in src      # hidden by default
+        dot2 = mx.viz.plot_network(sym, hide_weights=False)
+        assert "c1_weight" in dot2.source
