@@ -14,8 +14,9 @@ from .symbol import Symbol
 __all__ = ["print_summary", "plot_network"]
 
 def _is_param(name):
-    return name.rsplit("_", 1)[-1] in ("weight", "bias", "gamma", "beta",
-                                       "mean", "var")
+    return name.rsplit("_", 1)[-1] in ("weight", "bias", "gamma",
+                                       "beta") or \
+        name.endswith(("moving_mean", "moving_var"))
 
 
 def _graph_info(symbol, shape):
@@ -62,7 +63,12 @@ def print_summary(symbol, shape=None, line_length=98,
                   positions=(0.42, 0.66, 0.80, 1.0)):
     """Layer table: name(op) / output shape / #params / feeds-from.
     reference surface: visualization.py print_summary."""
-    cols = [int(line_length * p) for p in positions]
+    # fractional positions scale with line_length; absolute column stops
+    # (reference calling convention) pass through unchanged
+    if positions[-1] <= 1:
+        cols = [int(line_length * p) for p in positions]
+    else:
+        cols = [int(p) for p in positions]
     header = ["Layer (type)", "Output Shape", "Param #", "Previous Layer"]
 
     def emit(fields):
@@ -136,19 +142,24 @@ def plot_network(symbol, title="plot", save_format="pdf", shape=None,
     dot = Digraph(name=title, format=save_format)
     base = {"shape": "box", "style": "filled", "fixedsize": "false"}
     base.update(node_attrs or {})
+    # user node_attrs win over per-op styling (fillcolor/label included)
+    fill_override = base.pop("fillcolor", None)
+    label_override = base.pop("label", None)
 
     shown = set()
     for node in symbol._topo_nodes():
         if node.is_variable:
             if hide_weights and _is_param(node.name):
                 continue
-            dot.node(node.name, label=node.name,
-                     fillcolor=_FILL["input"], **base)
+            dot.node(node.name, label=label_override or node.name,
+                     fillcolor=fill_override or _FILL["input"], **base)
             shown.add(node.name)
             continue
         label, fill = _node_style(node)
-        dot.node(node.name, label=f"{node.name}\n{label}"
-                 if "\n" not in label else label, fillcolor=fill, **base)
+        if "\n" not in label:
+            label = f"{node.name}\n{label}"
+        dot.node(node.name, label=label_override or label,
+                 fillcolor=fill_override or fill, **base)
         shown.add(node.name)
 
     for node in symbol._topo_nodes():

@@ -1,0 +1,115 @@
+"""Tooling: parse_log, bandwidth, kill_mxnet, bi-lstm-sort.
+
+reference: tools/parse_log.py (nightly gate consumer, test_all.sh:42-55),
+tools/bandwidth/, tools/kill-mxnet.py, example/bi-lstm-sort/.
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOLS = os.path.join(ROOT, "tools")
+
+SAMPLE_LOG = """\
+INFO:root:Epoch[0] Batch[20] speed=100.00 samples/s train: accuracy=0.5
+INFO:root:Epoch[0] Train-accuracy=0.612000
+INFO:root:Epoch[0] Time cost=10.500
+INFO:root:Epoch[0] Validation-accuracy=0.650000
+INFO:root:Epoch[1] Batch[20] speed=140.00 samples/s train: accuracy=0.8
+INFO:root:Epoch[1] Train-accuracy=0.890000
+INFO:root:Epoch[1] Time cost=9.100
+INFO:root:Epoch[1] Validation-accuracy=0.915000
+"""
+
+
+def test_parse_log_table_and_gate(tmp_path):
+    log = tmp_path / "train.log"
+    log.write_text(SAMPLE_LOG)
+    cli = os.path.join(TOOLS, "parse_log.py")
+    r = subprocess.run([sys.executable, cli, str(log), "--format", "csv"],
+                       capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    rows = r.stdout.strip().splitlines()
+    assert rows[0].startswith("epoch,")
+    assert "0.890000" in rows[2] and "0.915000" in rows[2]
+    assert ",9.1," in rows[2] and "140.0" in rows[2]
+    # gate passes at 0.9, fails at 0.92
+    ok = subprocess.run([sys.executable, cli, str(log),
+                         "--check-val", "accuracy:0.9"],
+                        capture_output=True, text=True)
+    assert ok.returncode == 0, ok.stderr
+    bad = subprocess.run([sys.executable, cli, str(log),
+                          "--check-val", "accuracy:0.92"],
+                         capture_output=True, text=True)
+    assert bad.returncode == 1
+
+
+def test_bandwidth_tool_local():
+    r = subprocess.run(
+        [sys.executable, os.path.join(TOOLS, "bandwidth.py"),
+         "--size-mb", "4", "--num-keys", "4", "--repeat", "3", "--cpu"],
+        capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stderr[-1500:]
+    out = json.loads(r.stdout.strip().splitlines()[-1])
+    assert out["metric"] == "kvstore_push_pull_bandwidth"
+    assert out["gb_per_sec"] > 0
+    assert out["num_workers"] == 1
+
+
+def test_bandwidth_tool_dist_sync_2proc():
+    env = dict(os.environ)
+    env.pop("DMLC_NUM_WORKER", None)
+    env.pop("DMLC_WORKER_ID", None)
+    r = subprocess.run(
+        [sys.executable, os.path.join(TOOLS, "launch.py"), "-n", "2",
+         sys.executable, os.path.join(TOOLS, "bandwidth.py"),
+         "--kv-store", "dist_sync", "--size-mb", "2", "--num-keys", "4",
+         "--repeat", "2"],
+        capture_output=True, text=True, timeout=600, env=env, cwd=ROOT)
+    assert r.returncode == 0, (r.stdout[-1500:], r.stderr[-1500:])
+    rows = [json.loads(ln) for ln in r.stdout.splitlines()
+            if ln.startswith("{")]
+    assert len(rows) == 2
+    assert all(row["num_workers"] == 2 for row in rows)
+    assert all(row["gb_per_sec"] > 0 for row in rows)
+
+
+def test_kill_mxnet_terminates_workers():
+    env = dict(os.environ)
+    env["DMLC_ROLE"] = "worker"
+    victim = subprocess.Popen([sys.executable, "-c",
+                               "import time; time.sleep(300)"], env=env)
+    try:
+        time.sleep(0.3)
+        r = subprocess.run(
+            [sys.executable, os.path.join(TOOLS, "kill_mxnet.py")],
+            capture_output=True, text=True, timeout=60)
+        assert r.returncode == 0, r.stderr
+        deadline = time.time() + 5
+        while victim.poll() is None and time.time() < deadline:
+            time.sleep(0.1)
+        assert victim.poll() is not None, "worker not terminated"
+        assert victim.returncode == -signal.SIGTERM
+    finally:
+        if victim.poll() is None:
+            victim.kill()
+
+
+def test_bi_lstm_sort_learns():
+    sys.path.insert(0, os.path.join(ROOT, "examples"))
+    import bi_lstm_sort
+    train = bi_lstm_sort.make_batches(1280, 8, 8, 32)
+    val = bi_lstm_sort.make_batches(256, 8, 8, 32, seed=9)
+    import mxnet_tpu as mx
+    net = bi_lstm_sort.build_symbol(8, 8, 48, 24)
+    mod = mx.mod.Module(net, context=mx.cpu(),
+                        label_names=("softmax_label",))
+    mod.fit(train, num_epoch=5, initializer=mx.initializer.Xavier(),
+            optimizer="adam", optimizer_params={"learning_rate": 0.01})
+    acc = mod.score(val, "acc")[0][1]
+    assert acc > 0.8, f"bi-lstm sort failed to learn: {acc}"

@@ -95,6 +95,8 @@ def test_checkpoint_resume_continues_training():
     ("ssd/train.py", ["--epochs", "1", "--batch-size", "8",
                       "--num-images", "16", "--width", "8",
                       "--data-size", "64"]),
+    ("bi_lstm_sort.py", ["--num-epochs", "1", "--num-train", "256",
+                         "--seq-len", "6", "--num-hidden", "24"]),
 ])
 def test_example_scripts_smoke(script, args):
     """Every shipped example must run end-to-end (tiny settings)."""

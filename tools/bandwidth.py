@@ -1,0 +1,70 @@
+#!/usr/bin/env python
+"""Measure KVStore push/pull bandwidth (reference: tools/bandwidth/ —
+"measures the communication bandwidth per batch", docs perf.md:197-199).
+
+Simulates one Module.update round: push a gradient set, pull the weights
+back, repeat; reports effective GB/s over the payload. Works for local
+stores and, under tools/launch.py, for dist_sync (where push is the
+bucketed all-reduce over the coordination runtime).
+
+    python tools/bandwidth.py --size-mb 64 --num-keys 16 --repeat 10
+    python tools/launch.py -n 4 python tools/bandwidth.py --kv-store dist_sync
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--kv-store", default="local")
+    p.add_argument("--size-mb", type=float, default=64.0,
+                   help="total payload per round")
+    p.add_argument("--num-keys", type=int, default=16)
+    p.add_argument("--repeat", type=int, default=10)
+    p.add_argument("--cpu", action="store_true",
+                   help="force the CPU backend (for launch.py runs)")
+    args = p.parse_args()
+    if args.cpu or int(os.environ.get("DMLC_NUM_WORKER", "1")) > 1:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import mxnet_tpu as mx
+
+    kv = mx.kv.create(args.kv_store)
+    n_per_key = max(int(args.size_mb * (1 << 20) / 4 / args.num_keys), 1)
+    keys = list(range(args.num_keys))
+    vals = [mx.nd.ones((n_per_key,)) for _ in keys]
+    outs = [mx.nd.empty((n_per_key,)) for _ in keys]
+    kv.init(keys, vals)
+    kv.push(keys, vals)            # warm (compile collectives)
+    kv.pull(keys, out=outs)
+    payload = args.num_keys * n_per_key * 4 / (1 << 30)
+
+    tic = time.perf_counter()
+    for _ in range(args.repeat):
+        kv.push(keys, vals)
+        kv.pull(keys, out=outs)
+    float(np.asarray(outs[0].asnumpy()).ravel()[0])   # force completion
+    toc = time.perf_counter()
+    per_round = (toc - tic) / args.repeat
+    print(json.dumps({
+        "metric": "kvstore_push_pull_bandwidth",
+        "kv_store": kv.type,
+        "rank": kv.rank,
+        "num_workers": kv.num_workers,
+        "payload_gb": round(payload, 4),
+        "seconds_per_round": round(per_round, 4),
+        "gb_per_sec": round(2 * payload / per_round, 3),   # push + pull
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,55 @@
+#!/usr/bin/env python
+"""Kill leftover distributed-job processes (reference: tools/kill-mxnet.py
+— cleans up worker remnants after a crashed launch).
+
+Finds processes whose environment carries the launcher's DMLC_* contract
+(or whose command line matches the given script) and terminates them.
+
+    python tools/kill_mxnet.py                 # kill all DMLC workers
+    python tools/kill_mxnet.py train.py        # only workers running this
+"""
+from __future__ import annotations
+
+import os
+import signal
+import sys
+
+
+def _iter_procs():
+    for pid in os.listdir("/proc"):
+        if not pid.isdigit():
+            continue
+        try:
+            with open(f"/proc/{pid}/environ", "rb") as f:
+                env = f.read()
+            with open(f"/proc/{pid}/cmdline", "rb") as f:
+                cmd = f.read().replace(b"\0", b" ").decode(
+                    "utf-8", "replace")
+        except (FileNotFoundError, PermissionError, ProcessLookupError):
+            continue
+        yield int(pid), env, cmd
+
+
+def main():
+    pattern = sys.argv[1] if len(sys.argv) > 1 else None
+    me = os.getpid()
+    killed = []
+    for pid, env, cmd in _iter_procs():
+        if pid == me:
+            continue
+        if b"DMLC_ROLE=worker" not in env:
+            continue
+        if pattern and pattern not in cmd:
+            continue
+        try:
+            os.kill(pid, signal.SIGTERM)
+            killed.append((pid, cmd.strip()))
+        except ProcessLookupError:
+            pass
+    for pid, cmd in killed:
+        print(f"killed {pid}: {cmd[:100]}")
+    print(f"{len(killed)} process(es) terminated")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,117 @@
+#!/usr/bin/env python
+"""Extract per-epoch metrics and throughput from training logs.
+
+Reference counterpart: tools/parse_log.py, which the nightly accuracy
+gates consume (reference: tests/nightly/test_all.sh:42-55 check_val).
+Parses this framework's fit log lines:
+
+    Epoch[3] Train-accuracy=0.913000
+    Epoch[3] Time cost=12.345
+    Epoch[3] Validation-accuracy=0.887000
+    Epoch[3] Batch[40] speed=1234.56 samples/s ...
+
+Usage:
+    python tools/parse_log.py train.log [--format markdown|csv]
+    python tools/parse_log.py train.log --check-val accuracy:0.85
+        (exit 1 if the final validation metric is below the threshold —
+         the nightly gating mode)
+"""
+from __future__ import annotations
+
+import argparse
+import re
+import sys
+from collections import defaultdict
+
+EPOCH_METRIC = re.compile(
+    r"Epoch\[(\d+)\]\s+(Train|Validation)-([\w-]+)=([0-9.eE+-]+)")
+EPOCH_TIME = re.compile(r"Epoch\[(\d+)\]\s+Time cost=([0-9.eE+-]+)")
+BATCH_SPEED = re.compile(
+    r"Epoch\[(\d+)\]\s+Batch\[\d+\]\s+speed=([0-9.eE+-]+)")
+
+
+def parse(lines):
+    """-> {epoch: {"train": {m: v}, "val": {m: v}, "time": s,
+                   "speed": mean samples/s}}"""
+    out = defaultdict(lambda: {"train": {}, "val": {},
+                               "time": None, "_speeds": []})
+    for line in lines:
+        m = EPOCH_METRIC.search(line)
+        if m:
+            epoch, which, name, val = m.groups()
+            key = "train" if which == "Train" else "val"
+            out[int(epoch)][key][name] = float(val)
+            continue
+        m = EPOCH_TIME.search(line)
+        if m:
+            out[int(m.group(1))]["time"] = float(m.group(2))
+            continue
+        m = BATCH_SPEED.search(line)
+        if m:
+            out[int(m.group(1))]["_speeds"].append(float(m.group(2)))
+    for rec in out.values():
+        sp = rec.pop("_speeds")
+        rec["speed"] = sum(sp) / len(sp) if sp else None
+    return dict(out)
+
+
+def render(table, fmt="markdown"):
+    metrics = sorted({m for rec in table.values()
+                      for m in list(rec["train"]) + list(rec["val"])})
+    cols = ["epoch"] + [f"train-{m}" for m in metrics] + \
+        [f"val-{m}" for m in metrics] + ["time(s)", "samples/s"]
+    rows = []
+    for epoch in sorted(table):
+        rec = table[epoch]
+        row = [str(epoch)]
+        row += [f"{rec['train'].get(m, ''):.6f}"
+                if m in rec["train"] else "" for m in metrics]
+        row += [f"{rec['val'].get(m, ''):.6f}"
+                if m in rec["val"] else "" for m in metrics]
+        row.append(f"{rec['time']:.1f}" if rec["time"] is not None else "")
+        row.append(f"{rec['speed']:.1f}" if rec["speed"] is not None else "")
+        rows.append(row)
+    if fmt == "csv":
+        return "\n".join(",".join(r) for r in [cols] + rows)
+    widths = [max(len(c), *(len(r[i]) for r in rows)) if rows else len(c)
+              for i, c in enumerate(cols)]
+    lines = [" | ".join(c.ljust(w) for c, w in zip(cols, widths)),
+             "-|-".join("-" * w for w in widths)]
+    lines += [" | ".join(c.ljust(w) for c, w in zip(r, widths))
+              for r in rows]
+    return "\n".join(lines)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("logfile")
+    p.add_argument("--format", choices=("markdown", "csv"),
+                   default="markdown")
+    p.add_argument("--check-val", metavar="METRIC:THRESHOLD",
+                   help="exit nonzero unless the last epoch's validation "
+                        "METRIC >= THRESHOLD (nightly gate mode)")
+    args = p.parse_args()
+    with open(args.logfile) as f:
+        table = parse(f)
+    if not table:
+        print("no epochs found", file=sys.stderr)
+        return 2
+    print(render(table, args.format))
+    if args.check_val:
+        name, thresh = args.check_val.split(":")
+        last = table[max(table)]
+        val = last["val"].get(name)
+        if val is None:
+            print(f"check-val: no validation metric {name!r}",
+                  file=sys.stderr)
+            return 2
+        if val < float(thresh):
+            print(f"check-val FAILED: {name}={val} < {thresh}",
+                  file=sys.stderr)
+            return 1
+        print(f"check-val ok: {name}={val} >= {thresh}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
