@@ -182,18 +182,33 @@ def backward(outputs, out_grads=None, retain_graph=False):
             "no imperative ops were recorded — run the computation inside "
             "a train_section() with variables marked first")
 
-    tape = list(_TAPE)
-    # Leaves are only the marked variables this tape actually consumed —
-    # computing grads for every variable ever marked would clobber the
-    # grad buffers of unrelated models with zeros (the reference scopes
-    # its tape per recording session, autograd.cc:54-68).
+    out_ids_req = [id(o) for o in outputs]
+    # prune the tape to the sub-graph feeding the requested outputs:
+    # entries on unrelated branches are neither replayed nor
+    # differentiated (the reference builds its backward graph only from
+    # the requested heads, autograd.cc:132-188)
+    needed = set(out_ids_req)
+    kept = []
+    for e in reversed(_TAPE):
+        if any(o in needed for o in e.out_ids):
+            kept.append(e)
+            needed.update(e.in_ids)
+    tape = list(reversed(kept))
+    if not tape:
+        raise MXNetError(
+            "backward() got outputs that were not produced by recorded "
+            "ops in this train_section")
+    # Leaves are only the marked variables this (pruned) tape actually
+    # consumed — computing grads for every variable ever marked would
+    # clobber the grad buffers of unrelated models with zeros (the
+    # reference scopes its tape per recording session, autograd.cc:54-68).
     used = set()
     for e in tape:
         used.update(e.in_ids)
     leaves = {vid: var.asjax() for vid, (var, _, _) in _MARKED.items()
               if vid in used}
     leaf_ids = list(leaves)
-    out_ids = [id(o) for o in outputs]
+    out_ids = out_ids_req
 
     def replay(leaf_vals):
         env = dict(zip(leaf_ids, leaf_vals))

@@ -303,3 +303,129 @@ def _register_builtin():
 
 
 _register_builtin()
+
+
+# --------------------------------------------------------------------------
+# built-in: flash attention (the framework's marquee Pallas kernel — the
+# reference's attention-era gap filled TPU-first). Forward is a Pallas
+# online-softmax kernel: grid over (batch*heads, query blocks), K/V
+# streamed through VMEM block by block inside the kernel; backward
+# recomputes attention via the XLA composition under jax.custom_vjp
+# (flash recompute strategy — no T x T tensor is ever stored for fwd).
+# --------------------------------------------------------------------------
+def _flash_kernel(block_q, block_k, causal, scale):
+    def kernel(q_ref, k_ref, v_ref, o_ref):
+        # q_ref: (block_q, D); k_ref/v_ref: (T, D); o_ref: (block_q, D)
+        q = q_ref[...].astype(jnp.float32) * scale
+        T = k_ref.shape[0]
+        D = q_ref.shape[1]
+        qi = pl.program_id(1)
+        m = jnp.full((block_q,), -jnp.inf, jnp.float32)
+        l = jnp.zeros((block_q,), jnp.float32)
+        acc = jnp.zeros((block_q, D), jnp.float32)
+
+        def body(kb, carry):
+            m, l, acc = carry
+            k = k_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+            v = v_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+            # HIGHEST: match the XLA composition's f32 accumulation (the
+            # default would multiply in bf16 on the MXU)
+            s = jnp.dot(q, k.T, precision=jax.lax.Precision.HIGHEST)
+            if causal:
+                q_pos = qi * block_q + \
+                    jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k),
+                                             0)
+                k_pos = kb * block_k + \
+                    jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k),
+                                             1)
+                s = jnp.where(q_pos >= k_pos, s, -jnp.inf)
+            m_blk = jnp.max(s, axis=-1)
+            m_new = jnp.maximum(m, m_blk)
+            m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+            p = jnp.exp(s - m_safe[:, None])
+            p = jnp.where(jnp.isfinite(s), p, 0.0)
+            corr = jnp.where(jnp.isfinite(m), jnp.exp(m - m_safe), 0.0)
+            l_new = l * corr + jnp.sum(p, axis=-1)
+            acc_new = acc * corr[:, None] + jnp.dot(
+                p, v, precision=jax.lax.Precision.HIGHEST)
+            return m_new, l_new, acc_new
+
+        n_kb = T // block_k
+        if causal:
+            # K blocks strictly after this query block's last row are
+            # fully masked — skip them instead of exp(-inf)-ing them
+            last_q = (qi + 1) * block_q - 1
+            n_kb = jnp.minimum(n_kb, last_q // block_k + 1)
+        m, l, acc = jax.lax.fori_loop(0, n_kb, body, (m, l, acc))
+        o_ref[...] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(
+            o_ref.dtype)
+    return kernel
+
+
+def flash_attention(q, k, v, causal=False, block_q=128, block_k=128):
+    """Pallas flash attention. q/k/v: (B, H, T, D) -> (B, H, T, D).
+
+    Differentiable: backward recomputes standard attention (XLA) under
+    custom_vjp, so training numerics match ``parallel.ring_attention
+    .attention`` while forward never materializes the (T, T) matrix.
+    """
+    from .parallel.ring_attention import attention as _xla_attention
+
+    B, H, T, D = q.shape
+    block_q = min(block_q, T)
+    block_k = min(block_k, T)
+    if T % block_q or T % block_k:
+        raise MXNetError(f"flash_attention: T={T} must be a multiple of "
+                         f"block sizes ({block_q}, {block_k})")
+    scale = 1.0 / float(np.sqrt(D))
+
+    @jax.custom_vjp
+    def _flash(q, k, v):
+        qf = q.reshape(B * H, T, D)
+        kf = k.reshape(B * H, T, D)
+        vf = v.reshape(B * H, T, D)
+        out = pallas_call(
+            _flash_kernel(block_q, block_k, causal, scale),
+            out_shape=jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
+            grid=(B * H, T // block_q),
+            in_specs=[pl.BlockSpec((None, block_q, D),
+                                   lambda b, i: (b, i, 0)),
+                      pl.BlockSpec((None, T, D), lambda b, i: (b, 0, 0)),
+                      pl.BlockSpec((None, T, D), lambda b, i: (b, 0, 0))],
+            out_specs=pl.BlockSpec((None, block_q, D),
+                                   lambda b, i: (b, i, 0)))(qf, kf, vf)
+        return out.reshape(B, H, T, D)
+
+    def fwd(q, k, v):
+        return _flash(q, k, v), (q, k, v)
+
+    def bwd(res, ct):
+        q, k, v = res
+        _, vjp_fn = jax.vjp(
+            lambda q, k, v: _xla_attention(q, k, v, causal=causal), q, k, v)
+        return vjp_fn(ct)
+
+    _flash.defvjp(fwd, bwd)
+    return _flash(q, k, v)
+
+
+def _register_flash():
+    if "pallas_flash_attention" in OP_REGISTRY:
+        return
+
+    def forward(attrs, q, k, v):
+        from .base import parse_bool
+        return flash_attention(q, k, v,
+                               causal=parse_bool(attrs.get("causal",
+                                                           False)),
+                               block_q=int(attrs.get("block_q", 128)),
+                               block_k=int(attrs.get("block_k", 128)))
+
+    _register_op("pallas_flash_attention", inputs=("q", "k", "v"),
+                 simple=forward,
+                 attr_spec={"causal": (None, False),
+                            "block_q": (int, 128),
+                            "block_k": (int, 128)})
+
+
+_register_flash()

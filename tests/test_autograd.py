@@ -99,3 +99,35 @@ def test_backward_does_not_clobber_unrelated_marked_grads():
     np.testing.assert_allclose(gb.asnumpy(), [3.0], rtol=1e-6)
     # ga must NOT have been zeroed by the second backward
     np.testing.assert_allclose(ga.asnumpy(), [6.0], rtol=1e-6)
+
+
+def test_backward_prunes_unrelated_branches():
+    """Only the sub-graph feeding the requested outputs replays: ops on
+    unrelated branches are skipped entirely (reference builds the
+    backward graph from the requested heads only, autograd.cc:132-188)."""
+    from mxnet_tpu import autograd as ag
+
+    x = mx.nd.array(np.array([1.0, 2.0], np.float32))
+    z = mx.nd.array(np.array([3.0, 4.0], np.float32))
+    gx = mx.nd.zeros((2,))
+    gz = mx.nd.zeros((2,))
+    ag.mark_variables([x, z], [gx, gz])
+    calls = {"side": 0}
+
+    with ag.train_section():
+        y = x * x                     # wanted branch
+        side_in = z * 2.0             # unrelated branch (its own leaf)
+
+        def side_replay(vals):
+            calls["side"] += 1
+            return [vals[0] * 10.0]
+
+        side_out = mx.nd.empty((2,))
+        ag._record_fn(side_replay, [side_in], [side_in.asjax()],
+                      [side_out])
+        ag.backward(y)
+
+    np.testing.assert_allclose(gx.asnumpy(), [2.0, 4.0])
+    # the unrelated branch was never replayed and its leaf grad untouched
+    assert calls["side"] == 0
+    np.testing.assert_allclose(gz.asnumpy(), [0.0, 0.0])

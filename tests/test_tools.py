@@ -82,12 +82,15 @@ def test_bandwidth_tool_dist_sync_2proc():
 def test_kill_mxnet_terminates_workers():
     env = dict(os.environ)
     env["DMLC_ROLE"] = "worker"
+    marker = f"mx_kill_test_{os.getpid()}"
     victim = subprocess.Popen([sys.executable, "-c",
-                               "import time; time.sleep(300)"], env=env)
+                               f"import time  # {marker}\n"
+                               "time.sleep(300)"], env=env)
     try:
         time.sleep(0.3)
+        # pattern-scoped: never sweep unrelated workers on this machine
         r = subprocess.run(
-            [sys.executable, os.path.join(TOOLS, "kill_mxnet.py")],
+            [sys.executable, os.path.join(TOOLS, "kill_mxnet.py"), marker],
             capture_output=True, text=True, timeout=60)
         assert r.returncode == 0, r.stderr
         deadline = time.time() + 5

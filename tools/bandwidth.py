@@ -43,16 +43,23 @@ def main():
     keys = list(range(args.num_keys))
     vals = [mx.nd.ones((n_per_key,)) for _ in keys]
     outs = [mx.nd.empty((n_per_key,)) for _ in keys]
+    def sync():
+        # force EVERY key's transfer to complete — async dispatch would
+        # otherwise leave keys in flight outside the timed window
+        for o in outs:
+            o.asnumpy()
+
     kv.init(keys, vals)
     kv.push(keys, vals)            # warm (compile collectives)
     kv.pull(keys, out=outs)
+    sync()
     payload = args.num_keys * n_per_key * 4 / (1 << 30)
 
     tic = time.perf_counter()
     for _ in range(args.repeat):
         kv.push(keys, vals)
         kv.pull(keys, out=outs)
-    float(np.asarray(outs[0].asnumpy()).ravel()[0])   # force completion
+    sync()
     toc = time.perf_counter()
     per_round = (toc - tic) / args.repeat
     print(json.dumps({
