@@ -61,9 +61,14 @@ class Context:
 
     # -- JAX mapping ------------------------------------------------------
     def jax_device(self):
-        """Resolve this context to a concrete jax.Device."""
+        """Resolve this context to a concrete jax.Device.
+
+        Multi-process: a Context names a device of THIS process —
+        ``jax.devices()`` would enumerate the whole job's devices and
+        hand other processes' (non-addressable) ones to low ids."""
         if self.device_type in ("cpu", "cpu_pinned"):
-            devs = jax.devices("cpu")
+            devs = [d for d in jax.local_devices()
+                    if d.platform == "cpu"] or jax.devices("cpu")
         else:
             # "gpu" is a compat alias for the accelerator backend: on a TPU
             # machine it resolves to TPU chips so reference scripts using
@@ -84,7 +89,9 @@ class Context:
 
 
 def _accelerator_devices():
-    devs = [d for d in jax.devices() if d.platform not in ("cpu",)]
+    # this process's chips only (multi-process: remote chips are
+    # non-addressable and must not be bind targets)
+    devs = [d for d in jax.local_devices() if d.platform not in ("cpu",)]
     return devs
 
 

@@ -498,7 +498,12 @@ def load_json(json_str):
     jnodes = data["nodes"]
     built = []
     for jn in jnodes:
-        attrs_raw = jn.get("attrs", jn.get("param", {})) or {}
+        # merge the legacy key spellings of pre-NNVM checkpoints: op
+        # params lived in "param" and user attributes in "attr" on the
+        # SAME node (reference: legacy_json_util.cc:178 UpgradeJSON)
+        attrs_raw = {}
+        for key in ("param", "attr", "attrs"):
+            attrs_raw.update(jn.get(key) or {})
         op = jn["op"]
         if op == "null":
             node = Node(None, jn["name"],

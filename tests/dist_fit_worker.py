@@ -1,0 +1,61 @@
+"""Distributed Module.fit worker (the dist_lenet analog, launched N-way).
+
+reference: tests/nightly/dist_lenet.py — data-parallel training across
+processes through the dist_sync kvstore; the gate is that every worker
+ends with bit-identical parameters (the all-reduce + shared updater must
+keep replicas in lockstep) and that training actually learned.
+"""
+import hashlib
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import mxnet_tpu as mx  # noqa: E402
+
+
+def main():
+    kv = mx.kv.create("dist_sync")
+    rank, nworker = kv.rank, kv.num_workers
+
+    # each worker sees its own shard of the planted-signal task
+    rng = np.random.RandomState(100 + rank)
+    n = 256
+    X = rng.rand(n, 16).astype("f")
+    y = (X[:, 3] > 0.5).astype("f")
+    X[:, 0] = y * 3.0
+    it = mx.io.NDArrayIter(X, y, batch_size=32, shuffle=True)
+
+    data = mx.sym.var("data")
+    h = mx.sym.Activation(mx.sym.FullyConnected(data, num_hidden=16,
+                                                name="fc1"),
+                          act_type="relu")
+    net = mx.sym.SoftmaxOutput(mx.sym.FullyConnected(h, num_hidden=2,
+                                                     name="fc2"),
+                               name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.fit(it, num_epoch=4, kvstore=kv,
+            initializer=mx.initializer.Xavier(rnd_type="uniform",
+                                              magnitude=2),
+            optimizer="sgd",
+            optimizer_params={"learning_rate": 0.05, "momentum": 0.9})
+
+    args, _ = mod.get_params()
+    digest = hashlib.sha1()
+    for nm in sorted(args):
+        digest.update(np.ascontiguousarray(
+            np.round(args[nm].asnumpy().astype(np.float64), 5)).tobytes())
+    acc = mod.score(it, "acc")[0][1]
+    print(f"DIST_FIT_OK rank={rank} nworker={nworker} "
+          f"params={digest.hexdigest()[:16]} acc={acc:.3f}", flush=True)
+    assert acc > 0.8, f"rank {rank} failed to learn: {acc}"
+
+
+if __name__ == "__main__":
+    main()

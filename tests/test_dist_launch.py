@@ -14,7 +14,7 @@ import pytest
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _launch(nworkers, timeout=600):
+def _launch(nworkers, script="dist_sync_worker.py", timeout=600):
     env = dict(os.environ)
     env.pop("DMLC_NUM_WORKER", None)  # never inherit stale cluster env
     env.pop("DMLC_WORKER_ID", None)
@@ -24,7 +24,7 @@ def _launch(nworkers, timeout=600):
     proc = subprocess.Popen(
         [sys.executable, os.path.join(ROOT, "tools", "launch.py"),
          "-n", str(nworkers), sys.executable,
-         os.path.join(ROOT, "tests", "dist_sync_worker.py")],
+         os.path.join(ROOT, "tests", script)],
         stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
         env=env, cwd=ROOT, start_new_session=True)
     try:
@@ -49,3 +49,15 @@ def test_dist_sync_invariant_multiprocess(nworkers):
         res.stdout[-2000:], res.stderr[-2000:])
     for rank in range(nworkers):
         assert f"rank={rank} nworker={nworkers}" in res.stdout
+
+
+@pytest.mark.parametrize("nworkers", [2])
+def test_dist_fit_lockstep(nworkers):
+    """Module.fit over dist_sync (the dist_lenet analog): every worker
+    learns AND ends with bit-identical parameters."""
+    res = _launch(nworkers, script="dist_fit_worker.py")
+    assert res.returncode == 0, (res.stdout[-1500:], res.stderr[-1500:])
+    assert res.stdout.count("DIST_FIT_OK") == nworkers, res.stdout[-1500:]
+    digests = {tok for tok in res.stdout.split()
+               if tok.startswith("params=")}
+    assert len(digests) == 1, f"replicas diverged: {digests}"

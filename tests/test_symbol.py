@@ -122,3 +122,59 @@ def test_symbol_zeros_ones():
     z = mx.sym.zeros((2, 3)) + mx.sym.ones((2, 3))
     out = z.bind(mx.cpu(), args={}).forward()
     np.testing.assert_allclose(out[0].asnumpy(), np.ones((2, 3)))
+
+
+def test_load_legacy_json_key_spellings():
+    """Pre-NNVM checkpoints spell node attributes "param"/"attr"
+    (reference: legacy_json_util.cc UpgradeJSON); loading must accept
+    them and produce the same graph as the modern format."""
+    import json
+    legacy = json.dumps({
+        "nodes": [
+            {"op": "null", "name": "data", "inputs": []},
+            {"op": "null", "name": "fc_weight", "inputs": []},
+            {"op": "null", "name": "fc_bias", "inputs": []},
+            {"op": "FullyConnected", "name": "fc",
+             "param": {"num_hidden": "4"},
+             "inputs": [[0, 0, 0], [1, 0, 0], [2, 0, 0]]},
+            {"op": "Activation", "name": "act",
+             "attr": {"act_type": "relu"},
+             "inputs": [[3, 0, 0]]},
+        ],
+        "arg_nodes": [0, 1, 2],
+        "heads": [[4, 0, 0]],
+    })
+    sym = mx.sym.load_json(legacy)
+    assert sym.list_arguments() == ["data", "fc_weight", "fc_bias"]
+    d = np.random.RandomState(0).rand(2, 3).astype("f")
+    w = np.random.RandomState(1).rand(4, 3).astype("f") - 0.5
+    b = np.zeros(4, "f")
+    exe = sym.bind(mx.cpu(), args={"data": mx.nd.array(d),
+                                   "fc_weight": mx.nd.array(w),
+                                   "fc_bias": mx.nd.array(b)},
+                   grad_req="null")
+    exe.forward(is_train=False)
+    np.testing.assert_allclose(exe.outputs[0].asnumpy(),
+                               np.maximum(d @ w.T, 0), rtol=1e-5)
+
+
+def test_load_legacy_json_merges_param_and_attr():
+    """A legacy node can carry op params in "param" AND user attrs in
+    "attr" simultaneously — both must survive the upgrade."""
+    import json
+    legacy = json.dumps({
+        "nodes": [
+            {"op": "null", "name": "data", "inputs": []},
+            {"op": "null", "name": "fc_weight", "inputs": []},
+            {"op": "null", "name": "fc_bias", "inputs": []},
+            {"op": "FullyConnected", "name": "fc",
+             "param": {"num_hidden": "4"},
+             "attr": {"__lr_mult__": "0.1"},
+             "inputs": [[0, 0, 0], [1, 0, 0], [2, 0, 0]]},
+        ],
+        "arg_nodes": [0, 1, 2],
+        "heads": [[3, 0, 0]],
+    })
+    sym = mx.sym.load_json(legacy)
+    _, out_shapes, _ = sym.infer_shape(data=(2, 3))
+    assert tuple(out_shapes[0]) == (2, 4)   # num_hidden survived
