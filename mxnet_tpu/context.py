@@ -67,15 +67,14 @@ class Context:
         ``jax.devices()`` would enumerate the whole job's devices and
         hand other processes' (non-addressable) ones to low ids."""
         if self.device_type in ("cpu", "cpu_pinned"):
-            devs = [d for d in jax.local_devices()
-                    if d.platform == "cpu"] or jax.devices("cpu")
+            devs = _local_cpu_devices()
         else:
             # "gpu" is a compat alias for the accelerator backend: on a TPU
             # machine it resolves to TPU chips so reference scripts using
             # mx.gpu(i) run unchanged.
             devs = _accelerator_devices()
             if not devs:
-                devs = jax.devices("cpu")
+                devs = _local_cpu_devices()
         return devs[min(self.device_id, len(devs) - 1)]
 
     def __enter__(self):
@@ -86,6 +85,16 @@ class Context:
 
     def __exit__(self, *args):
         Context._local.stack.pop()
+
+
+def _local_cpu_devices():
+    """THIS process's CPU devices. ``jax.local_devices()`` with no
+    backend only enumerates the default backend, so on an accelerator
+    machine the cpu devices must be asked for explicitly."""
+    try:
+        return jax.local_devices(backend="cpu")
+    except RuntimeError:
+        return jax.devices("cpu")
 
 
 def _accelerator_devices():

@@ -9,12 +9,14 @@ pins ops and inserts ``_CrossDeviceCopy`` at boundaries
 TPU-native lowering — there is no per-op device pinning in SPMD/XLA;
 the mesh equivalent is *parameter sharding*: the devices named by
 ``group2ctx`` become a 1-D ``model`` mesh axis, every parameter tagged
-with a ctx_group is sharded across that axis along its largest divisible
-dimension, and activations crossing a group boundary get a replication
-constraint (``lax.with_sharding_constraint`` — the compiler inserts the
-all-gather that replaces ``_CrossDeviceCopy``). XLA then partitions one
-program over all the devices, which both distributes the memory the way
-the reference's layer placement did and overlaps the per-group compute.
+with a ctx_group is sharded across that axis along the dimension its
+consumer makes safe (a matmul-like op's weight shards on its OUTPUT dim,
+never a contraction dim), and activations crossing a group boundary get
+a replication constraint (``lax.with_sharding_constraint`` — the
+compiler inserts the all-gather that replaces ``_CrossDeviceCopy``).
+XLA then partitions one program over all the devices, which both
+distributes the memory the way the reference's layer placement did and
+overlaps the per-group compute.
 
 Numerics are unchanged by construction — shardings never alter values —
 which is exactly the reference's contract for moving a model from one
@@ -51,14 +53,32 @@ class ModelParallelPlan:
         return [jax.lax.with_sharding_constraint(a, sh) for a in arrays]
 
 
-def _shard_spec(shape, n_dev, axis_name="model"):
-    """Shard the largest divisible dim over the model axis, else replicate."""
-    dims = sorted(range(len(shape)), key=lambda i: -shape[i])
-    for i in dims:
-        if shape[i] % n_dev == 0 and shape[i] >= n_dev:
-            spec = [None] * len(shape)
-            spec[i] = axis_name
-            return P(*spec)
+# consumer-aware shard axes: the OUTPUT dimension of each matmul-like
+# op's weight — sharding a contraction dim would force a partial-sum
+# collective on every apply (op, input slot) -> axis to shard
+_PREFERRED_AXIS = {
+    ("FullyConnected", "weight"): 0, ("FullyConnected", "bias"): 0,
+    ("Convolution", "weight"): 0, ("Convolution", "bias"): 0,
+    ("Deconvolution", "weight"): 1, ("Deconvolution", "bias"): 0,
+    ("Embedding", "weight"): 1,
+}
+
+
+def _shard_spec(shape, n_dev, consumer=None, axis_name="model"):
+    """Pick the shard axis from how the param is consumed.
+
+    Known matmul-like consumers shard their weight's output dimension;
+    1-D params (per-channel vectors) shard elementwise; anything else is
+    replicated — never guess at a 2-D+ tensor's contraction structure.
+    """
+    axis = _PREFERRED_AXIS.get(consumer) if consumer else None
+    if axis is None and len(shape) == 1:
+        axis = 0
+    if axis is not None and axis < len(shape) and \
+            shape[axis] % n_dev == 0 and shape[axis] >= n_dev:
+        spec = [None] * len(shape)
+        spec[axis] = axis_name
+        return P(*spec)
     return P()
 
 
@@ -86,6 +106,18 @@ def build_plan(symbol, group2ctx, arg_shapes_by_name):
     n_dev = len(devices)
     replicated = NamedSharding(mesh, P())
 
+    # who consumes each tagged param, and through which input slot
+    consumer_of = {}
+    for node in nodes:
+        if node.is_variable:
+            continue
+        in_names = node.opdef().input_names(node.attrs)
+        for (inp, _), slot in zip(node.inputs, in_names):
+            if inp.is_variable and id(inp) not in consumer_of:
+                # slot names may be prefixed per-layer; normalize to the
+                # canonical suffix ("weight"/"bias"/...)
+                consumer_of[id(inp)] = (node.op, slot.rsplit("_", 1)[-1])
+
     param_shardings = {}
     for node in nodes:
         if not node.is_variable or not node._extra.get("ctx_group"):
@@ -94,7 +126,8 @@ def build_plan(symbol, group2ctx, arg_shapes_by_name):
         if shape is None:
             continue
         param_shardings[node.name] = NamedSharding(
-            mesh, _shard_spec(shape, n_dev))
+            mesh, _shard_spec(shape, n_dev,
+                              consumer=consumer_of.get(id(node))))
 
     # cross-group edges: the producer's outputs must be gathered before a
     # different group consumes them (the _CrossDeviceCopy analog)

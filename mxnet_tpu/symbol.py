@@ -512,10 +512,15 @@ def load_json(json_str):
                 node._extra["__is_aux__"] = True
         else:
             opdef = get_op(op)
+            # reserved user attributes ride in _extra, not op attrs —
+            # ctx_group placement tags must survive a JSON round-trip
+            # (tojson serializes _extra into the same dict)
+            reserved = {"ctx_group", "lr_mult", "wd_mult"}
             attrs = opdef.normalize_attrs(
                 {k: str_to_attr(v) for k, v in attrs_raw.items()
-                 if not k.startswith("__")})
-            extra = {k: v for k, v in attrs_raw.items() if k.startswith("__")}
+                 if not k.startswith("__") and k not in reserved})
+            extra = {k: v for k, v in attrs_raw.items()
+                     if k.startswith("__") or k in reserved}
             node = Node(op, jn["name"], attrs, extra=extra)
         node.inputs = [(built[i], oi) for i, oi, *_ in jn["inputs"]]
         built.append(node)

@@ -118,3 +118,27 @@ def test_model_parallel_lstm_groups():
                          "layer1": mx.Context("cpu", 1)})
     np.testing.assert_allclose(mp_out, ref_out, rtol=1e-5, atol=1e-6)
     np.testing.assert_allclose(mp_gw, ref_gw, rtol=1e-4, atol=1e-5)
+
+
+def test_shard_spec_consumer_aware():
+    """Weights shard on their OUTPUT dim (never a contraction dim);
+    unknown 2-D+ consumers replicate; 1-D per-channel vectors shard."""
+    from jax.sharding import PartitionSpec as P
+    from mxnet_tpu.parallel.placement import _shard_spec
+
+    # FullyConnected weight (num_hidden, in_dim): shard axis 0 even when
+    # the contraction dim is larger (the old largest-dim rule got this
+    # wrong and paid a partial-sum per matmul)
+    assert _shard_spec((4, 1024), 2, ("FullyConnected", "weight")) == \
+        P("model", None)
+    assert _shard_spec((8, 3, 3, 3), 2, ("Convolution", "weight")) == \
+        P("model", None, None, None)
+    assert _shard_spec((1024, 8), 2, ("Embedding", "weight")) == \
+        P(None, "model")
+    # unknown consumer, 2-D: replicate rather than guess
+    assert _shard_spec((1024, 512), 2, ("Correlation", "data1")) == P()
+    assert _shard_spec((1024, 512), 2, None) == P()
+    # per-channel vector: elementwise-safe
+    assert _shard_spec((64,), 2, None) == P("model")
+    # indivisible: replicate
+    assert _shard_spec((7, 6), 2, ("FullyConnected", "weight")) == P()

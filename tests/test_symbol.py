@@ -178,3 +178,15 @@ def test_load_legacy_json_merges_param_and_attr():
     sym = mx.sym.load_json(legacy)
     _, out_shapes, _ = sym.infer_shape(data=(2, 3))
     assert tuple(out_shapes[0]) == (2, 4)   # num_hidden survived
+
+
+def test_json_roundtrip_preserves_ctx_group():
+    """ctx_group placement tags on op nodes must survive tojson/load_json
+    in _extra (placement.py reads them there), not leak into op attrs."""
+    with mx.AttrScope(ctx_group="g0"):
+        d = mx.sym.var("data")
+        fc = mx.sym.FullyConnected(d, num_hidden=4, name="fc")
+    loaded = mx.sym.load_json(fc.tojson())
+    node = [n for n in loaded._topo_nodes() if not n.is_variable][0]
+    assert node._extra.get("ctx_group") == "g0"
+    assert "ctx_group" not in node.attrs
