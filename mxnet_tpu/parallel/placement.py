@@ -106,17 +106,29 @@ def build_plan(symbol, group2ctx, arg_shapes_by_name):
     n_dev = len(devices)
     replicated = NamedSharding(mesh, P())
 
-    # who consumes each tagged param, and through which input slot
-    consumer_of = {}
+    # every consumer of each tagged param, with its input slot
+    consumers_of = {}
     for node in nodes:
         if node.is_variable:
             continue
         in_names = node.opdef().input_names(node.attrs)
         for (inp, _), slot in zip(node.inputs, in_names):
-            if inp.is_variable and id(inp) not in consumer_of:
-                # slot names may be prefixed per-layer; normalize to the
-                # canonical suffix ("weight"/"bias"/...)
-                consumer_of[id(inp)] = (node.op, slot.rsplit("_", 1)[-1])
+            if inp.is_variable:
+                consumers_of.setdefault(id(inp), []).append(
+                    (node.op, slot))
+
+    def _resolve_consumer(pid):
+        """Agree on one preferred axis across all consumers; a tied param
+        whose consumers want different axes replicates (sharding either
+        way would put a contraction dim on the wire for one of them)."""
+        axes = {_PREFERRED_AXIS.get(c) for c in consumers_of.get(pid, [])}
+        axes.discard(None)
+        if len(axes) != 1:
+            return None
+        for c in consumers_of[pid]:
+            if _PREFERRED_AXIS.get(c) is not None:
+                return c
+        return None
 
     param_shardings = {}
     for node in nodes:
@@ -127,7 +139,7 @@ def build_plan(symbol, group2ctx, arg_shapes_by_name):
             continue
         param_shardings[node.name] = NamedSharding(
             mesh, _shard_spec(shape, n_dev,
-                              consumer=consumer_of.get(id(node))))
+                              consumer=_resolve_consumer(id(node))))
 
     # cross-group edges: the producer's outputs must be gathered before a
     # different group consumes them (the _CrossDeviceCopy analog)

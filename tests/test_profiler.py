@@ -1,0 +1,32 @@
+"""Profiler (reference: tests/python/unittest/test_profiler.py —
+set_config/run/stop writes a trace; per-op names flow into it via the
+executor's jax.named_scope wrapping)."""
+import glob
+import os
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def test_profiler_trace_roundtrip(tmp_path):
+    mx.profiler.profiler_set_config(mode="all",
+                                    filename=str(tmp_path / "prof.json"))
+    mx.profiler.profiler_set_state("run")
+    x = mx.sym.var("data")
+    out = mx.sym.FullyConnected(x, num_hidden=4, name="proffc")
+    exe = out.simple_bind(ctx=mx.cpu(), data=(4, 8))
+    exe.arg_dict["data"][:] = np.random.rand(4, 8).astype("f")
+    exe.forward(is_train=False)
+    exe.outputs[0].asnumpy()
+    mx.profiler.profiler_set_state("stop")
+    trace_dir = mx.profiler.dump_profile()
+    assert trace_dir and os.path.isdir(trace_dir)
+    files = glob.glob(os.path.join(trace_dir, "**", "*"), recursive=True)
+    assert any(os.path.isfile(f) for f in files), "no trace artifacts"
+
+
+def test_profiler_rejects_bad_state():
+    import pytest
+    with pytest.raises(ValueError):
+        mx.profiler.profiler_set_state("pause")
