@@ -225,7 +225,11 @@ class DataParallelExecutorGroup:
                 new_states[nm] = ns
             return outs, new_aux, new_w, new_states, grads
 
-        # donate optimizer states: their old buffers die every step
+        # donate optimizer states: their old buffers die every step.
+        # (Params/aux are NOT donated: _load_batch can alias iterator
+        # arrays into arg_vals, and donation would delete the caller's
+        # buffers out from under it — measured: "Array has been deleted"
+        # in eval paths sharing those arrays.)
         self._fused_prog = jax.jit(step, donate_argnums=(3,))
         self._fused_watched = watched
         self._fused_states = {}
