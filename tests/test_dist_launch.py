@@ -51,7 +51,7 @@ def test_dist_sync_invariant_multiprocess(nworkers):
         assert f"rank={rank} nworker={nworkers}" in res.stdout
 
 
-@pytest.mark.parametrize("nworkers", [2])
+@pytest.mark.parametrize("nworkers", [2, 4])
 def test_dist_fit_lockstep(nworkers):
     """Module.fit over dist_sync (the dist_lenet analog): every worker
     learns AND ends with bit-identical parameters."""
