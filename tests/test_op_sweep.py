@@ -763,3 +763,19 @@ def test_registry_coverage():
           f"skipped-with-reason, {len(missing)} uncovered of "
           f"{len(OP_REGISTRY)} registered ==")
     assert not missing, f"ops with no sweep coverage: {missing}"
+
+
+def test_check_speed_harness():
+    """check_speed (reference: test_utils.py:602) measures a bound
+    executor's step time — exercise both modes so the harness stays
+    alive."""
+    from mxnet_tpu.test_utils import check_speed
+    data = mx.sym.var("data")
+    net = mx.sym.FullyConnected(data, num_hidden=8, name="spfc")
+    t_whole = check_speed(net, ctx=mx.cpu(), N=3, typ="whole",
+                          data=(4, 16))
+    t_fwd = check_speed(net, ctx=mx.cpu(), N=3, typ="forward",
+                        data=(4, 16))
+    assert t_whole > 0 and t_fwd > 0
+    with pytest.raises(ValueError):
+        check_speed(net, ctx=mx.cpu(), N=1, typ="sideways", data=(4, 16))
