@@ -203,9 +203,6 @@ class KVStoreDistSync(KVStore):
         self._nproc = jax.process_count()
         self._mesh = None
         self._sum_jit = None
-        # read at use time like the reference's dmlc::GetEnv tuning knobs
-        self.BUCKET_BYTES = int(os.environ.get(
-            "MXNET_KVSTORE_BUCKET_BYTES", 64 << 20))
 
     @property
     def rank(self):
@@ -245,7 +242,10 @@ class KVStoreDistSync(KVStore):
 
     def _allreduce(self, arrs):
         """Batched all-reduce: bucket same-dtype arrays into flat buffers
-        up to BUCKET_BYTES, one collective per bucket."""
+        up to MXNET_KVSTORE_BUCKET_BYTES, one collective per bucket."""
+        # read at use time like the reference's dmlc::GetEnv tuning knobs
+        bucket_bytes = int(os.environ.get(
+            "MXNET_KVSTORE_BUCKET_BYTES", 64 << 20))
         out = [None] * len(arrs)
         by_dtype = {}
         for i, a in enumerate(arrs):
@@ -255,7 +255,7 @@ class KVStoreDistSync(KVStore):
             buckets = []
             for i in idxs:
                 sz = arrs[i].size * dt.itemsize
-                if bucket and nbytes + sz > self.BUCKET_BYTES:
+                if bucket and nbytes + sz > bucket_bytes:
                     buckets.append(bucket)
                     bucket, nbytes = [], 0
                 bucket.append(i)
