@@ -40,7 +40,19 @@ from .ndarray import NDArray, zeros as nd_zeros
 from .ops.registry import get_op
 from . import random as _random
 
-__all__ = ["Executor"]
+__all__ = ["Executor", "naive_engine_active"]
+
+
+def naive_engine_active():
+    """True when ``MXNET_ENGINE_TYPE=NaiveEngine`` — the one-switch
+    deterministic debug mode (reference: env_var.md:33-40, engine
+    selection src/engine/engine.cc:13-40). Executor programs then run
+    un-jitted, op by op, each op forced to completion before the next —
+    serial replay for debugging, exactly what the reference's error
+    message recommends (threaded_engine.h:330-338). Read at use time so
+    tests (and users mid-session) can flip it."""
+    import os
+    return os.environ.get("MXNET_ENGINE_TYPE") == "NaiveEngine"
 
 
 class _LazyOutputs:
@@ -119,6 +131,14 @@ def _build_graph_runner(symbol, shape_overrides=None, tap=None, mp_plan=None,
     arg_names = symbol.list_arguments()
     aux_names = symbol.list_auxiliary_states()
     shape_overrides = shape_overrides or {}
+
+    # NHWC layout pass (ops/layout.py): on for the compiled hot path;
+    # debug runners (monitor tap, NaiveEngine) and model-parallel plans
+    # stay reference-layout so per-op observations match the reference
+    from .ops import layout as _layout
+    layout_opt = (tap is None and mp_plan is None
+                  and _layout.layout_opt_enabled())
+    entry_tags = {}     # (node_idx, out_idx) -> True when value is NHWC
     loss_mask = []
     for node, _ in symbol._outputs:
         loss_mask.append(bool(not node.is_variable and
@@ -149,7 +169,7 @@ def _build_graph_runner(symbol, shape_overrides=None, tap=None, mp_plan=None,
         if id(node) in shape_overrides:
             attrs = {**attrs, "shape": shape_overrides[id(node)]}
         aux_n = len(opdef.aux_names(attrs))
-        in_entries = []
+        in_entries, in_tags = [], []
         for inp, idx in node.inputs:
             if inp.is_variable:
                 if inp._extra.get("__is_aux__"):
@@ -158,15 +178,31 @@ def _build_graph_runner(symbol, shape_overrides=None, tap=None, mp_plan=None,
                 else:
                     in_entries.append(_load_var(arg_vals[inp.name],
                                                 inp.name))
+                in_tags.append(False)
             else:
-                in_entries.append(get_in((node_index[id(inp)], idx)))
+                key = (node_index[id(inp)], idx)
+                in_entries.append(get_in(key))
+                in_tags.append(entry_tags.get(key, False))
         regular = in_entries[:len(in_entries) - aux_n] if aux_n \
             else in_entries
         aux = in_entries[len(in_entries) - aux_n:] if aux_n else []
         krng = jax.random.fold_in(rng, i) if opdef.need_rng else None
         with jax.named_scope(node.name):
-            outs, aux_out = opdef.forward(attrs, regular, aux,
-                                          is_train, krng)
+            out_tags = None
+            if layout_opt:
+                res = _layout.nhwc_exec(opdef, attrs, regular, aux,
+                                        in_tags[:len(regular)],
+                                        is_train, krng)
+                if res is not None:
+                    outs, aux_out, out_tags = res
+            if out_tags is None:
+                regular = [_layout.to_nchw(x) if t else x
+                           for x, t in zip(regular, in_tags)]
+                outs, aux_out = opdef.forward(attrs, regular, aux,
+                                              is_train, krng)
+                out_tags = [False] * len(outs)
+        for j, t in enumerate(out_tags):
+            entry_tags[(i, j)] = t
         if mp_plan is not None:
             outs = mp_plan.constrain(id(node), outs)
         if tap is not None:
@@ -192,7 +228,11 @@ def _build_graph_runner(symbol, shape_overrides=None, tap=None, mp_plan=None,
                 src = aux_vals if ent[2] else arg_vals
                 outs.append(_load_var(src[ent[1]], ent[1]))
             else:
-                outs.append(get_entry((ent[1], ent[2])))
+                o = get_entry((ent[1], ent[2]))
+                # user-visible outputs are always reference-layout NCHW
+                if entry_tags.get((ent[1], ent[2]), False):
+                    o = _layout.to_nchw(o)
+                outs.append(o)
         return outs
 
     compute_idx = [i for i, n in enumerate(nodes) if not n.is_variable]
@@ -382,6 +422,7 @@ class Executor:
         # compiled program cache: (kind, ) -> jitted fn
         self._jit_cache = {}
         self._tapped_runner = None   # eager monitored runner (per callback)
+        self._naive_runner = None    # NaiveEngine serial replay runner
         self._pending = None      # recorded inputs awaiting execution
         self._outputs = None      # computed output NDArrays
 
@@ -448,18 +489,42 @@ class Executor:
         return [nm for nm in self.arg_names
                 if self.grad_req.get(nm, "null") != "null"]
 
+    def _naive_runner_fn(self):
+        """Serial deterministic replay runner for the NaiveEngine debug
+        mode: every op executes eagerly (no jit, no XLA fusion) and is
+        forced to completion before the next one dispatches — the analog
+        of the reference's ``MXNET_ENGINE_TYPE=NaiveEngine`` synchronous
+        engine (src/engine/naive_engine.cc; the debugging procedure in
+        threaded_engine.h:330-338)."""
+        if self._naive_runner is None:
+            def tap(node, outs):
+                for o in outs:
+                    # under jax.vjp the forward replays with tracers;
+                    # only concrete arrays can (and need to) block
+                    if isinstance(o, jax.Array) and \
+                            not isinstance(o, jax.core.Tracer):
+                        o.block_until_ready()
+
+            self._naive_runner, *_ = _build_graph_runner(
+                self._symbol, self._shape_overrides, tap=tap,
+                mp_plan=self._mp_plan,
+                compute_dtype=self._compute_dtype)
+        return self._naive_runner
+
     def _get_program(self, kind):
-        fn = self._jit_cache.get(kind)
+        naive = naive_engine_active()
+        cache_key = (kind, naive)
+        fn = self._jit_cache.get(cache_key)
         if fn is not None:
             return fn
-        runner = self._runner
+        runner = self._naive_runner_fn() if naive else self._runner
         if kind in ("fwd_infer", "fwd_train"):
             is_train = kind == "fwd_train"
 
             def prog(arg_vals, aux_vals, rng):
                 return runner(arg_vals, aux_vals, is_train, rng)
 
-            fn = jax.jit(prog)
+            fn = prog if naive else jax.jit(prog)
         elif kind == "fwd_bwd":
             watched = self._watched()
 
@@ -477,10 +542,10 @@ class Executor:
                 grads, = vjp_fn(head_grads)
                 return outs, new_aux, grads
 
-            fn = jax.jit(prog)
+            fn = prog if naive else jax.jit(prog)
         else:
             raise ValueError(kind)
-        self._jit_cache[kind] = fn
+        self._jit_cache[cache_key] = fn
         return fn
 
     # -------------------------------------------------------------- forward

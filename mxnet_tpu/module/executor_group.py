@@ -188,8 +188,13 @@ class DataParallelExecutorGroup:
         one XLA dispatch -> buffer swaps. Returns False when the
         optimizer or binding can't express it (imperative path remains).
         """
+        from ..executor import naive_engine_active
         plan = optimizer.fused_plan()
         if plan is None or not self.for_training or self.inputs_need_grad:
+            return False
+        if naive_engine_active():
+            # NaiveEngine debug mode: keep the imperative per-phase path so
+            # every op replays serially through the un-jitted runner
             return False
         if any(self.grad_req.get(nm) not in ("write", "null")
                for nm in self.arg_names):
@@ -204,10 +209,7 @@ class DataParallelExecutorGroup:
         runner = exe._runner
         loss_mask = exe._loss_mask
 
-        def step(arg_vals, aux_vals, rng, states, lrs, wds):
-            w = {nm: arg_vals[nm] for nm in watched}
-            rest = {nm: v for nm, v in arg_vals.items() if nm not in w}
-
+        def step(w, rest, aux_vals, rng, states, lrs, wds):
             def f(wv):
                 return runner({**rest, **wv}, aux_vals, True, rng)
 
@@ -218,20 +220,31 @@ class DataParallelExecutorGroup:
             (grads,) = vjp_fn(heads)
             new_w, new_states = {}, {}
             for nm in watched:
-                nw, ns = update(arg_vals[nm],
-                                grads[nm].astype(arg_vals[nm].dtype),
+                nw, ns = update(w[nm],
+                                grads[nm].astype(w[nm].dtype),
                                 states[nm], lrs[nm], wds[nm])
                 new_w[nm] = nw
                 new_states[nm] = ns
             return outs, new_aux, new_w, new_states, grads
 
-        # donate optimizer states: their old buffers die every step.
-        # (Params/aux are NOT donated: _load_batch can alias iterator
-        # arrays into arg_vals, and donation would delete the caller's
-        # buffers out from under it — measured: "Array has been deleted"
-        # in eval paths sharing those arrays.)
-        self._fused_prog = jax.jit(step, donate_argnums=(3,))
+        # donate the watched params and optimizer states: both are
+        # replaced by same-shaped outputs every step, so XLA updates them
+        # in place instead of allocating fresh buffers. They get their own
+        # arguments precisely so donation is safe — `rest` still carries
+        # data/label entries that _load_batch can alias to iterator
+        # arrays, and donating those would delete the caller's buffers
+        # out from under it (measured: "Array has been deleted" in eval
+        # paths sharing those arrays). Aux (BN stats) stays undonated for
+        # the same reason: eval paths read the same cells mid-epoch.
+        self._fused_prog = jax.jit(step, donate_argnums=(0, 4))
         self._fused_watched = watched
+        # the watched cells must own their buffers exclusively before the
+        # first donated step: init_params aliases the same arrays into
+        # Module._arg_params, and donating a shared buffer would delete it
+        # out from under that holder
+        ad = exe.arg_dict
+        for nm in watched:
+            ad[nm]._set(jnp.array(ad[nm].asjax(), copy=True))
         self._fused_states = {}
         for nm in watched:
             w = exe.arg_dict[nm].asjax()
@@ -248,8 +261,10 @@ class DataParallelExecutorGroup:
         exe = self.executor
         self._load_batch(data_batch)
 
+        arg_vals = exe._arg_vals()
+        w = {nm: arg_vals.pop(nm) for nm in self._fused_watched}
         outs, new_aux, new_w, new_states, grads = self._fused_prog(
-            exe._arg_vals(), exe._aux_vals(), _random.next_key(),
+            w, arg_vals, exe._aux_vals(), _random.next_key(),
             self._fused_states, lrs, wds)
         self._fused_states = new_states
         ad = exe.arg_dict

@@ -1,0 +1,188 @@
+"""Execution-time NHWC layout propagation (a graph-level layout pass).
+
+The reference framework is NCHW end to end — mshadow's and cuDNN's
+native layout (reference: src/operator/convolution-inl.h:1-570). On TPU
+the MXU/VPU want the channel dimension minor (in lanes): NHWC. The
+public API, shape inference, parameters and checkpoints all stay NCHW
+(reference parity); this pass rewrites only the *execution* inside the
+graph runner, the way the reference's memory-plan/exec passes rewrite
+execution without changing Symbol semantics.
+
+Mechanics: the runner keeps an "is NHWC" tag per graph value.
+``Convolution`` pulls its data input into NHWC and emits NHWC;
+layout-flexible ops — BatchNorm, Pooling, LRN, activations, Dropout,
+same-shape elementwise arithmetic, Concat/SliceChannel over the channel
+axis — propagate the tag by running a channel-last variant (or their
+stock elementwise kernel, which is layout-blind). Every other op forces
+its inputs back to NCHW, so transposes appear only at layout-domain
+boundaries: once at the first conv, and once where a layout-fixing op
+(Flatten, FullyConnected, SoftmaxOutput, ...) consumes a spatial tensor
+— in ResNet-50 that second boundary sits after global pooling where the
+tensor is (N, 1, 1, C) and the transpose is free. XLA folds the
+per-step OIHW->HWIO weight transposes into the convolution itself.
+
+Kill switch: ``MXNET_NHWC_LAYOUT=0`` (the pass is on by default; the
+monitor/NaiveEngine debug runners always run reference-layout NCHW).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import parse_tuple, parse_bool, parse_int, parse_float
+
+__all__ = ["nhwc_exec", "to_nhwc", "to_nchw", "layout_opt_enabled"]
+
+
+def layout_opt_enabled():
+    import os
+    return os.environ.get("MXNET_NHWC_LAYOUT", "1") != "0"
+
+
+def to_nhwc(x):
+    return jnp.transpose(x, (0, 2, 3, 1))
+
+
+def to_nchw(x):
+    return jnp.transpose(x, (0, 3, 1, 2))
+
+
+def _ntuple(v, n, default):
+    t = parse_tuple(v) if v is not None else None
+    if t is None:
+        return (default,) * n
+    if len(t) != n:
+        t = tuple(t) + (default,) * (n - len(t))
+    return t
+
+
+# --------------------------------------------------------------------------
+# channel-last kernels for the layout-entry / layout-flex ops
+# --------------------------------------------------------------------------
+def _conv_nhwc(attrs, data, weight, bias=None):
+    """2-d Convolution on NHWC data; weight arrives in the reference's
+    OIHW parameter layout and is transposed to HWIO here (folded into
+    the conv by XLA)."""
+    kernel = parse_tuple(attrs["kernel"])
+    stride = _ntuple(attrs.get("stride"), 2, 1)
+    pad = _ntuple(attrs.get("pad"), 2, 0)
+    dilate = _ntuple(attrs.get("dilate"), 2, 1)
+    ng = parse_int(attrs.get("num_group", 1))
+    w = jnp.transpose(weight, (2, 3, 1, 0)).astype(data.dtype)
+    dn = lax.conv_dimension_numbers(data.shape, w.shape,
+                                    ("NHWC", "HWIO", "NHWC"))
+    out = lax.conv_general_dilated(
+        data, w, stride, [(p, p) for p in pad], rhs_dilation=dilate,
+        dimension_numbers=dn, feature_group_count=ng)
+    if bias is not None:
+        out = out + bias.astype(data.dtype)   # broadcasts over minor C
+    return out
+
+
+# --------------------------------------------------------------------------
+# dispatch
+# --------------------------------------------------------------------------
+# shape-preserving elementwise binaries: layout-blind when every operand
+# shares one layout (the runner converts minority-NCHW operands first)
+_EW_BINARY = {"elemwise_add", "elemwise_sub", "elemwise_mul", "elemwise_div",
+              "_maximum", "_minimum", "_hypot", "_power"}
+# shape-preserving single-input ops whose stock kernel never looks at the
+# channel axis
+_EW_UNARY = {"Activation", "Dropout", "_copy", "BlockGrad", "Cast",
+             "relu", "sigmoid", "tanh", "exp", "sqrt", "square", "abs",
+             "negative", "clip", "_add_scalar", "_minus_scalar",
+             "_rminus_scalar", "_mul_scalar", "_div_scalar",
+             "_rdiv_scalar", "_maximum_scalar", "_minimum_scalar",
+             "_power_scalar"}
+
+
+def nhwc_exec(opdef, attrs, regular, aux, in_tags, is_train, rng):
+    """Try to execute one graph node channel-last.
+
+    ``regular`` are the node's data inputs (possibly NHWC, per
+    ``in_tags``); ``aux`` are its auxiliary states (always layout-free:
+    per-channel vectors). Returns ``(outputs, new_aux, out_tags)`` or
+    None, in which case the caller must convert NHWC inputs back to
+    NCHW and run the stock kernel.
+    """
+    name = opdef.name
+
+    if name == "Convolution":
+        data = regular[0]
+        if data.ndim != 4 or len(parse_tuple(attrs["kernel"])) != 2:
+            return None
+        if not in_tags[0]:
+            data = to_nhwc(data)
+        out = _conv_nhwc(attrs, data, *regular[1:])
+        return [out], [], [True]
+
+    # flex ops only continue an NHWC domain, never start one
+    if name == "Pooling":
+        if not in_tags[0] or regular[0].ndim != 4:
+            return None
+        from .nn import _pooling
+        return [_pooling(attrs, regular[0], channel_axis=-1)], [], [True]
+
+    if name == "BatchNorm":
+        if not in_tags[0]:
+            return None
+        from .nn import _bn_fwd
+        outs, new_aux = _bn_fwd(attrs, regular, aux, is_train, rng,
+                                channel_axis=-1)
+        return outs, new_aux, [True, False, False]
+
+    if name == "LRN":
+        if not in_tags[0] or regular[0].ndim != 4:
+            return None
+        from .nn import _lrn
+        out, norm = _lrn(attrs, regular[0], channel_axis=-1)
+        return [out, norm], [], [True, True]
+
+    if name == "LeakyReLU":
+        if not in_tags[0]:
+            return None
+        if attrs.get("act_type", "leaky") == "prelu":
+            x = regular[0]
+            gamma = regular[1].reshape((1,) * (x.ndim - 1) + (-1,))
+            return [jnp.where(x > 0, x, gamma * x)], [], [True]
+        outs, new_aux = opdef.forward(attrs, regular, aux, is_train, rng)
+        return outs, new_aux, [True] * len(outs)
+
+    if name == "Concat":
+        dim = parse_int(attrs.get("dim", 1))
+        if dim != 1 or not all(in_tags) or regular[0].ndim != 4:
+            return None
+        return [jnp.concatenate(regular, axis=3)], [], [True]
+
+    if name == "SliceChannel":
+        if not in_tags[0] or regular[0].ndim != 4 or \
+                parse_int(attrs.get("axis", 1)) != 1 or \
+                parse_bool(attrs.get("squeeze_axis", False)):
+            return None
+        n = parse_int(attrs.get("num_outputs", 1))
+        outs = jnp.split(regular[0], n, axis=3)
+        return list(outs), [], [True] * n
+
+    if name in _EW_UNARY:
+        if not in_tags[0]:
+            return None
+        outs, new_aux = opdef.forward(attrs, regular, aux, is_train, rng)
+        # identity-shaped: every output inherits the input's layout
+        return outs, new_aux, [True] * len(outs)
+
+    if name in _EW_BINARY:
+        if len(regular) != 2 or not any(in_tags[:2]):
+            return None
+        a, b = regular
+        if a.ndim != 4 or a.shape != b.shape:
+            return None
+        if not in_tags[0]:
+            a = to_nhwc(a)
+        if not in_tags[1]:
+            b = to_nhwc(b)
+        outs, new_aux = opdef.forward(attrs, [a, b], aux, is_train, rng)
+        return outs, new_aux, [True] * len(outs)
+
+    return None

@@ -268,10 +268,15 @@ def _pool_infer(attrs, in_shapes):
                      "pooling_convention": (None, "valid"),
                      "stride": (parse_tuple, None), "pad": (parse_tuple, None)},
           infer_shape=_pool_infer)
-def _pooling(attrs, data):
+def _pooling(attrs, data, channel_axis=1):
+    """channel_axis=1 is the reference NCHW layout; the NHWC layout pass
+    (ops/layout.py) calls with channel_axis=-1, putting the window over
+    the middle axes and the channel in lanes."""
     nd = data.ndim - 2
+    nhwc = channel_axis in (-1, data.ndim - 1)
+    sp0 = 1 if nhwc else 2              # first spatial axis
     if parse_bool(attrs.get("global_pool", False)):
-        kernel = data.shape[2:]
+        kernel = data.shape[sp0:sp0 + nd]
         stride = (1,) * nd
         pad = (0,) * nd
     else:
@@ -279,8 +284,12 @@ def _pooling(attrs, data):
         stride = _ntuple(attrs.get("stride"), nd, 1)
         pad = _ntuple(attrs.get("pad"), nd, 0)
     ptype = attrs.get("pool_type", "max")
-    window = (1, 1) + tuple(kernel)
-    strides = (1, 1) + tuple(stride)
+    if nhwc:
+        window = (1,) + tuple(kernel) + (1,)
+        strides = (1,) + tuple(stride) + (1,)
+    else:
+        window = (1, 1) + tuple(kernel)
+        strides = (1, 1) + tuple(stride)
     # pooling_convention='full' (ceil output shape): pad extra on the high
     # side so reduce_window's floor semantics yield the ceil-based shape
     # that _pool_infer reports
@@ -288,12 +297,13 @@ def _pooling(attrs, data):
     if attrs.get("pooling_convention", "valid") == "full" and \
             not parse_bool(attrs.get("global_pool", False)):
         for i in range(nd):
-            x = data.shape[2 + i] + 2 * pad[i] - kernel[i]
+            x = data.shape[sp0 + i] + 2 * pad[i] - kernel[i]
             want = int(np.ceil(x / stride[i])) + 1
             extra[i] = max(0, (want - 1) * stride[i] + kernel[i]
-                           - (data.shape[2 + i] + 2 * pad[i]))
-    pads = ((0, 0), (0, 0)) + tuple(
-        (p, p + e) for p, e in zip(pad, extra))
+                           - (data.shape[sp0 + i] + 2 * pad[i]))
+    spatial = tuple((p, p + e) for p, e in zip(pad, extra))
+    pads = ((0, 0),) + spatial + ((0, 0),) if nhwc else \
+        ((0, 0), (0, 0)) + spatial
     if ptype == "max":
         init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) else \
             jnp.iinfo(data.dtype).min
@@ -411,15 +421,22 @@ def _bn_infer(attrs, in_shapes):
     return [data_s, c, c], [data_s, c, c], [c, c]
 
 
-def _bn_fwd(attrs, inputs, aux, is_train, rng):
+def _bn_fwd(attrs, inputs, aux, is_train, rng, channel_axis=1):
+    """channel_axis=1 is the reference NCHW layout; the NHWC layout pass
+    (ops/layout.py) calls with channel_axis=-1 so statistics reduce over
+    the major axes and the per-channel affine rides the lane dimension."""
     data, gamma, beta = inputs
     moving_mean, moving_var = aux
     eps = parse_float(attrs.get("eps", 1e-3))
     momentum = parse_float(attrs.get("momentum", 0.9))
     fix_gamma = parse_bool(attrs.get("fix_gamma", True))
     use_global = parse_bool(attrs.get("use_global_stats", False))
-    axes = (0,) + tuple(range(2, data.ndim))
-    bshape = (1, -1) + (1,) * (data.ndim - 2)
+    if channel_axis in (-1, data.ndim - 1):
+        axes = tuple(range(data.ndim - 1))
+        bshape = (1,) * (data.ndim - 1) + (-1,)
+    else:
+        axes = (0,) + tuple(range(2, data.ndim))
+        bshape = (1, -1) + (1,) * (data.ndim - 2)
     if fix_gamma:
         gamma = jnp.ones_like(gamma)
     if is_train and not use_global:
@@ -490,13 +507,23 @@ def _l2_normalization(attrs, data):
                      "knorm": (parse_float, 2.0), "nsize": (parse_int, 5)},
           num_outputs=2, num_visible=1, output_names=["output", "tmp_norm"],
           infer_shape=lambda attrs, s: (s, [s[0], s[0]], []))
-def _lrn(attrs, data):
+def _lrn(attrs, data, channel_axis=1):
+    """channel_axis=1 is the reference NCHW layout; the NHWC layout pass
+    calls with channel_axis=-1 (window slides over the lane axis)."""
     nsize = attrs["nsize"]
     alpha, beta, knorm = attrs["alpha"], attrs["beta"], attrs["knorm"]
     sq = jnp.square(data)
     half = nsize // 2
-    padded = jnp.pad(sq, ((0, 0), (half, half), (0, 0), (0, 0)))
-    windows = sum(padded[:, i:i + data.shape[1]] for i in range(nsize))
+    pads = [(0, 0)] * data.ndim
+    ax = channel_axis % data.ndim
+    pads[ax] = (half, half)
+    padded = jnp.pad(sq, pads)
+    c = data.shape[ax]
+    idx = [slice(None)] * data.ndim
+    windows = 0
+    for i in range(nsize):
+        idx[ax] = slice(i, i + c)
+        windows = windows + padded[tuple(idx)]
     norm = (knorm + alpha / nsize * windows) ** beta
     return data / norm, norm
 

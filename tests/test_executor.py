@@ -147,3 +147,50 @@ def test_monitor_taps_per_op_during_training():
     # exactly once per op per step — no duplicate taps
     from collections import Counter
     assert all(c == 1 for c in Counter(seen).values()), Counter(seen)
+
+
+def test_naive_engine_serial_replay(monkeypatch):
+    """MXNET_ENGINE_TYPE=NaiveEngine routes executor programs through the
+    un-jitted serial runner (reference: env_var.md:33-40, the documented
+    deterministic-debug switch) and must match the jitted path bitwise-
+    close on forward outputs and gradients."""
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data, num_hidden=3, name="fc")
+    out = mx.sym.SoftmaxOutput(fc, mx.sym.Variable("sm_label"), name="sm")
+
+    x = np.random.rand(4, 5).astype(np.float32)
+    y = np.array([0, 1, 2, 0], dtype=np.float32)
+
+    def run_step():
+        mx.random.seed(7)
+        exe = out.simple_bind(mx.cpu(), data=(4, 5), sm_label=(4,))
+        for nm, arr in exe.arg_dict.items():
+            if nm not in ("data", "sm_label"):
+                arr[:] = 0.1
+        exe.forward(is_train=True, data=mx.nd.array(x),
+                    sm_label=mx.nd.array(y))
+        exe.backward()
+        return (exe.outputs[0].asnumpy(),
+                exe.grad_dict["fc_weight"].asnumpy())
+
+    ref_out, ref_grad = run_step()
+    monkeypatch.setenv("MXNET_ENGINE_TYPE", "NaiveEngine")
+    naive_out, naive_grad = run_step()
+    assert_almost_equal(naive_out, ref_out)
+    assert_almost_equal(naive_grad, ref_grad)
+
+
+def test_naive_engine_disables_fused_fit(monkeypatch):
+    """Under NaiveEngine Module.fit must fall back to the imperative
+    per-phase path (per-op serial replay), not the fused XLA step."""
+    monkeypatch.setenv("MXNET_ENGINE_TYPE", "NaiveEngine")
+    n = 16
+    x = np.random.rand(n, 4).astype(np.float32)
+    y = (x.sum(axis=1) > 2).astype(np.float32)
+    it = mx.io.NDArrayIter(x, y, batch_size=8, label_name="sm_label")
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(mx.sym.var("data"), num_hidden=2, name="fc"),
+        mx.sym.var("sm_label"), name="sm")
+    mod = mx.mod.Module(net, label_names=("sm_label",))
+    mod.fit(it, num_epoch=1, optimizer_params={"learning_rate": 0.1})
+    assert not mod._fused_armed

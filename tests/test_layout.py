@@ -1,0 +1,119 @@
+"""NHWC layout-propagation pass (mxnet_tpu/ops/layout.py).
+
+The pass must be numerically invisible: identical outputs/gradients with
+``MXNET_NHWC_LAYOUT`` on and off, NCHW everywhere at the API surface, and
+the NHWC domain must actually cover the conv trunk (transpose count).
+Reference context: the reference is NCHW-native (convolution-inl.h); on
+TPU the channel-minor layout is the performance-correct one, so the pass
+is the TPU analog of cuDNN's internal NCHW kernels.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def _convnet():
+    data = mx.sym.var("data")
+    net = mx.sym.Convolution(data, num_filter=8, kernel=(3, 3), pad=(1, 1),
+                             name="c1")
+    net = mx.sym.BatchNorm(net, name="bn1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.Pooling(net, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    branch = mx.sym.Convolution(net, num_filter=8, kernel=(3, 3),
+                                pad=(1, 1), name="c2")
+    net = branch + net                     # residual join inside the domain
+    net = mx.sym.LRN(net, nsize=5)
+    net = mx.sym.Concat(net, net, dim=1)
+    parts = mx.sym.SliceChannel(net, num_outputs=2, axis=1)
+    net = parts[0] * 1.0 + parts[1]
+    net = mx.sym.Flatten(net)
+    net = mx.sym.FullyConnected(net, num_hidden=4, name="fc")
+    return mx.sym.SoftmaxOutput(net, mx.sym.var("sm_label"), name="sm")
+
+
+def _run(sym, train=True):
+    mx.random.seed(0)
+    exe = sym.simple_bind(mx.cpu(), data=(2, 3, 8, 8), sm_label=(2,))
+    for nm, a in exe.arg_dict.items():
+        if nm not in ("data", "sm_label"):
+            a[:] = np.random.RandomState(
+                abs(hash(nm)) % 2**31).uniform(-.2, .2, a.shape).astype(
+                    np.float32)
+    x = np.random.RandomState(1).rand(2, 3, 8, 8).astype(np.float32)
+    y = np.array([0, 2], dtype=np.float32)
+    exe.forward(is_train=train, data=mx.nd.array(x),
+                sm_label=mx.nd.array(y))
+    grads = {}
+    if train:
+        exe.backward()
+        grads = {nm: g.asnumpy() for nm, g in exe.grad_dict.items()
+                 if g is not None and nm != "data"}
+    aux = {nm: a.asnumpy() for nm, a in exe.aux_dict.items()}
+    return exe.outputs[0].asnumpy(), grads, aux
+
+
+def test_layout_pass_numerically_invisible(monkeypatch):
+    sym = _convnet()
+    monkeypatch.setenv("MXNET_NHWC_LAYOUT", "0")
+    out0, g0, aux0 = _run(sym)
+    monkeypatch.setenv("MXNET_NHWC_LAYOUT", "1")
+    out1, g1, aux1 = _run(sym)
+    assert_almost_equal(out0, out1, rtol=1e-4, atol=1e-5)
+    assert set(g0) == set(g1)
+    for nm in g0:
+        assert_almost_equal(g0[nm], g1[nm], rtol=1e-3, atol=1e-4)
+    for nm in aux0:    # BN moving stats updated identically
+        assert_almost_equal(aux0[nm], aux1[nm], rtol=1e-4, atol=1e-5)
+
+
+def test_layout_pass_inference_path(monkeypatch):
+    sym = _convnet()
+    monkeypatch.setenv("MXNET_NHWC_LAYOUT", "0")
+    out0, _, _ = _run(sym, train=False)
+    monkeypatch.setenv("MXNET_NHWC_LAYOUT", "1")
+    out1, _, _ = _run(sym, train=False)
+    assert_almost_equal(out0, out1, rtol=1e-4, atol=1e-5)
+
+
+def test_layout_domain_covers_trunk():
+    """The NHWC domain must swallow the whole conv trunk: the traced
+    program may transpose activation data only at the two boundaries
+    (entry into the first conv, exit to Flatten) — everything else is
+    the small per-conv OIHW->HWIO weight relayout XLA folds away."""
+    import jax
+    from mxnet_tpu.models import resnet
+    sym = resnet.get_symbol(num_classes=10, num_layers=50,
+                            image_shape="3,32,32")
+    exe = sym.simple_bind(mx.cpu(), data=(2, 3, 32, 32),
+                          softmax_label=(2,))
+    jaxpr = jax.make_jaxpr(
+        lambda a, x, r: exe._runner(a, x, True, r))(
+            exe._arg_vals(), exe._aux_vals(), jax.random.PRNGKey(0))
+    s = str(jaxpr)
+    n_conv = s.count("conv_general_dilated")
+    n_transpose = s.count("transpose[")
+    assert n_conv >= 50
+    # weight transposes scale with convs; activation transposes must not
+    assert n_transpose <= n_conv + 6, (n_conv, n_transpose)
+
+
+def test_layout_pass_grouped_conv_and_prelu(monkeypatch):
+    data = mx.sym.var("data")
+    net = mx.sym.Convolution(data, num_filter=4, kernel=(1, 1), name="c0")
+    net = mx.sym.Convolution(net, num_filter=8, kernel=(3, 3), pad=(1, 1),
+                             num_group=2, name="c1")
+    net = mx.sym.LeakyReLU(net, act_type="prelu", name="pr")
+    net = mx.sym.Pooling(net, global_pool=True, pool_type="avg",
+                         kernel=(1, 1))
+    net = mx.sym.Flatten(net)
+    net = mx.sym.FullyConnected(net, num_hidden=3, name="fc")
+    sym = mx.sym.SoftmaxOutput(net, mx.sym.var("sm_label"), name="sm")
+    monkeypatch.setenv("MXNET_NHWC_LAYOUT", "0")
+    out0, g0, _ = _run(sym)
+    monkeypatch.setenv("MXNET_NHWC_LAYOUT", "1")
+    out1, g1, _ = _run(sym)
+    assert_almost_equal(out0, out1, rtol=1e-4, atol=1e-5)
+    for nm in g0:
+        assert_almost_equal(g0[nm], g1[nm], rtol=1e-3, atol=1e-4)
