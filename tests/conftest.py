@@ -32,6 +32,8 @@ import pytest
 
 @pytest.fixture(autouse=True)
 def _seed():
+    import random
+    random.seed(0)          # augmenters draw from stdlib random
     np.random.seed(0)
     import mxnet_tpu as mx
     mx.random.seed(0)

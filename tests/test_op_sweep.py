@@ -21,6 +21,8 @@ from mxnet_tpu.ops import OP_REGISTRY
 from mxnet_tpu.test_utils import (check_consistency, check_numeric_gradient,
                                   assert_almost_equal)
 
+pytestmark = pytest.mark.slow
+
 F32, F16 = np.float32, np.float16
 
 

@@ -19,6 +19,8 @@ from examples.ssd import data as shapes_data  # noqa: E402
 from examples.ssd import symbol as ssd_symbol  # noqa: E402
 from examples.ssd import train as ssd_train  # noqa: E402
 
+pytestmark = pytest.mark.slow
+
 
 # ------------------------------------------------------------- augmenters
 def test_det_flip_box_math():

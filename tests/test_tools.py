@@ -4,6 +4,7 @@ reference: tools/parse_log.py (nightly gate consumer, test_all.sh:42-55),
 tools/bandwidth/, tools/kill-mxnet.py, example/bi-lstm-sort/.
 """
 import json
+import pytest
 import os
 import signal
 import subprocess
@@ -11,6 +12,8 @@ import sys
 import time
 
 import numpy as np
+
+pytestmark = pytest.mark.slow
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 TOOLS = os.path.join(ROOT, "tools")
