@@ -73,7 +73,22 @@ def fit(args, network, data_iters, **fit_kwargs):
     checkpoint = mx.callback.do_checkpoint(args.model_prefix) \
         if args.model_prefix else None
 
-    mod = mx.mod.Module(network, context=_contexts(args))
+    contexts = _contexts(args)
+    # overlap input with compute: decode/augment runs ahead of the step
+    # in a background thread with batches staged to the training device
+    # (reference: PrefetcherIter always tops the C++ iterator stack,
+    # iter_prefetcher.h:129). Iterators that already prefetch pass through.
+    if isinstance(train, mx.io.PrefetchingIter):
+        train.ensure_device(contexts[0])
+    else:
+        train = mx.io.PrefetchingIter(train, device=contexts[0])
+    if val is not None:
+        if isinstance(val, mx.io.PrefetchingIter):
+            val.ensure_device(contexts[0])
+        else:
+            val = mx.io.PrefetchingIter(val, device=contexts[0])
+
+    mod = mx.mod.Module(network, context=contexts)
     mod.fit(train,
             eval_data=val,
             eval_metric=["acc"],

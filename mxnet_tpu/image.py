@@ -415,15 +415,45 @@ def ImageRecordIter(path_imgrec, data_shape, batch_size, path_imgidx=None,
                     shuffle=False, rand_crop=False, rand_mirror=False,
                     mean_r=0, mean_g=0, mean_b=0, std_r=1, std_g=1, std_b=1,
                     resize=0, part_index=0, num_parts=1, prefetch=True,
-                    data_name="data", label_name="softmax_label", **kwargs):
+                    data_name="data", label_name="softmax_label",
+                    num_workers=None, seed=0, **kwargs):
     """Factory matching the reference's ImageRecordIter params
-    (reference: iter_image_recordio_2.cc registration :559-579)."""
+    (reference: iter_image_recordio_2.cc registration :559-579).
+
+    The standard param-driven augmentation set routes to the
+    multiprocess decode pipeline (mp_decode.py — the analog of the
+    reference's OMP-parallel C++ parser); anything it can't express
+    falls back to the in-process thread-pool ImageIter. Set
+    ``num_workers=0`` (or MXNET_DECODE_WORKERS=0) to force the
+    fallback."""
     mean = None
     std = None
     if mean_r or mean_g or mean_b:
         mean = np.array([mean_r, mean_g, mean_b])
     if std_r != 1 or std_g != 1 or std_b != 1:
         std = np.array([std_r, std_g, std_b])
+
+    env_workers = os.environ.get("MXNET_DECODE_WORKERS")
+    if num_workers is None and env_workers is not None:
+        num_workers = int(env_workers)
+    mp_ok = (num_workers != 0
+             and set(kwargs) <= {"label_width"}
+             and path_imgrec is not None)
+    if mp_ok:
+        from .mp_decode import MPImageRecordIter
+        from .io import PrefetchingIter
+        it = MPImageRecordIter(
+            path_imgrec, data_shape, batch_size, path_imgidx=path_imgidx,
+            label_width=kwargs.get("label_width", 1), shuffle=shuffle,
+            part_index=part_index, num_parts=num_parts,
+            aug_params={"resize": resize, "rand_crop": rand_crop,
+                        "rand_mirror": rand_mirror,
+                        "mean": None if mean is None else mean.tolist(),
+                        "std": None if std is None else std.tolist()},
+            num_workers=num_workers, seed=seed,
+            data_name=data_name, label_name=label_name)
+        return PrefetchingIter(it) if prefetch else it
+
     aug_list = CreateAugmenter(data_shape, resize=resize,
                                rand_crop=rand_crop, rand_mirror=rand_mirror,
                                mean=mean, std=std)

@@ -197,6 +197,17 @@ class PrefetchingIter(DataIter):
                      for x in i.provide_label]
                     for r, i in zip(self.rename_label, self.iters)], [])
 
+    def ensure_device(self, device):
+        """Enable prefetch-to-device staging if it wasn't configured.
+
+        Lets training wrappers (examples/common/fit.py) upgrade an
+        already-prefetching iterator — e.g. ImageRecordIter's default
+        ``PrefetchingIter(it)`` — to stage batches onto the training
+        device without double-wrapping. No-op when a device is set."""
+        if self._device is None:
+            self._device = device
+        return self
+
     def _producer(self):
         while not self._stop.is_set():
             try:

@@ -81,5 +81,9 @@ def test_pipeline_throughput_floor(tmp_path):
     import io_bench
     prefix = str(tmp_path / "synth")
     io_bench.make_synthetic_pack(prefix, 64, 128)
-    img_s = io_bench.measure(prefix, 16, (3, 112, 112), epochs=1)
+    img_s = io_bench.measure_threads(prefix, 16, (3, 112, 112), epochs=1)
     assert img_s > 25, f"pipeline throughput collapsed: {img_s:.1f} img/s"
+    mp_res = io_bench.measure_mp(prefix, 16, (3, 112, 112), epochs=1,
+                                 num_workers=2)
+    assert mp_res is not None and mp_res[0] > 25, \
+        f"mp pipeline throughput collapsed: {mp_res}"
