@@ -308,58 +308,167 @@ _register_builtin()
 # --------------------------------------------------------------------------
 # built-in: flash attention (the framework's marquee Pallas kernel — the
 # reference's attention-era gap filled TPU-first). Forward is a Pallas
-# online-softmax kernel: grid over (batch*heads, query blocks), K/V
-# streamed through VMEM block by block inside the kernel; backward
-# recomputes attention via the XLA composition under jax.custom_vjp
-# (flash recompute strategy — no T x T tensor is ever stored for fwd).
+# online-softmax kernel on a (batch*heads, q blocks, k blocks) grid: K/V
+# are tiled *through the grid* so VMEM only ever holds one
+# (block, D) tile of each (running max/normalizer/accumulator persist in
+# VMEM scratch across the sequential k dimension). Backward recomputes
+# attention via the XLA composition under jax.custom_vjp (flash recompute
+# strategy — no T x T tensor is ever stored for fwd). ``partial=True``
+# returns the *unnormalized* (acc, m, l) triple instead, which is what
+# ring attention (parallel/ring_attention.py) folds into its cross-device
+# online-softmax carry — the kernel is the local block of the ring.
 # --------------------------------------------------------------------------
-def _flash_kernel(block_q, block_k, causal, scale):
-    def kernel(q_ref, k_ref, v_ref, o_ref):
-        # q_ref: (block_q, D); k_ref/v_ref: (T, D); o_ref: (block_q, D)
-        q = q_ref[...].astype(jnp.float32) * scale
-        T = k_ref.shape[0]
-        D = q_ref.shape[1]
+def _flash_kernel(block_q, block_k, causal, scale, partial=False):
+    def kernel(offs_ref, q_ref, k_ref, v_ref, *refs):
+        # offs_ref: scalar-prefetch (2,) int32 — absolute sequence offsets
+        # of this q shard and k shard (zero for self-attention; ring-step
+        # shard offsets in partial mode, where device order = seq order)
+        if partial:
+            o_ref, m_ref, l_ref, m_s, l_s, acc_s = refs
+        else:
+            o_ref, m_s, l_s, acc_s = refs
         qi = pl.program_id(1)
-        m = jnp.full((block_q,), -jnp.inf, jnp.float32)
-        l = jnp.zeros((block_q,), jnp.float32)
-        acc = jnp.zeros((block_q, D), jnp.float32)
+        kb = pl.program_id(2)
+        n_kb = pl.num_programs(2)
 
-        def body(kb, carry):
-            m, l, acc = carry
-            k = k_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-            v = v_ref[pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        @pl.when(kb == 0)
+        def _init():
+            m_s[...] = jnp.full(m_s.shape, -jnp.inf, jnp.float32)
+            l_s[...] = jnp.zeros(l_s.shape, jnp.float32)
+            acc_s[...] = jnp.zeros(acc_s.shape, jnp.float32)
+
+        q_start = offs_ref[0] + qi * block_q
+        k_start = offs_ref[1] + kb * block_k
+
+        def update():
+            q = q_ref[...].astype(jnp.float32) * scale
+            k = k_ref[...].astype(jnp.float32)
+            v = v_ref[...].astype(jnp.float32)
             # HIGHEST: match the XLA composition's f32 accumulation (the
             # default would multiply in bf16 on the MXU)
             s = jnp.dot(q, k.T, precision=jax.lax.Precision.HIGHEST)
             if causal:
-                q_pos = qi * block_q + \
-                    jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k),
-                                             0)
-                k_pos = kb * block_k + \
-                    jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k),
-                                             1)
+                q_pos = q_start + jax.lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 0)
+                k_pos = k_start + jax.lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 1)
                 s = jnp.where(q_pos >= k_pos, s, -jnp.inf)
-            m_blk = jnp.max(s, axis=-1)
+            m = m_s[...]                       # (block_q, 1) f32
+            m_blk = jnp.max(s, axis=-1, keepdims=True)
             m_new = jnp.maximum(m, m_blk)
             m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
-            p = jnp.exp(s - m_safe[:, None])
+            p = jnp.exp(s - m_safe)
             p = jnp.where(jnp.isfinite(s), p, 0.0)
             corr = jnp.where(jnp.isfinite(m), jnp.exp(m - m_safe), 0.0)
-            l_new = l * corr + jnp.sum(p, axis=-1)
-            acc_new = acc * corr[:, None] + jnp.dot(
+            m_s[...] = m_new
+            l_s[...] = l_s[...] * corr + jnp.sum(p, axis=-1, keepdims=True)
+            acc_s[...] = acc_s[...] * corr + jnp.dot(
                 p, v, precision=jax.lax.Precision.HIGHEST)
-            return m_new, l_new, acc_new
 
-        n_kb = T // block_k
         if causal:
-            # K blocks strictly after this query block's last row are
-            # fully masked — skip them instead of exp(-inf)-ing them
-            last_q = (qi + 1) * block_q - 1
-            n_kb = jnp.minimum(n_kb, last_q // block_k + 1)
-        m, l, acc = jax.lax.fori_loop(0, n_kb, body, (m, l, acc))
-        o_ref[...] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(
-            o_ref.dtype)
+            # K blocks wholly above the diagonal contribute nothing —
+            # skip their FLOPs instead of exp(-inf)-ing them
+            pl.when(k_start <= q_start + block_q - 1)(update)
+        else:
+            update()
+
+        @pl.when(kb == n_kb - 1)
+        def _emit():
+            if partial:
+                o_ref[...] = acc_s[...].astype(o_ref.dtype)
+                m_ref[...] = m_s[...]
+                l_ref[...] = l_s[...]
+            else:
+                l = jnp.maximum(l_s[...], 1e-30)
+                o_ref[...] = (acc_s[...] / l).astype(o_ref.dtype)
     return kernel
+
+
+def _flash_call(qf, kf, vf, q_off, k_off, causal, scale, block_q, block_k,
+                partial=False):
+    """Launch the flash kernel on flattened (BH, T, D) operands.
+
+    Returns the normalized output, or in partial mode the unnormalized
+    (acc, m, l) with m/l shaped (BH, Tq, 1) float32.
+    """
+    from jax.experimental.pallas import tpu as pltpu
+
+    BH, Tq, D = qf.shape
+    Tk = kf.shape[1]
+    grid = (BH, Tq // block_q, Tk // block_k)
+    # index maps take the grid ids plus the scalar-prefetch ref (unused)
+    in_specs = [
+        pl.BlockSpec((None, block_q, D), lambda b, i, j, offs: (b, i, 0)),
+        pl.BlockSpec((None, block_k, D), lambda b, i, j, offs: (b, j, 0)),
+        pl.BlockSpec((None, block_k, D), lambda b, i, j, offs: (b, j, 0)),
+    ]
+    o_spec = pl.BlockSpec((None, block_q, D), lambda b, i, j, offs: (b, i, 0))
+    ml_spec = pl.BlockSpec((None, block_q, 1), lambda b, i, j, offs: (b, i, 0))
+    scratch = [pltpu.VMEM((block_q, 1), jnp.float32),
+               pltpu.VMEM((block_q, 1), jnp.float32),
+               pltpu.VMEM((block_q, D), jnp.float32)]
+    # under shard_map (ring attention) outputs vary over the same mesh
+    # axes as the operands — propagate vma so check_vma stays on
+    try:
+        vma = (jax.typeof(qf).vma | jax.typeof(kf).vma
+               | jax.typeof(vf).vma)
+    except (AttributeError, TypeError):
+        vma = None
+
+    def _struct(shape, dtype):
+        if vma is not None:
+            return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+        return jax.ShapeDtypeStruct(shape, dtype)
+
+    if partial:
+        out_shape = [_struct((BH, Tq, D), jnp.float32),
+                     _struct((BH, Tq, 1), jnp.float32),
+                     _struct((BH, Tq, 1), jnp.float32)]
+        out_specs = [o_spec, ml_spec, ml_spec]
+    else:
+        out_shape = _struct((BH, Tq, D), qf.dtype)
+        out_specs = o_spec
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1, grid=grid, in_specs=in_specs,
+        out_specs=out_specs, scratch_shapes=scratch)
+    offs = jnp.stack([jnp.asarray(q_off, jnp.int32),
+                      jnp.asarray(k_off, jnp.int32)])
+    if vma:
+        # match the tensor operands' varying axes (pallas requires all
+        # operands to agree under shard_map's check_vma)
+        missing = tuple(vma - jax.typeof(offs).vma)
+        if missing:
+            offs = jax.lax.pvary(offs, missing)
+    return pallas_call(
+        _flash_kernel(block_q, block_k, causal, scale, partial),
+        out_shape=out_shape, grid_spec=grid_spec)(offs, qf, kf, vf)
+
+
+def flash_attention_partial(q, k, v, q_off, k_off, causal=False,
+                            block_q=128, block_k=128, scale=None):
+    """Unnormalized flash attention block for ring composition.
+
+    q: (B, H, Tq, D) local query shard; k/v: (B, H, Tk, D) the K/V shard
+    currently held. ``q_off``/``k_off`` are the shards' absolute sequence
+    offsets (traced values are fine — they ride the kernel's scalar
+    prefetch). Returns (acc, m, l): acc (B,H,Tq,D) f32 unnormalized,
+    m/l (B,H,Tq) f32 running max / normalizer — exactly the carry terms
+    of the online softmax, mergeable across shards.
+    """
+    B, H, Tq, D = q.shape
+    Tk = k.shape[2]
+    block_q = min(block_q, Tq)
+    block_k = min(block_k, Tk)
+    if Tq % block_q or Tk % block_k:
+        raise MXNetError("flash_attention_partial: T must divide blocks")
+    if scale is None:
+        scale = 1.0 / float(np.sqrt(D))
+    acc, m, l = _flash_call(
+        q.reshape(B * H, Tq, D), k.reshape(B * H, Tk, D),
+        v.reshape(B * H, Tk, D), q_off, k_off, causal, scale,
+        block_q, block_k, partial=True)
+    return (acc.reshape(B, H, Tq, D), m.reshape(B, H, Tq),
+            l.reshape(B, H, Tq))
 
 
 def flash_attention(q, k, v, causal=False, block_q=128, block_k=128):
@@ -381,19 +490,9 @@ def flash_attention(q, k, v, causal=False, block_q=128, block_k=128):
 
     @jax.custom_vjp
     def _flash(q, k, v):
-        qf = q.reshape(B * H, T, D)
-        kf = k.reshape(B * H, T, D)
-        vf = v.reshape(B * H, T, D)
-        out = pallas_call(
-            _flash_kernel(block_q, block_k, causal, scale),
-            out_shape=jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
-            grid=(B * H, T // block_q),
-            in_specs=[pl.BlockSpec((None, block_q, D),
-                                   lambda b, i: (b, i, 0)),
-                      pl.BlockSpec((None, T, D), lambda b, i: (b, 0, 0)),
-                      pl.BlockSpec((None, T, D), lambda b, i: (b, 0, 0))],
-            out_specs=pl.BlockSpec((None, block_q, D),
-                                   lambda b, i: (b, i, 0)))(qf, kf, vf)
+        out = _flash_call(
+            q.reshape(B * H, T, D), k.reshape(B * H, T, D),
+            v.reshape(B * H, T, D), 0, 0, causal, scale, block_q, block_k)
         return out.reshape(B, H, T, D)
 
     def fwd(q, k, v):
