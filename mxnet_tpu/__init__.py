@@ -52,5 +52,7 @@ from . import rtc
 _op_gen.init_ndarray_module(ndarray.__dict__)
 symbol._init_symbol_module(symbol.__dict__)
 from . import image
+from . import predict
+from .predict import export_model, Predictor
 
 __version__ = "0.1.0"

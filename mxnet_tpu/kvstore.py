@@ -217,28 +217,54 @@ class KVStoreDistSync(KVStore):
         if self._mesh is not None:
             return
         from jax.sharding import Mesh, PartitionSpec, NamedSharding
-        # one device per process: the reduction result is replicated
-        # host-side anyway, and a 1-device-per-proc mesh keeps the
-        # host-local <-> global layout trivial on any pod shape
-        devs = []
-        for p in range(self._nproc):
-            devs.append(next(d for d in jax.devices()
-                             if d.process_index == p))
-        self._mesh = Mesh(np.array(devs), ("proc",))
+        # (process x local-device) mesh: every chip on every host joins
+        # the reduction — the analog of the reference's dist_device_sync
+        # (local GPU reduce + PS across nodes, comm.h:289-361). The
+        # buffer is split over the local axis, so each local device
+        # reduces (and moves over DCN) only its slice, multiplying
+        # cross-host bandwidth by the local device count.
+        by_proc = {}
+        for d in sorted(jax.devices(), key=lambda d: (d.process_index,
+                                                      d.id)):
+            by_proc.setdefault(d.process_index, []).append(d)
+        counts = {len(v) for v in by_proc.values()}
+        if len(counts) != 1:
+            raise MXNetError(
+                f"uneven local device counts across processes: "
+                f"{sorted(counts)}")
+        self._local = counts.pop()
+        devs = np.array([by_proc[p] for p in range(self._nproc)])
+        self._mesh = Mesh(devs, ("proc", "dev"))
         self._pspec = PartitionSpec
         self._sum_jit = jax.jit(
             lambda x: jnp.sum(x, axis=0),
-            out_shardings=NamedSharding(self._mesh, PartitionSpec()))
+            out_shardings=NamedSharding(self._mesh,
+                                        PartitionSpec("dev")))
 
     def _allreduce_flat(self, flat):
-        """All-reduce one 1-D buffer across processes (jitted psum)."""
+        """All-reduce one 1-D buffer across all devices of all processes.
+
+        Layout: pad to a multiple of the local device count L, view as
+        (1, L, chunk) sharded (proc, dev), sum over proc with the result
+        sharded over dev; every process then reassembles the full
+        reduced buffer from its own local shards (replicated-across-proc
+        output).
+        """
         from jax.experimental import multihost_utils
         self._ensure_mesh()
+        n = flat.shape[0]
+        pad = (-n) % self._local
+        if pad:
+            flat = jnp.concatenate(
+                [flat, jnp.zeros((pad,), flat.dtype)])
+        x = flat.reshape(1, self._local, -1)
         glob = multihost_utils.host_local_array_to_global_array(
-            flat[None], self._mesh, self._pspec("proc"))
+            x, self._mesh, self._pspec("proc", "dev"))
         red = self._sum_jit(glob)
-        return multihost_utils.global_array_to_host_local_array(
-            red, self._mesh, self._pspec())
+        loc = multihost_utils.global_array_to_host_local_array(
+            red, self._mesh, self._pspec("dev"))
+        out = jnp.ravel(loc)
+        return out[:n] if pad else out
 
     def _allreduce(self, arrs):
         """Batched all-reduce: bucket same-dtype arrays into flat buffers

@@ -209,7 +209,11 @@ class DataParallelExecutorGroup:
         runner = exe._runner
         loss_mask = exe._loss_mask
 
-        def step(w, rest, aux_vals, rng, states, lrs, wds):
+        # lr/wd arrive as TWO stacked f32 arrays, not 2x161 python
+        # scalars: scalar jit args each become their own host->device
+        # transfer per dispatch, which through a remote chip is hundreds
+        # of tiny RPCs per step
+        def step(w, rest, aux_vals, rng, states, lr_arr, wd_arr):
             def f(wv):
                 return runner({**rest, **wv}, aux_vals, True, rng)
 
@@ -219,10 +223,10 @@ class DataParallelExecutorGroup:
                      for o, is_loss in zip(outs, loss_mask)]
             (grads,) = vjp_fn(heads)
             new_w, new_states = {}, {}
-            for nm in watched:
+            for i, nm in enumerate(watched):
                 nw, ns = update(w[nm],
                                 grads[nm].astype(w[nm].dtype),
-                                states[nm], lrs[nm], wds[nm])
+                                states[nm], lr_arr[i], wd_arr[i])
                 new_w[nm] = nw
                 new_states[nm] = ns
             return outs, new_aux, new_w, new_states, grads
@@ -263,9 +267,13 @@ class DataParallelExecutorGroup:
 
         arg_vals = exe._arg_vals()
         w = {nm: arg_vals.pop(nm) for nm in self._fused_watched}
+        lr_arr = jnp.asarray([lrs[nm] for nm in self._fused_watched],
+                             jnp.float32)
+        wd_arr = jnp.asarray([wds[nm] for nm in self._fused_watched],
+                             jnp.float32)
         outs, new_aux, new_w, new_states, grads = self._fused_prog(
             w, arg_vals, exe._aux_vals(), _random.next_key(),
-            self._fused_states, lrs, wds)
+            self._fused_states, lr_arr, wd_arr)
         self._fused_states = new_states
         ad = exe.arg_dict
         for nm in self._fused_watched:

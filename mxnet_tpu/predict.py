@@ -1,0 +1,196 @@
+"""Deployment surface: ahead-of-time export + standalone predictor.
+
+Reference counterpart: the C predict API
+(reference: src/c_api/c_predict_api.cc:1-334,
+include/mxnet/c_predict_api.h:1-210) — ``MXPredCreate(symbol_json,
+param_bytes, input_shapes)`` builds a self-contained inference executor
+from serialized artifacts, ``MXPredForward``/``MXPredGetOutput`` run it;
+the amalgamation build ships exactly this surface for serving/mobile.
+
+TPU-native realization: ``export_model`` traces the bound inference
+graph once and serializes the compiled program via ``jax.export``
+(StableHLO, multi-platform cpu+tpu) into a single ``.mxp`` archive
+together with the reference-format ``.params`` bytes and a JSON
+manifest. ``Predictor`` loads the archive and runs it WITHOUT the
+Symbol/Module stack: no graph rebuilding, no re-tracing, no
+initializers — deserialize, bind params, call. Shapes are fixed at
+export time (the reference's MXPredReshape analog is re-exporting at
+the new shapes).
+
+Round-trip contract (tests/test_predict.py): Predictor outputs ==
+``Module.predict`` outputs for the same params, including from a fresh
+process that never touches mx.sym/mx.mod.
+"""
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import zipfile
+
+import numpy as np
+
+from .base import MXNetError
+
+_FORMAT_VERSION = 1
+_PROGRAM = "program.stablehlo"
+_MANIFEST = "manifest.json"
+_PARAMS = "weights.params"
+
+
+def export_model(path, symbol, arg_params, aux_params, data_shapes,
+                 compute_dtype=None):
+    """Serialize an inference program for ``symbol`` to ``path``.
+
+    ``data_shapes``: dict input name -> shape (the non-parameter inputs,
+    like MXPredCreate's input_shapes). ``arg_params``/``aux_params``:
+    trained parameters (NDArray or array-like). ``compute_dtype``:
+    optional mixed-precision compute dtype (e.g. jnp.bfloat16) baked
+    into the exported program.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import export as jexport
+    from .executor import _build_graph_runner
+    from .ndarray import NDArray, save as nd_save
+
+    data_shapes = {k: tuple(v) for k, v in data_shapes.items()}
+    runner, arg_names, aux_names, _ = _build_graph_runner(
+        symbol, compute_dtype=compute_dtype)
+    param_names = [n for n in arg_names
+                   if n not in data_shapes and n in arg_params]
+    # declared-but-untrained inputs (loss-head labels) are zero-filled,
+    # exactly like Module.predict's unbound labels; their shapes come
+    # from inference against the data shapes
+    zero_names = [n for n in arg_names
+                  if n not in data_shapes and n not in arg_params]
+    zeros = {}
+    if zero_names:
+        inferred, _, _ = symbol.infer_shape(**data_shapes)
+        by_name = dict(zip(symbol.list_arguments(), inferred))
+        for n in zero_names:
+            s = by_name.get(n)
+            if s is None:
+                raise MXNetError(
+                    f"export_model: no params and no inferable shape "
+                    f"for input {n!r}")
+            zeros[n] = jnp.zeros(s, jnp.float32)
+
+    def _val(p):
+        return p.asjax() if isinstance(p, NDArray) else jnp.asarray(p)
+
+    params = {n: _val(arg_params[n]) for n in param_names}
+    params.update(zeros)
+    param_names = param_names + zero_names
+    aux = {n: _val((aux_params or {})[n]) for n in aux_names}
+
+    def infer(params, aux, data):
+        args = {**params, **data}
+        outs, _ = runner(args, aux, False, jax.random.PRNGKey(0))
+        return outs
+
+    data_example = {n: jnp.zeros(s, jnp.float32)
+                    for n, s in data_shapes.items()}
+    exported = jexport.export(
+        jax.jit(infer), platforms=("cpu", "tpu"))(params, aux,
+                                                  data_example)
+    blob = exported.serialize()
+
+    manifest = {
+        "format_version": _FORMAT_VERSION,
+        "inputs": {n: list(s) for n, s in data_shapes.items()},
+        "param_names": param_names,
+        "aux_names": aux_names,
+        "output_names": symbol.list_outputs(),
+        "compute_dtype": None if compute_dtype is None else
+        np.dtype(compute_dtype).name,
+    }
+
+    with tempfile.TemporaryDirectory() as td:
+        pfile = os.path.join(td, "w.params")
+        nd_save(pfile, {**{f"arg:{n}": NDArray(v)
+                           for n, v in params.items()},
+                        **{f"aux:{n}": NDArray(v)
+                           for n, v in aux.items()}})
+        with zipfile.ZipFile(path, "w") as z:
+            z.writestr(_MANIFEST, json.dumps(manifest, indent=1))
+            z.writestr(_PROGRAM, bytes(blob))
+            z.write(pfile, _PARAMS)
+    return path
+
+
+class Predictor:
+    """Load-and-run inference from an exported ``.mxp`` artifact.
+
+    API mirrors the reference predict API's create/forward/get_output
+    cycle (c_predict_api.h: MXPredCreate, MXPredSetInput/Forward,
+    MXPredGetOutput). Only the array container and the deserialized
+    program are touched — never the Symbol/Module stack.
+    """
+
+    def __init__(self, path, device=None):
+        import jax
+        from jax import export as jexport
+        from .ndarray import load as nd_load
+
+        with zipfile.ZipFile(path) as z:
+            self._manifest = json.loads(z.read(_MANIFEST))
+            if self._manifest["format_version"] != _FORMAT_VERSION:
+                raise MXNetError(
+                    f"unsupported artifact version "
+                    f"{self._manifest['format_version']}")
+            blob = z.read(_PROGRAM)
+            with tempfile.TemporaryDirectory() as td:
+                pfile = os.path.join(td, "w.params")
+                with open(pfile, "wb") as f:
+                    f.write(z.read(_PARAMS))
+                loaded = nd_load(pfile)
+        self._exported = jexport.deserialize(bytearray(blob))
+        dev = device.jax_device() if hasattr(device, "jax_device") else \
+            device
+        if dev is None:
+            dev = jax.devices()[0]
+
+        def put(arr):
+            return jax.device_put(arr.asjax(), dev)
+
+        self._params = {n: put(loaded[f"arg:{n}"])
+                        for n in self._manifest["param_names"]}
+        self._aux = {n: put(loaded[f"aux:{n}"])
+                     for n in self._manifest["aux_names"]}
+        self._outputs = None
+
+    @property
+    def output_names(self):
+        return list(self._manifest["output_names"])
+
+    @property
+    def input_shapes(self):
+        return {n: tuple(s) for n, s in self._manifest["inputs"].items()}
+
+    def forward(self, **inputs):
+        """Run the exported program; returns the output list."""
+        import jax.numpy as jnp
+        from .ndarray import NDArray
+
+        data = {}
+        for n, shape in self.input_shapes.items():
+            if n not in inputs:
+                raise MXNetError(f"missing input {n!r}")
+            v = inputs[n]
+            v = v.asjax() if isinstance(v, NDArray) else jnp.asarray(
+                v, jnp.float32)
+            if tuple(v.shape) != shape:
+                raise MXNetError(
+                    f"input {n!r}: shape {tuple(v.shape)} != exported "
+                    f"{shape} (re-export to reshape, like MXPredReshape)")
+            data[n] = v
+        outs = self._exported.call(self._params, self._aux, data)
+        self._outputs = [NDArray(o) for o in outs]
+        return self._outputs
+
+    def get_output(self, index=0):
+        """reference: MXPredGetOutput — output of the last forward."""
+        if self._outputs is None:
+            raise MXNetError("call forward() first")
+        return self._outputs[index]

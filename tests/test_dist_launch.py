@@ -16,10 +16,19 @@ pytestmark = pytest.mark.slow
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _launch(nworkers, script="dist_sync_worker.py", timeout=600):
+def _launch(nworkers, script="dist_sync_worker.py", timeout=600,
+            local_devices=None):
     env = dict(os.environ)
     env.pop("DMLC_NUM_WORKER", None)  # never inherit stale cluster env
     env.pop("DMLC_WORKER_ID", None)
+    if local_devices:
+        # give every worker process its own multi-device view — the
+        # dist_device_sync topology (N hosts x L chips each)
+        env["XLA_FLAGS"] = (
+            env.get("XLA_FLAGS", "").replace(
+                "--xla_force_host_platform_device_count=8", "").strip()
+            + f" --xla_force_host_platform_device_count={local_devices}"
+        ).strip()
     # own session so a timeout can kill the whole tree: worker
     # grandchildren inherit the stdout pipe, and killing only the
     # launcher would leave communicate() blocked on the open write ends
@@ -51,6 +60,17 @@ def test_dist_sync_invariant_multiprocess(nworkers):
         res.stdout[-2000:], res.stderr[-2000:])
     for rank in range(nworkers):
         assert f"rank={rank} nworker={nworkers}" in res.stdout
+
+
+def test_dist_sync_invariant_multidevice():
+    """2 processes x 4 local devices: the kvstore reduction must ride a
+    (proc, dev) mesh — every local device reduces a slice of the buffer
+    (the reference dist_device_sync topology, comm.h:289-361) — and
+    still satisfy the same nightly arithmetic invariant."""
+    res = _launch(2, local_devices=4)
+    assert res.returncode == 0, (res.stdout[-2000:], res.stderr[-2000:])
+    assert res.stdout.count("DIST_SYNC_OK") == 2, (
+        res.stdout[-2000:], res.stderr[-2000:])
 
 
 @pytest.mark.parametrize("nworkers", [2, 4])

@@ -1,0 +1,123 @@
+"""Deployment/predict surface (mxnet_tpu/predict.py).
+
+Reference parity target: the standalone predict API
+(src/c_api/c_predict_api.cc:1-334) — build from serialized artifacts,
+run inference without the training stack. Gates: (a) Predictor output
+== Module.predict bitwise-close, (b) the artifact loads and runs in a
+FRESH subprocess that never constructs a Symbol or Module, (c) shape
+mismatches error per the fixed-shape contract.
+"""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.models import lenet
+
+
+def _trained_module(batch=8):
+    net = lenet.get_symbol(num_classes=4)
+    it = mx.io.NDArrayIter(
+        np.random.rand(32, 1, 28, 28).astype(np.float32),
+        (np.random.rand(32) * 4).astype(np.float32), batch)
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.fit(it, num_epoch=1, initializer=mx.initializer.Xavier(),
+            optimizer_params={"learning_rate": 0.01})
+    return net, mod
+
+
+def test_export_roundtrip_matches_module_predict(tmp_path):
+    net, mod = _trained_module()
+    arg_params, aux_params = mod.get_params()
+    path = str(tmp_path / "lenet.mxp")
+    mx.export_model(path, net, arg_params, aux_params,
+                    {"data": (8, 1, 28, 28)})
+
+    x = np.random.rand(8, 1, 28, 28).astype(np.float32)
+    it = mx.io.NDArrayIter(x, None, 8)
+    expect = mod.predict(it).asnumpy()
+
+    pred = mx.Predictor(path)
+    assert pred.output_names == net.list_outputs()
+    got = pred.forward(data=x)[0].asnumpy()
+    np.testing.assert_allclose(got, expect, rtol=1e-5, atol=1e-6)
+    # get_output mirrors MXPredGetOutput
+    np.testing.assert_allclose(pred.get_output(0).asnumpy(), got)
+
+
+def test_predictor_runs_in_fresh_process(tmp_path):
+    """The artifact must be servable by a process that never builds a
+    Symbol/Module (the reference's deployment story: amalgamated predict
+    lib + params blob)."""
+    net, mod = _trained_module()
+    arg_params, aux_params = mod.get_params()
+    path = str(tmp_path / "lenet.mxp")
+    mx.export_model(path, net, arg_params, aux_params,
+                    {"data": (8, 1, 28, 28)})
+    x = np.random.rand(8, 1, 28, 28).astype(np.float32)
+    np.save(str(tmp_path / "x.npy"), x)
+    it = mx.io.NDArrayIter(x, None, 8)
+    expect = mod.predict(it).asnumpy()
+    np.save(str(tmp_path / "expect.npy"), expect)
+
+    script = f"""
+import jax
+jax.config.update("jax_platforms", "cpu")   # site hook may pin a TPU
+import numpy as np
+from mxnet_tpu.predict import Predictor
+import mxnet_tpu.symbol as _sym_mod
+import mxnet_tpu.module as _mod_mod
+# prove the loader path itself never constructs graph objects
+_sym_mod.Symbol.__init__ = lambda *a, **k: (_ for _ in ()).throw(
+    RuntimeError("Symbol constructed in predictor process"))
+p = Predictor({str(tmp_path / 'lenet.mxp')!r})
+x = np.load({str(tmp_path / 'x.npy')!r})
+out = p.forward(data=x)[0].asnumpy()
+expect = np.load({str(tmp_path / 'expect.npy')!r})
+np.testing.assert_allclose(out, expect, rtol=1e-5, atol=1e-6)
+print("PREDICTOR_SUBPROCESS_OK")
+"""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run([sys.executable, "-c", script],
+                       capture_output=True, text=True, env=env,
+                       cwd=os.path.dirname(os.path.dirname(
+                           os.path.abspath(__file__))))
+    assert "PREDICTOR_SUBPROCESS_OK" in r.stdout, r.stderr[-2000:]
+
+
+def test_predictor_rejects_wrong_shape(tmp_path):
+    net, mod = _trained_module()
+    arg_params, aux_params = mod.get_params()
+    path = str(tmp_path / "lenet.mxp")
+    mx.export_model(path, net, arg_params, aux_params,
+                    {"data": (8, 1, 28, 28)})
+    pred = mx.Predictor(path)
+    with pytest.raises(mx.base.MXNetError):
+        pred.forward(data=np.zeros((4, 1, 28, 28), np.float32))
+
+
+@pytest.mark.slow
+def test_export_resnet50(tmp_path):
+    """Flagship round-trip (VERDICT r3 #4: 'export ResNet-50, reload,
+    outputs match Module.predict') at a reduced image size so the CPU
+    trace stays test-sized."""
+    from mxnet_tpu.models import resnet
+    net = resnet.get_symbol(num_classes=10, num_layers=50,
+                            image_shape="3,32,32")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind([("data", (4, 3, 32, 32))], [("softmax_label", (4,))],
+             for_training=False)
+    mod.init_params(mx.initializer.Xavier())
+    arg_params, aux_params = mod.get_params()
+    path = str(tmp_path / "resnet50.mxp")
+    mx.export_model(path, net, arg_params, aux_params,
+                    {"data": (4, 3, 32, 32)})
+    x = np.random.rand(4, 3, 32, 32).astype(np.float32)
+    it = mx.io.NDArrayIter(x, None, 4)
+    expect = mod.predict(it).asnumpy()
+    got = mx.Predictor(path).forward(data=x)[0].asnumpy()
+    np.testing.assert_allclose(got, expect, rtol=1e-4, atol=1e-5)
