@@ -47,8 +47,7 @@ def init_distributed():
     n = int(os.environ.get("DMLC_NUM_WORKER", "1"))
     if n <= 1:
         return
-    import jax._src.distributed as _dist
-    if _dist.global_state.client is not None:
+    if jax.distributed.is_initialized():
         return                               # already connected
     rank = int(os.environ.get("DMLC_WORKER_ID", "0"))
     uri = os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1")
@@ -58,14 +57,52 @@ def init_distributed():
         # multi-process CPU collectives need the gloo transport; must be
         # configured before the backend initializes
         jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    # ps-lite reads PS_HEARTBEAT_TIMEOUT (seconds) for its failure
+    # detector (reference: ps-lite/src/van.cc heartbeat handling); honor
+    # the same knob for the coordination service's liveness tracking.
+    heartbeat = int(os.environ.get("PS_HEARTBEAT_TIMEOUT", "100"))
+    # Failure-handling mode. Default (fail-fast): JAX's error-polling
+    # thread terminates every survivor the moment a peer misses its
+    # heartbeat — the NCCL-abort analog, right for fit-and-restart jobs.
+    # MXNET_KVSTORE_RECOVERABLE=1 selects ps-lite semantics instead: a
+    # peer death is *reported* (get_num_dead_node, reference
+    # kvstore_dist.h GetDeadNodes) and survivors keep running so they can
+    # checkpoint/re-form; without the flag the fatal propagation would
+    # make get_num_dead_node unobservable.
+    if os.environ.get("MXNET_KVSTORE_RECOVERABLE", "0") == "1":
+        jax.config.update("jax_enable_recoverability", True)
     jax.distributed.initialize(coordinator_address=f"{uri}:{port}",
-                               num_processes=n, process_id=rank)
+                               num_processes=n, process_id=rank,
+                               heartbeat_timeout_seconds=heartbeat)
     if jax.process_count() != n:
         raise MXNetError(
             f"distributed init came up with {jax.process_count()} "
             f"processes, expected {n}: the backend was initialized before "
             "init_distributed() — create the dist kvstore before touching "
             "any device")
+
+
+def _coordination_client():
+    """Handle to the coordination-service client, or None.
+
+    JAX exposes no public liveness query, so this is the one sanctioned
+    private touchpoint (everything else uses the public
+    ``jax.distributed`` API). Guarded so a JAX upgrade that moves the
+    internals degrades to a loud error rather than a silent wrong answer.
+    """
+    if not jax.distributed.is_initialized():
+        return None
+    try:
+        from jax._src import distributed as _dist
+        client = getattr(_dist.global_state, "client", None)
+    except ImportError:
+        client = None
+    if client is None or not hasattr(client, "get_live_nodes"):
+        raise MXNetError(
+            "jax.distributed is initialized but the coordination-service "
+            "client is not reachable at jax._src.distributed.global_state."
+            "client (JAX internals moved?); liveness queries unavailable")
+    return client
 
 
 def _ctype_key_value(key, vals):
@@ -316,6 +353,15 @@ class KVStoreDistSync(KVStore):
         else:
             reduced = [a for _, _, a in merged]
         for (k, ctx, _), red in zip(merged, reduced):
+            # The bucketed all-reduce hands back each value sharded over the
+            # local `dev` mesh axis (bandwidth layout). The store replica and
+            # its optimizer state live wherever the user placed the weight —
+            # re-place the reduced gradient there so the updater's inputs are
+            # colocated (the analog of the reference copying the merged
+            # buffer back to each GPU, comm.h Broadcast).
+            store_sharding = self._store[k].asjax().sharding
+            if red.sharding != store_sharding:
+                red = jax.device_put(red, store_sharding)
             nd_val = NDArray(red, ctx=ctx)
             if self._updater is not None:
                 self._updater(k, nd_val, self._store[k])
@@ -337,8 +383,7 @@ class KVStoreDistSync(KVStore):
         applies its own heartbeat timeout."""
         if self._nproc <= 1:
             return 0
-        import jax._src.distributed as _dist
-        client = _dist.global_state.client
+        client = _coordination_client()
         if client is None:
             return 0
         live = client.get_live_nodes(list(range(self._nproc)))

@@ -1,0 +1,44 @@
+"""Failure-detection worker: the last rank dies; survivors must see it.
+
+reference: tests/nightly's failure path + kvstore_dist.h:159-168
+(GetDeadNodes over ps-lite heartbeats). Here the coordination service is
+the failure detector: a peer that stops heartbeating (or whose connection
+drops) shows up in get_num_dead_node() on every surviving rank.
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+os.environ.setdefault("PS_HEARTBEAT_TIMEOUT", "5")
+os.environ["MXNET_KVSTORE_RECOVERABLE"] = "1"   # survive the peer death
+
+import mxnet_tpu as mx  # noqa: E402
+
+
+def main():
+    kv = mx.kv.create("dist_sync")
+    rank, nworker = kv.rank, kv.num_workers
+    kv._barrier()                    # everyone fully up before the kill
+    if rank == nworker - 1:
+        os._exit(17)                 # die without shutdown: the failure
+    dead = 0
+    for _ in range(30):              # detector needs a beat to notice
+        time.sleep(1)
+        dead = kv.get_num_dead_node()
+        if dead > 0:
+            break
+    print(f"DEAD_NODE_SEEN rank={rank} dead={dead}", flush=True)
+    # exit without the shutdown barrier: the dead peer would fail it, and
+    # the point of this gate is the detection, not a clean teardown
+    os._exit(0 if dead > 0 else 1)
+
+
+if __name__ == "__main__":
+    main()
