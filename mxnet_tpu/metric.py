@@ -57,28 +57,50 @@ class EvalMetric:
 
     ``sum_metric`` / ``num_inst`` keep the reference's attribute names —
     downstream code (and the reference's own tests) poke them directly.
+    They are flushing properties: reading either drains any queued
+    device-side accumulations first, so direct reads never undercount.
     """
 
     def __init__(self, name, num=None):
         self.name = name
         self.num = num
+        self._pending = []        # before reset(): subclasses override it
         self.reset()
 
     def reset(self):
         if self.num is None:
-            self.sum_metric, self.num_inst = 0.0, 0
+            self._sum_metric, self._num_inst = 0.0, 0
         else:
-            self.sum_metric = [0.0] * self.num
-            self.num_inst = [0] * self.num
+            self._sum_metric = [0.0] * self.num
+            self._num_inst = [0] * self.num
         self._pending = []        # device-lazy (total, count) pairs
+
+    # reference-parity attributes; reads flush queued device scalars
+    @property
+    def sum_metric(self):
+        self._flush()
+        return self._sum_metric
+
+    @sum_metric.setter
+    def sum_metric(self, value):
+        self._sum_metric = value
+
+    @property
+    def num_inst(self):
+        self._flush()
+        return self._num_inst
+
+    @num_inst.setter
+    def num_inst(self, value):
+        self._num_inst = value
 
     def _accumulate(self, total, count, index=None):
         if index is None:
-            self.sum_metric += total
-            self.num_inst += count
+            self._sum_metric += total
+            self._num_inst += count
         else:
-            self.sum_metric[index] += total
-            self.num_inst[index] += count
+            self._sum_metric[index] += total
+            self._num_inst[index] += count
 
     def _accumulate_device(self, total_dev, count):
         """Accumulate a device-resident scalar WITHOUT synchronizing.
@@ -91,10 +113,13 @@ class EvalMetric:
         (``get``) synchronizes, once, fetching all queued scalars in a
         single transfer batch.
         """
+        assert self.num is None, (
+            "_accumulate_device supports single-output metrics only "
+            "(multi-output sum_metric is a list; use _accumulate)")
         self._pending.append((total_dev, count))
 
     def _flush(self):
-        if not self._pending:
+        if not getattr(self, "_pending", None):
             return
         import jax
         pend, self._pending = self._pending, []

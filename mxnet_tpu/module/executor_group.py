@@ -294,13 +294,21 @@ class DataParallelExecutorGroup:
     def set_params(self, arg_params, aux_params):
         """reference: executor_group.py set_params -> copy into the bound
         arrays, preserving sharded placement."""
+        fused = getattr(self, "_fused_prog", None) is not None
         ad = self.executor.arg_dict
         for name, arr in arg_params.items():
             if name in ad:
                 val = arr.asjax() if isinstance(arr, NDArray) \
                     else jnp.asarray(arr)
-                ad[name]._set(self._place(val.astype(ad[name].dtype),
-                                          "param"))
+                val = self._place(val.astype(ad[name].dtype), "param")
+                if fused and name in self._fused_watched:
+                    # the fused step donates its param inputs; astype/
+                    # device_put are identity when dtype+placement already
+                    # match, which would alias the caller's buffer into a
+                    # donated argument — force exclusive ownership, same
+                    # as the arming-time copy
+                    val = jnp.array(val, copy=True)
+                ad[name]._set(val)
         xd = self.executor.aux_dict
         for name, arr in (aux_params or {}).items():
             if name in xd:

@@ -26,6 +26,7 @@ overrides the worker count (0 disables the multiprocess path).
 """
 from __future__ import annotations
 
+import collections
 import json
 import os
 import struct
@@ -146,6 +147,12 @@ class MPImageRecordIter(DataIter):
                 stderr=ef, text=True))
             ef.close()
         self._inflight = []               # [(pad, [(worker, slot, n)])]
+        # per-worker FIFO of slots awaiting a reply: every reply is
+        # matched against the slot it was dispatched for, and error/reset
+        # paths drain each stream exactly — otherwise a partially-read
+        # batch would desynchronize replies from slots and the parent
+        # could copy a slot the worker hasn't confirmed writing
+        self._pending = [collections.deque() for _ in range(self._W)]
         self._cursor = 0
         self._order = None
         self.reset()
@@ -178,6 +185,7 @@ class MPImageRecordIter(DataIter):
                     f"decode worker {wi} died "
                     f"(rc={self._procs[wi].poll()}): "
                     f"{self._worker_stderr(wi)}")
+            self._pending[wi].append(slot)
             shards.append((wi, slot, len(shard)))
         self._inflight.append((pad, shards))
         return True
@@ -192,13 +200,12 @@ class MPImageRecordIter(DataIter):
                           dtype=np.float32)
         row = 0
         for wi, slot, n in shards:
-            line = self._procs[wi].stdout.readline()
-            if not line:
+            rep = self._read_reply(wi)
+            if rep.get("slot") != slot:
                 raise MXNetError(
-                    f"decode worker {wi} died (rc="
-                    f"{self._procs[wi].poll()}): "
-                    f"{self._worker_stderr(wi)}")
-            rep = json.loads(line)
+                    f"decode worker {wi} reply for slot "
+                    f"{rep.get('slot')} but slot {slot} expected — "
+                    "parent/worker streams desynchronized")
             if "error" in rep:
                 raise MXNetError(f"decode worker {wi}: {rep['error']}")
             base = slot * self._slot_floats
@@ -212,6 +219,19 @@ class MPImageRecordIter(DataIter):
             labels[row:row + n] = labs[:n]
             row += n
         return data, labels, pad
+
+    def _read_reply(self, wi):
+        """Read one reply line from worker wi and retire its oldest
+        pending slot; the caller validates the echoed slot id."""
+        line = self._procs[wi].stdout.readline()
+        if not line:
+            raise MXNetError(
+                f"decode worker {wi} died (rc="
+                f"{self._procs[wi].poll()}): "
+                f"{self._worker_stderr(wi)}")
+        if self._pending[wi]:
+            self._pending[wi].popleft()
+        return json.loads(line)
 
     def _worker_stderr(self, wi, tail=500):
         try:
@@ -234,11 +254,13 @@ class MPImageRecordIter(DataIter):
         return [DataDesc(self.label_name, shape)]
 
     def reset(self):
-        # drain in-flight work so slots are quiescent before reordering
-        while self._inflight:
-            pad, shards = self._inflight.pop(0)
-            for wi, _, _ in shards:
-                self._procs[wi].stdout.readline()
+        # drain every outstanding reply (not just whole in-flight
+        # batches: an error may have left a batch partially read) so
+        # slots are quiescent before reordering
+        for wi in range(self._W):
+            while self._pending[wi]:
+                self._read_reply(wi)
+        self._inflight.clear()
         n = len(self._offsets)
         if self._shuffle:
             rng = np.random.default_rng(self._seed + self._epoch)

@@ -23,9 +23,15 @@ import mxnet_tpu as mx  # noqa: E402
 
 
 def main():
+    from mxnet_tpu.kvstore import _coordination_client
     kv = mx.kv.create("dist_sync")
     rank, nworker = kv.rank, kv.num_workers
-    kv._barrier()                    # everyone fully up before the kill
+    # rendezvous through the coordination service, NOT a gloo collective
+    # (kv._barrier): the doomed rank exits the moment its barrier call
+    # returns, and tearing down gloo connections while a peer's
+    # collective is still in flight aborts that peer before it can
+    # observe the death
+    _coordination_client().wait_at_barrier("dead_node_ready", 60_000)
     if rank == nworker - 1:
         os._exit(17)                 # die without shutdown: the failure
     dead = 0
@@ -35,6 +41,16 @@ def main():
         if dead > 0:
             break
     print(f"DEAD_NODE_SEEN rank={rank} dead={dead}", flush=True)
+    # survivors rendezvous (subset barrier: the dead rank excluded)
+    # before exiting — rank 0 hosts the coordination service, and its
+    # exit would kill the other survivors' detection mid-flight
+    _coordination_client().wait_at_barrier(
+        "dead_node_done", 60_000, list(range(nworker - 1)))
+    if rank == 0:
+        # rank 0 hosts the coordination service: linger so the other
+        # survivors reach their os._exit before the coordinator vanishes
+        # (a socket close mid-exit would abort them with rc!=0)
+        time.sleep(3)
     # exit without the shutdown barrier: the dead peer would fail it, and
     # the point of this gate is the detection, not a clean teardown
     os._exit(0 if dead > 0 else 1)

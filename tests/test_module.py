@@ -295,6 +295,32 @@ def test_fused_step_optimizer_state_roundtrip():
         np.testing.assert_allclose(before[k], after[k])
 
 
+def test_set_params_after_arming_does_not_donate_caller_buffer():
+    """set_params after the fused step is armed must copy: astype/
+    device_put are identity when dtype+placement match, and the next
+    step's donation would otherwise delete a buffer the caller holds."""
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    mod.bind([("data", (4, 6))], [("softmax_label", (4,))])
+    mod.init_params(initializer=mx.initializer.Xavier())
+    mod.init_optimizer(kvstore=None, optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.1),))
+    assert mod._fused_armed
+    rs = np.random.RandomState(7)
+    batch = mx.io.DataBatch(
+        data=[mx.nd.array(rs.rand(4, 6).astype(np.float32))],
+        label=[mx.nd.array(rs.randint(0, 3, (4,)).astype(np.float32))])
+    mod.forward_backward(batch)
+    mod.update()
+    # caller-held arrays, already in matching dtype/placement
+    args, aux = mod.get_params()
+    held = {k: v.asjax() for k, v in args.items()}
+    mod.set_params(args, aux)
+    mod.forward_backward(batch)          # donated step runs again
+    mod.update()
+    for k, v in held.items():            # caller buffers must survive
+        np.asarray(v)
+
+
 def test_fused_step_matches_staged_with_scheduler():
     """lr scheduler must see the same update count in both paths."""
     def params():

@@ -91,6 +91,38 @@ def test_mp_shuffle_covers_all_labels(tmp_path):
     it.close()
 
 
+def test_mp_error_recovery_no_desync(tmp_path):
+    """A worker error mid-epoch leaves replies partially read; reset()
+    must drain each stream exactly so the next epoch's slots aren't
+    copied before the worker confirmed writing them (ADVICE r4)."""
+    import im2rec
+    prefix = str(tmp_path / "bad")
+    rng = np.random.RandomState(1)
+    rec = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
+    for i in range(24):
+        if i == 17:  # lands in a mid-shard position of the last batch
+            payload = b"not an image"
+        else:
+            img = rng.randint(0, 255, (40, 48, 3), dtype=np.uint8)
+            payload = im2rec._encode(img, quality=90)
+        rec.write_idx(i, recordio.pack(
+            recordio.IRHeader(0, float(i % 10), i, 0), payload))
+    rec.close()
+
+    from mxnet_tpu.mp_decode import MPImageRecordIter
+    it = MPImageRecordIter(prefix + ".rec", data_shape=(3, 16, 16),
+                           batch_size=8, path_imgidx=prefix + ".idx",
+                           num_workers=2)
+    good = [it.next().data[0].asnumpy() for _ in range(2)]
+    with pytest.raises(mx.base.MXNetError, match="decode worker"):
+        it.next()                      # batch 3 carries the bad record
+    it.reset()                         # exact per-stream drain
+    again = [it.next().data[0].asnumpy() for _ in range(2)]
+    for a, b in zip(good, again):      # no stale-slot reads after recovery
+        np.testing.assert_allclose(a, b)
+    it.close()
+
+
 def test_mp_offset_scan_matches_idx(tmp_path):
     from mxnet_tpu.mp_decode import scan_record_offsets
     prefix = _make_pack(tmp_path, n=16)
