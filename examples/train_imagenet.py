@@ -17,17 +17,20 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-from mxnet_tpu.models import resnet, alexnet, vgg, inception_bn
+from mxnet_tpu.models import (resnet, alexnet, vgg, inception_bn,
+                              inception_v3)
 from common import data, fit
 
 
 def main():
     parser = argparse.ArgumentParser(description="train imagenet")
     parser.add_argument("--network", type=str, default="resnet",
-                        choices=("resnet", "alexnet", "vgg", "inception-bn"))
+                        choices=("resnet", "alexnet", "vgg", "inception-bn",
+                                 "inception-v3"))
     parser.add_argument("--num-layers", type=int, default=50)
     parser.add_argument("--num-classes", type=int, default=1000)
     parser.add_argument("--num-examples", type=int, default=2560)
+    parser.add_argument("--num-val", type=int, default=256)
     fit.add_fit_args(parser)
     parser.set_defaults(batch_size=64, num_epochs=1, lr=0.1,
                         disp_batches=10)
@@ -42,12 +45,19 @@ def main():
     elif args.network == "vgg":
         net = vgg.get_symbol(num_classes=args.num_classes,
                              num_layers=args.num_layers)
+    elif args.network == "inception-v3":
+        net = inception_v3.get_symbol(num_classes=args.num_classes)
     else:
         net = inception_bn.get_symbol(num_classes=args.num_classes)
 
+    # inception-v3 is a 299x299 architecture (its global pool is 8x8)
+    image_shape = (3, 299, 299) if args.network == "inception-v3" \
+        else (3, 224, 224)
     iters = data.imagenet_like_iters(args.batch_size,
                                      num_classes=args.num_classes,
-                                     num_train=args.num_examples)
+                                     image_shape=image_shape,
+                                     num_train=args.num_examples,
+                                     num_val=args.num_val)
     fit.fit(args, net, iters)
 
 

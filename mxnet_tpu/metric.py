@@ -83,7 +83,8 @@ class EvalMetric:
 
     @sum_metric.setter
     def sum_metric(self, value):
-        self._sum_metric = value
+        self._flush()             # queued device batches must not leak
+        self._sum_metric = value  # into a freshly poked value later
 
     @property
     def num_inst(self):
@@ -92,6 +93,7 @@ class EvalMetric:
 
     @num_inst.setter
     def num_inst(self, value):
+        self._flush()
         self._num_inst = value
 
     def _accumulate(self, total, count, index=None):

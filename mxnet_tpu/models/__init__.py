@@ -6,3 +6,4 @@ from . import resnet
 from . import alexnet
 from . import vgg
 from . import inception_bn
+from . import inception_v3

@@ -1,0 +1,53 @@
+"""Model-zoo symbol checks (shape inference is cheap; forwards are slow).
+
+Reference analog: tests/python/unittest/test_symbol.py + the example
+zoo's implicit coverage via example runs.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.models import inception_v3
+
+
+def test_inception_v3_shapes():
+    """299x299 in, (N, classes) out, published parameter budget, and
+    reference checkpoint naming (reference:
+    example/image-classification/symbols/inception-v3.py:1)."""
+    net = inception_v3.get_symbol(num_classes=1000)
+    arg_shapes, out_shapes, _ = net.infer_shape(data=(2, 3, 299, 299))
+    assert out_shapes == [(2, 1000)]
+    names = net.list_arguments()
+    total = sum(int(np.prod(s)) for s in arg_shapes)
+    assert 23_000_000 < total < 25_000_000, total
+    # reference naming so .params files line up across frameworks
+    for expect in ("conv_conv2d_weight",
+                   "mixed_tower_1_conv_2_conv2d_weight",
+                   "mixed_4_tower_1_conv_4_conv2d_weight",
+                   "mixed_10_tower_mixed_conv_1_conv2d_weight",
+                   "fc1_weight"):
+        assert expect in names, expect
+
+
+def test_inception_v3_small_classes_shapes():
+    net = inception_v3.get_symbol(num_classes=7)
+    _, out_shapes, _ = net.infer_shape(data=(1, 3, 299, 299))
+    assert out_shapes == [(1, 7)]
+
+
+@pytest.mark.slow
+def test_inception_v3_forward():
+    """One real forward pass executes and yields a normalized softmax."""
+    net = inception_v3.get_symbol(num_classes=10)
+    exe = net.simple_bind(ctx=mx.cpu(), data=(1, 3, 299, 299),
+                          grad_req="null")
+    rs = np.random.RandomState(0)
+    for name, arr in exe.arg_dict.items():
+        if name != "data":
+            arr[:] = rs.uniform(-0.05, 0.05, arr.shape).astype(np.float32)
+    for name, arr in exe.aux_dict.items():     # identity BN statistics
+        arr[:] = 1.0 if name.endswith("moving_var") else 0.0
+    exe.arg_dict["data"][:] = rs.rand(1, 3, 299, 299).astype(np.float32)
+    out = exe.forward(is_train=False)[0].asnumpy()
+    assert out.shape == (1, 10)
+    np.testing.assert_allclose(out.sum(axis=1), 1.0, rtol=1e-4)

@@ -94,11 +94,15 @@ def test_checkpoint_resume_continues_training():
     ("train_imagenet.py", ["--num-epochs", "1", "--batch-size", "8",
                            "--num-layers", "18", "--num-classes", "4",
                            "--num-examples", "32"]),
+    ("train_imagenet.py", ["--num-epochs", "1", "--batch-size", "2",
+                           "--network", "inception-v3", "--num-classes",
+                           "4", "--num-examples", "4", "--num-val", "2"]),
     ("ssd/train.py", ["--epochs", "1", "--batch-size", "8",
                       "--num-images", "16", "--width", "8",
                       "--data-size", "64"]),
     ("bi_lstm_sort.py", ["--num-epochs", "1", "--num-train", "256",
                          "--seq-len", "6", "--num-hidden", "24"]),
+    ("model_parallel_lstm.py", ["--num-epochs", "3"]),
 ])
 def test_example_scripts_smoke(script, args):
     """Every shipped example must run end-to-end (tiny settings)."""
