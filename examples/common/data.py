@@ -68,6 +68,44 @@ def real_digits(size=28, seed=0, val_frac=0.2):
     return (imgs[n_val:], labels[n_val:], imgs[:n_val], labels[:n_val])
 
 
+def real_photo_patches(patch=32, stride=16, split_col=420, gap=None,
+                       seed=0):
+    """Real RGB photographs at CIFAR patch scale, available offline:
+    scikit-learn vendors two genuine 427x640 photos (china.jpg,
+    flower.jpg). Cut into ``patch`` x ``patch`` tiles on a ``stride``
+    grid, labeled by source photo — a 2-class natural-image texture/
+    color task with real pixel statistics. The train/val split is
+    SPATIAL (train = left columns, val = right columns, with a >=patch
+    gap) so overlapping tiles never leak across the split; passing the
+    gate requires generalizing to unseen regions of the scene.
+
+    Returns (tr_img, tr_lbl, va_img, va_lbl): images uint8 HWC.
+    """
+    from sklearn.datasets import load_sample_images
+    photos = load_sample_images().images
+    if gap is None:
+        gap = patch               # guarantees zero tile overlap by itself
+
+    def cut(img, c0, c1):
+        return [img[y:y + patch, x:x + patch]
+                for y in range(0, img.shape[0] - patch + 1, stride)
+                for x in range(c0, c1 - patch + 1, stride)]
+
+    tr, trl, va, val = [], [], [], []
+    for lbl, img in enumerate(photos):
+        t = cut(img, 0, split_col)
+        v = cut(img, split_col + gap, img.shape[1])
+        tr += t
+        trl += [lbl] * len(t)
+        va += v
+        val += [lbl] * len(v)
+    rng = np.random.RandomState(seed)
+    order = rng.permutation(len(tr))
+    tr = np.stack(tr)[order]
+    trl = np.asarray(trl, np.float32)[order]
+    return tr, trl, np.stack(va), np.asarray(val, np.float32)
+
+
 def mnist_iters(batch_size, data_dir="data", flat=False, seed=0,
                 num_train=8000, num_val=2000):
     """(train_iter, val_iter) of 28x28 digits — real MNIST if the idx

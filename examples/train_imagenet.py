@@ -31,6 +31,11 @@ def main():
     parser.add_argument("--num-classes", type=int, default=1000)
     parser.add_argument("--num-examples", type=int, default=2560)
     parser.add_argument("--num-val", type=int, default=256)
+    parser.add_argument("--data-train", type=str, default=None,
+                        help=".rec pack for real training data (routes "
+                             "through ImageRecordIter: multiprocess "
+                             "decode + augmentation)")
+    parser.add_argument("--data-val", type=str, default=None)
     fit.add_fit_args(parser)
     parser.set_defaults(batch_size=64, num_epochs=1, lr=0.1,
                         disp_batches=10)
@@ -53,11 +58,26 @@ def main():
     # inception-v3 is a 299x299 architecture (its global pool is 8x8)
     image_shape = (3, 299, 299) if args.network == "inception-v3" \
         else (3, 224, 224)
-    iters = data.imagenet_like_iters(args.batch_size,
-                                     num_classes=args.num_classes,
-                                     image_shape=image_shape,
-                                     num_train=args.num_examples,
-                                     num_val=args.num_val)
+    if args.data_train:
+        # real data: RecordIO -> multiprocess decode + train augmentation
+        # (reference: train_imagenet.py's ImageRecordIter config)
+        import mxnet_tpu as mx
+        kw = dict(data_shape=image_shape, batch_size=args.batch_size,
+                  mean_r=123.68, mean_g=116.779, mean_b=103.939)
+        train = mx.image.ImageRecordIter(
+            args.data_train, shuffle=True, rand_crop=True,
+            rand_mirror=True, resize=image_shape[-1] + 32, **kw)
+        # no --data-val -> no validation (never score on the train pack)
+        val = mx.image.ImageRecordIter(
+            args.data_val, resize=image_shape[-1] + 32, **kw) \
+            if args.data_val else None
+        iters = (train, val)
+    else:
+        iters = data.imagenet_like_iters(args.batch_size,
+                                         num_classes=args.num_classes,
+                                         image_shape=image_shape,
+                                         num_train=args.num_examples,
+                                         num_val=args.num_val)
     fit.fit(args, net, iters)
 
 

@@ -303,8 +303,8 @@ class MPImageRecordIter(DataIter):
         for cf in self._cfg_files:
             try:
                 os.unlink(cf)
-            except OSError:
-                pass
+            except (OSError, AttributeError):
+                pass              # AttributeError: interpreter shutdown
         self._cfg_files = []
 
     def __del__(self):
