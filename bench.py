@@ -56,7 +56,7 @@ def _log(msg):
 _T0 = time.perf_counter()
 
 BATCH = 256
-N_BATCHES = 4          # synthetic epoch size (per timed round)
+N_BATCHES = 8          # synthetic epoch size (per timed round)
 ROUNDS = 5             # interleaved A/B rounds; the reported ratio is the
                        # median of per-round ratios (the shared chip's
                        # throughput drifts minute to minute, so the two
@@ -222,15 +222,18 @@ def setup_flax(imgs, labels):
         state_box[0], loss = step(state_box[0], *staged[i % N_BATCHES])
     float(jax.device_get(loss))
 
+    counter = [0]                           # device-step submissions
+
     def one_step(i):
         # forced completion via scalar fetch: through the remote-chip
         # tunnel block_until_ready returns before execution finishes,
         # which would time async dispatch instead of the train step
         state_box[0], loss = step(state_box[0],
                                   *staged[i % N_BATCHES])
+        counter[0] += 1           # timed laps only (warm calls step())
         float(jax.device_get(loss))
 
-    return one_step, flops
+    return one_step, flops, counter
 
 
 class _PairedRound:
@@ -276,7 +279,7 @@ def main():
     rng = np.random.RandomState(0)
     imgs, labels = _synthetic(rng)
 
-    flax_one_step, flax_flops = setup_flax(imgs, labels)
+    flax_one_step, flax_flops, flax_steps = setup_flax(imgs, labels)
     (mod, it, exe, force_ours, opt_params), ours_flops = \
         setup_ours(imgs, labels)
 
@@ -285,13 +288,26 @@ def main():
     # to the tunnel's multi-second latency spikes, which poison any
     # sum- or epoch-level statistic (observed: identical code measured
     # at 3.2s/batch and 21.5s/batch thirty minutes apart)
+    import gc
     ours_laps, flax_laps = [], []
     for r in range(ROUNDS):
         it.reset()
         pr = _PairedRound(flax_one_step, force_ours)
+        # a GC pause lands in whichever lap is running when it fires —
+        # asymmetric noise (ours' lap has more Python allocation than the
+        # flax closure); collect between rounds, never inside one
+        gc.collect()
+        gc.disable()
         pr.start()
         mod.fit(it, num_epoch=1, optimizer_params=opt_params,
                 batch_end_callback=pr)
+        gc.enable()
+        # drop each round's first lap from BOTH sides: it carries fit's
+        # epoch prologue (iterator/metric reset, re-bind guards), which
+        # the flax closure has no analog of — steady-state throughput is
+        # the comparison; the exclusion count is recorded in the JSON
+        pr.ours_laps = pr.ours_laps[1:]
+        pr.flax_laps = pr.flax_laps[1:]
         o = BATCH / statistics.median(pr.ours_laps)
         f = BATCH / statistics.median(pr.flax_laps)
         _log(f"round {r}: ours {o:.1f} img/s, flax {f:.1f} img/s "
@@ -303,6 +319,32 @@ def main():
     ours_img_s = BATCH / statistics.median(ours_laps)
     flax_img_s = BATCH / statistics.median(flax_laps)
     ratios = lap_ratios          # reported per-lap, sorted
+
+    def _lap_summary(laps):
+        s = sorted(laps)
+        pick = lambda q: s[min(len(s) - 1, int(q * len(s)))]
+        return {"p10": round(pick(0.10), 3), "p50": round(pick(0.50), 3),
+                "p90": round(pick(0.90), 3), "n": len(s)}
+
+    # methodology self-check (frozen r04 paired-lap method): each lap is
+    # exactly one ours fused batch (fit's batch_end_callback fires once
+    # per batch) followed by exactly one forced flax step; the counters
+    # prove both sides submitted the same number of device steps
+    steps_ours = len(ours_laps)
+    steps_flax = flax_steps[0]              # one_step calls = timed laps
+    paired_ok = (steps_ours == len(lap_ratios)
+                 == ROUNDS * (N_BATCHES - 1)
+                 and steps_flax == ROUNDS * N_BATCHES)
+
+    # on-device Pallas kernel smoke (AFTER the paired laps so its
+    # compiles/executions never contend with the measured rounds):
+    # Mosaic-compiles flash attention + fused SGD on the real backend and
+    # checks numerics vs the XLA compositions (VERDICT r4 #2)
+    _log("pallas smoke (on-device Mosaic compile)")
+    from benchmarks.pallas_smoke import run_pallas_smoke
+    pallas_smoke = run_pallas_smoke()
+    for part in ("flash_attention", "sgd_mom_update"):
+        pallas_smoke.get(part, {}).pop("traceback", None)
 
     # MFU from wall-clock is only a measurement when the wall clock is
     # actually dominated by device compute. Through the shared-chip tunnel
@@ -335,6 +377,14 @@ def main():
         "ratio_vs_flax": round(ratio, 3),
         "lap_ratios_sorted": [round(r, 3) for r in ratios],
         "n_paired_laps": len(ratios),
+        "lap_ratio_p10": round(ratios[int(0.10 * len(ratios))], 3),
+        "ours_lap_seconds": _lap_summary(ours_laps),
+        "flax_lap_seconds": _lap_summary(flax_laps),
+        "paired_step_check": {"ours_timed_laps": steps_ours,
+                              "flax_device_steps": steps_flax,
+                              "warmup_laps_excluded_per_round": 1,
+                              "consistent": paired_ok},
+        "pallas_smoke": pallas_smoke,
         "mfu_ours": mfu(ours_img_s, ours_flops),
         "mfu_flax": mfu(flax_img_s, flax_flops),
         "mfu_note": mfu_note,

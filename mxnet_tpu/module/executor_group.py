@@ -19,6 +19,7 @@ and the KVStore update paths work unchanged: with one logical executor,
 from __future__ import annotations
 
 import logging
+import os
 
 import numpy as np
 import jax
@@ -209,11 +210,24 @@ class DataParallelExecutorGroup:
         runner = exe._runner
         loss_mask = exe._loss_mask
 
+        # Gradients as program OUTPUTS cost ~5% of the step (measured on
+        # v5e: 161 extra materializations the fuser must keep live past
+        # the update instead of folding into it). The default fit loop
+        # never reads them, so they're off unless requested; the staged
+        # (non-fused) path always populates grad_dict.
+        keep_grads = os.environ.get("MXNET_FUSED_KEEP_GRADS", "0") == "1"
+
         # lr/wd arrive as TWO stacked f32 arrays, not 2x161 python
         # scalars: scalar jit args each become their own host->device
         # transfer per dispatch, which through a remote chip is hundreds
         # of tiny RPCs per step
-        def step(w, rest, aux_vals, rng, states, lr_arr, wd_arr):
+        def step(w, rest, aux_vals, key, states, lr_arr, wd_arr):
+            # rng chain lives ON DEVICE: split here (traced) and return
+            # the successor key, so per-step randomness costs zero extra
+            # host round-trips (next_key() per step was a device dispatch
+            # + transfer through the remote-chip tunnel)
+            key, rng = jax.random.split(key)
+
             def f(wv):
                 return runner({**rest, **wv}, aux_vals, True, rng)
 
@@ -229,7 +243,8 @@ class DataParallelExecutorGroup:
                                 states[nm], lr_arr[i], wd_arr[i])
                 new_w[nm] = nw
                 new_states[nm] = ns
-            return outs, new_aux, new_w, new_states, grads
+            return (outs, new_aux, new_w, new_states,
+                    grads if keep_grads else None, key)
 
         # donate the watched params and optimizer states: both are
         # replaced by same-shaped outputs every step, so XLA updates them
@@ -242,6 +257,10 @@ class DataParallelExecutorGroup:
         # the same reason: eval paths read the same cells mid-epoch.
         self._fused_prog = jax.jit(step, donate_argnums=(0, 4))
         self._fused_watched = watched
+        from .. import random as _random
+        self._fused_key = _random.next_key()   # device-chained thereafter
+        self._fused_rng_gen = _random.generation()
+        self._fused_lrwd = (None, None, None)  # (key, lr_arr, wd_arr)
         # the watched cells must own their buffers exclusively before the
         # first donated step: init_params aliases the same arrays into
         # Module._arg_params, and donating a shared buffer would delete it
@@ -258,31 +277,45 @@ class DataParallelExecutorGroup:
         return True
 
     def fused_step(self, data_batch, lrs, wds):
-        """Run one fused train step; swap new params/state/grads/outputs
-        in (grads are written back so ``grad_dict`` stays truthful for
-        callers that inspect gradients after a step)."""
+        """Run one fused train step; swap new params/state/outputs in
+        (gradients are emitted and written back only under
+        ``MXNET_FUSED_KEEP_GRADS=1`` — they cost ~5% of the step)."""
         from .. import random as _random
         exe = self.executor
         self._load_batch(data_batch)
+        if self._fused_rng_gen != _random.generation():
+            # mx.random.seed() was called since the last step: re-draw
+            # the device chain from the reseeded host chain so seeding
+            # stays effective mid-training (reference seed semantics)
+            self._fused_key = _random.next_key()
+            self._fused_rng_gen = _random.generation()
 
         arg_vals = exe._arg_vals()
         w = {nm: arg_vals.pop(nm) for nm in self._fused_watched}
-        lr_arr = jnp.asarray([lrs[nm] for nm in self._fused_watched],
-                             jnp.float32)
-        wd_arr = jnp.asarray([wds[nm] for nm in self._fused_watched],
-                             jnp.float32)
-        outs, new_aux, new_w, new_states, grads = self._fused_prog(
-            w, arg_vals, exe._aux_vals(), _random.next_key(),
-            self._fused_states, lr_arr, wd_arr)
+        # lr/wd device arrays are cached by value: with a fixed schedule
+        # this is zero host->device transfers per step (two per step
+        # otherwise — each a round trip through the remote-chip tunnel)
+        lrwd_key = (tuple(lrs[nm] for nm in self._fused_watched),
+                    tuple(wds[nm] for nm in self._fused_watched))
+        if self._fused_lrwd[0] != lrwd_key:
+            self._fused_lrwd = (
+                lrwd_key, jnp.asarray(lrwd_key[0], jnp.float32),
+                jnp.asarray(lrwd_key[1], jnp.float32))
+        _, lr_arr, wd_arr = self._fused_lrwd
+        outs, new_aux, new_w, new_states, grads, self._fused_key = \
+            self._fused_prog(w, arg_vals, exe._aux_vals(),
+                             self._fused_key, self._fused_states,
+                             lr_arr, wd_arr)
         self._fused_states = new_states
         ad = exe.arg_dict
         for nm in self._fused_watched:
             ad[nm]._set(new_w[nm])
-        gd = exe.grad_dict
-        for nm, g in grads.items():
-            dst = gd.get(nm)
-            if dst is not None:
-                dst._set(g.astype(dst.dtype))
+        if grads is not None:             # MXNET_FUSED_KEEP_GRADS=1
+            gd = exe.grad_dict
+            for nm, g in grads.items():
+                dst = gd.get(nm)
+                if dst is not None:
+                    dst._set(g.astype(dst.dtype))
         if new_aux:
             xd = exe.aux_dict
             for nm, val in new_aux.items():

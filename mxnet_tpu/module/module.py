@@ -318,8 +318,11 @@ class Module(BaseModule):
         program when armed. The weight update then happens inside this
         call (the subsequent ``update()`` is a no-op for the batch), so
         a loop that conditionally skips ``update()`` must first disarm
-        with ``install_monitor`` absent via the staged path — gradients
-        themselves remain readable from ``grad_dict`` either way."""
+        with ``install_monitor`` absent via the staged path. The fused
+        program does not emit per-param gradients (they cost ~5% of the
+        step as extra XLA outputs); set ``MXNET_FUSED_KEEP_GRADS=1`` to
+        keep ``grad_dict`` populated, or install a monitor to fall back
+        to the staged path, which always populates it."""
         if self._fused_armed and self.optimizer_initialized:
             if self._exec_group.executor._monitor_callback is not None:
                 # a monitor was installed directly on the executor after

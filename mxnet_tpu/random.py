@@ -13,12 +13,21 @@ import jax
 
 # lazy: materializing a key initializes the XLA backend, which must not
 # happen at import time (jax.distributed.initialize comes after import)
-_STATE = {"key": None}
+# ``generation`` bumps on every seed() so device-chained key consumers
+# (the fused train step keeps its rng on device between steps) can
+# detect a reseed and re-draw from the fresh chain
+_STATE = {"key": None, "generation": 0}
 
 
 def seed(seed_state):
     """Seed the global generator. reference: python/mxnet/random.py seed()."""
     _STATE["key"] = jax.random.PRNGKey(int(seed_state))
+    _STATE["generation"] += 1
+
+
+def generation():
+    """Monotonic count of seed() calls (device-chain invalidation tag)."""
+    return _STATE["generation"]
 
 
 def next_key():

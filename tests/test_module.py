@@ -295,6 +295,84 @@ def test_fused_step_optimizer_state_roundtrip():
         np.testing.assert_allclose(before[k], after[k])
 
 
+def test_fused_keep_grads_env(monkeypatch):
+    """MXNET_FUSED_KEEP_GRADS=1 makes the fused program emit per-param
+    gradients into grad_dict (off by default: they cost ~5%/step)."""
+    def grads_after_step(keep):
+        monkeypatch.setenv("MXNET_FUSED_KEEP_GRADS", "1" if keep else "0")
+        rs = np.random.RandomState(11)
+        mx.random.seed(5)                 # identical params every variant
+        mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+        mod.bind([("data", (4, 6))], [("softmax_label", (4,))])
+        mod.init_params(mx.initializer.Xavier())
+        mod.init_optimizer(kvstore=None, optimizer="sgd",
+                           optimizer_params=(("learning_rate", 0.0),))
+        assert mod._fused_armed
+        gd = mod._exec_group.executor.grad_dict
+        before = {k: v.asnumpy().copy() for k, v in gd.items()
+                  if v is not None}
+        batch = mx.io.DataBatch(
+            data=[mx.nd.array(rs.rand(4, 6).astype(np.float32))],
+            label=[mx.nd.array(rs.randint(0, 3, (4,)).astype(np.float32))])
+        mod.forward_backward(batch)
+        after = {k: v.asnumpy() for k, v in gd.items() if v is not None}
+        changed = any(np.abs(after[k] - before[k]).max() > 0
+                      for k in after)
+        return changed, after
+
+    changed_off, _ = grads_after_step(False)
+    assert not changed_off, "default fused step must not write grad_dict"
+    changed_on, grads_fused = grads_after_step(True)
+    assert changed_on, "KEEP_GRADS=1 must populate grad_dict"
+    # and the emitted gradients match the staged path's
+    rs = np.random.RandomState(11)
+    mx.random.seed(5)
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    mod.bind([("data", (4, 6))], [("softmax_label", (4,))])
+    mod.init_params(mx.initializer.Xavier())
+    mod.init_optimizer(kvstore=None, optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.0),))
+    mod._fused_armed = False                      # staged path
+    batch = mx.io.DataBatch(
+        data=[mx.nd.array(rs.rand(4, 6).astype(np.float32))],
+        label=[mx.nd.array(rs.randint(0, 3, (4,)).astype(np.float32))])
+    mod.forward_backward(batch)
+    for k, v in mod._exec_group.executor.grad_dict.items():
+        if v is not None:
+            np.testing.assert_allclose(grads_fused[k], v.asnumpy(),
+                                       rtol=2e-5, atol=2e-6, err_msg=k)
+
+
+def test_fused_rng_reseed_mid_training():
+    """mx.random.seed() between steps must re-draw the fused step's
+    device-chained rng key (reference seed semantics: seeding is
+    effective at any point, not just before arming)."""
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    mod.bind([("data", (4, 6))], [("softmax_label", (4,))])
+    mod.init_params(mx.initializer.Xavier())
+    mod.init_optimizer(kvstore=None, optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.1),))
+    assert mod._fused_armed
+    eg = mod._exec_group
+    rs = np.random.RandomState(3)
+    batch = mx.io.DataBatch(
+        data=[mx.nd.array(rs.rand(4, 6).astype(np.float32))],
+        label=[mx.nd.array(rs.randint(0, 3, (4,)).astype(np.float32))])
+    mod.forward_backward(batch)
+    key_before = np.asarray(eg._fused_key).copy()
+    mx.random.seed(42)
+    mod.forward_backward(batch)        # must re-draw from new chain
+    mx.random.seed(42)
+    fresh = np.asarray(mx.random.next_key())
+    # the chain was re-drawn at the step boundary: the key in use after
+    # reseed+step is the successor of the reseeded chain's first subkey,
+    # not a continuation of the pre-seed chain
+    assert not np.array_equal(np.asarray(eg._fused_key), key_before)
+    import jax
+    expect = np.asarray(jax.random.split(fresh)[0])
+    np.testing.assert_array_equal(np.asarray(eg._fused_key), expect)
+
+
 def test_set_params_after_arming_does_not_donate_caller_buffer():
     """set_params after the fused step is armed must copy: astype/
     device_put are identity when dtype+placement match, and the next
