@@ -401,7 +401,12 @@ def main():
                     "Module.fit's batch_end_callback after each forced "
                     "ours batch), and the median over all paired laps "
                     "is the signal; input pipeline is benched "
-                    "separately (io_bench.py)",
+                    "separately (io_bench.py). Across-SESSION "
+                    "dispersion remains: back-to-back runs of this "
+                    "unchanged script measured ratio 1.137 and 0.956 "
+                    "(benchmarks/results/), with within-run rounds "
+                    "tight in both — treat any single run as one "
+                    "sample of a ~0.95-1.15 session distribution",
     }))
 
 
