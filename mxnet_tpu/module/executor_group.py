@@ -209,6 +209,12 @@ class DataParallelExecutorGroup:
 
         runner = exe._runner
         loss_mask = exe._loss_mask
+        # (output index, label name) pairs, positional like
+        # Accuracy.update's zip(labels, preds) — names missing from the
+        # executor keep their index so pairings never shift
+        metric_pairs = [(i, nm) for i, nm in enumerate(self.label_names)
+                        if nm in exe.arg_dict]
+        self._fused_metric_pairs = metric_pairs
 
         # Gradients as program OUTPUTS cost ~5% of the step (measured on
         # v5e: 161 extra materializations the fuser must keep live past
@@ -243,8 +249,21 @@ class DataParallelExecutorGroup:
                                 states[nm], lr_arr[i], wd_arr[i])
                 new_w[nm] = nw
                 new_states[nm] = ns
+            # top-1 correct counts per (label, output) pair, computed
+            # inside the program: the Accuracy metric then costs zero
+            # extra dispatches per batch (its own device-side argmax
+            # was one more round trip through a remote-chip tunnel)
+            mets = []
+            for i, nm in metric_pairs:
+                if i >= len(outs):
+                    break
+                o = outs[i]
+                l = rest[nm].astype(jnp.int32).ravel()
+                p = jnp.argmax(o, axis=-1) if (
+                    o.ndim > 1 and o.shape != rest[nm].shape) else o
+                mets.append(jnp.sum(p.astype(jnp.int32).ravel() == l))
             return (outs, new_aux, new_w, new_states,
-                    grads if keep_grads else None, key)
+                    grads if keep_grads else None, key, mets)
 
         # donate the watched params and optimizer states: both are
         # replaced by same-shaped outputs every step, so XLA updates them
@@ -261,6 +280,7 @@ class DataParallelExecutorGroup:
         self._fused_key = _random.next_key()   # device-chained thereafter
         self._fused_rng_gen = _random.generation()
         self._fused_lrwd = (None, None, None)  # (key, lr_arr, wd_arr)
+        self._fused_metric_scalars = None
         # the watched cells must own their buffers exclusively before the
         # first donated step: init_params aliases the same arrays into
         # Module._arg_params, and donating a shared buffer would delete it
@@ -302,11 +322,18 @@ class DataParallelExecutorGroup:
                 lrwd_key, jnp.asarray(lrwd_key[0], jnp.float32),
                 jnp.asarray(lrwd_key[1], jnp.float32))
         _, lr_arr, wd_arr = self._fused_lrwd
-        outs, new_aux, new_w, new_states, grads, self._fused_key = \
-            self._fused_prog(w, arg_vals, exe._aux_vals(),
-                             self._fused_key, self._fused_states,
-                             lr_arr, wd_arr)
+        (outs, new_aux, new_w, new_states, grads, self._fused_key,
+         mets) = self._fused_prog(w, arg_vals, exe._aux_vals(),
+                                  self._fused_key, self._fused_states,
+                                  lr_arr, wd_arr)
         self._fused_states = new_states
+        self._fused_metric_scalars = [
+            (m, int(np.prod(arg_vals[nm].shape)))
+            for m, (_, nm) in zip(mets, self._fused_metric_pairs)]
+        # the counts are valid only for THIS batch's labels: remember
+        # which label objects they were computed against
+        self._fused_metric_labels = [id(l) for l in
+                                     (data_batch.label or [])]
         ad = exe.arg_dict
         for nm in self._fused_watched:
             ad[nm]._set(new_w[nm])
@@ -367,6 +394,10 @@ class DataParallelExecutorGroup:
         """
         if is_train is None:
             is_train = self.for_training
+        # any staged execution invalidates fused-step metric scalars so a
+        # later update_metric (e.g. an eval pass) can never consume
+        # counts from a previous train batch
+        self._fused_metric_scalars = None
         self._load_batch(data_batch)
         self.executor.forward(is_train=is_train)
 
@@ -405,7 +436,23 @@ class DataParallelExecutorGroup:
         return [[g] for g in grads]
 
     def update_metric(self, eval_metric, labels):
-        """reference: executor_group.py:510 — metric on device outputs."""
+        """reference: executor_group.py:510 — metric on device outputs.
+
+        After a fused step, plain Accuracy consumes the correct-counts
+        the program already computed (zero extra dispatches); every
+        other metric takes the general path on the outputs."""
+        from ..metric import Accuracy
+        scalars = getattr(self, "_fused_metric_scalars", None)
+        if (scalars and type(eval_metric) is Accuracy
+                and eval_metric.num is None
+                and len(scalars) == len(labels or [])
+                # the counts belong to the fused batch's label objects;
+                # a caller scoring different labels gets the general path
+                and [id(l) for l in labels] == self._fused_metric_labels):
+            self._fused_metric_scalars = None
+            for correct, size in scalars:
+                eval_metric._accumulate_device(correct, size)
+            return
         eval_metric.update(labels, self.executor.outputs)
 
     def get_states(self, merge_multi_context=True):

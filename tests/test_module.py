@@ -343,6 +343,37 @@ def test_fused_keep_grads_env(monkeypatch):
                                        rtol=2e-5, atol=2e-6, err_msg=k)
 
 
+def test_fused_metric_scalars_match_staged_accuracy():
+    """The fused program's in-step top-1 counts must reproduce exactly
+    what Accuracy computes from the outputs (zero-dispatch metric)."""
+    rs = np.random.RandomState(9)
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    mod.bind([("data", (8, 6))], [("softmax_label", (8,))])
+    mod.init_params(mx.initializer.Xavier())
+    mod.init_optimizer(kvstore=None, optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.0),))
+    assert mod._fused_armed
+    fused_acc = mx.metric.create("acc")
+    ref_acc = mx.metric.create("acc")
+    for _ in range(3):
+        batch = mx.io.DataBatch(
+            data=[mx.nd.array(rs.rand(8, 6).astype(np.float32))],
+            label=[mx.nd.array(rs.randint(0, 3, (8,)).astype(np.float32))])
+        mod.forward_backward(batch)
+        assert mod._exec_group._fused_metric_scalars is not None
+        mod.update_metric(fused_acc, batch.label)
+        assert mod._exec_group._fused_metric_scalars is None  # consumed
+        ref_acc.update(batch.label, mod.get_outputs())
+    assert fused_acc.get() == ref_acc.get()
+    # an eval pass right after a fused step must not consume train counts
+    batch = mx.io.DataBatch(
+        data=[mx.nd.array(rs.rand(8, 6).astype(np.float32))],
+        label=[mx.nd.array(rs.randint(0, 3, (8,)).astype(np.float32))])
+    mod.forward_backward(batch)                 # scalars armed...
+    mod.forward(batch, is_train=False)          # ...invalidated by eval
+    assert mod._exec_group._fused_metric_scalars is None
+
+
 def test_fused_rng_reseed_mid_training():
     """mx.random.seed() between steps must re-draw the fused step's
     device-chained rng key (reference seed semantics: seeding is
