@@ -257,10 +257,20 @@ class DataParallelExecutorGroup:
             for i, nm in metric_pairs:
                 if i >= len(outs):
                     break
-                o = outs[i]
-                l = rest[nm].astype(jnp.int32).ravel()
-                p = jnp.argmax(o, axis=-1) if (
-                    o.ndim > 1 and o.shape != rest[nm].shape) else o
+                o, lab = outs[i], rest[nm]
+                if o.ndim > 1 and o.shape != lab.shape:
+                    # classification semantics only: prediction classes
+                    # must align 1:1 with label elements after argmax
+                    # (detection-style structured labels skip the
+                    # in-step count and take the general metric path)
+                    if int(np.prod(o.shape[:-1])) != lab.size:
+                        break
+                    p = jnp.argmax(o, axis=-1)
+                elif o.shape == lab.shape:
+                    p = o
+                else:
+                    break
+                l = lab.astype(jnp.int32).ravel()
                 mets.append(jnp.sum(p.astype(jnp.int32).ravel() == l))
             return (outs, new_aux, new_w, new_states,
                     grads if keep_grads else None, key, mets)
@@ -330,10 +340,10 @@ class DataParallelExecutorGroup:
         self._fused_metric_scalars = [
             (m, int(np.prod(arg_vals[nm].shape)))
             for m, (_, nm) in zip(mets, self._fused_metric_pairs)]
-        # the counts are valid only for THIS batch's labels: remember
-        # which label objects they were computed against
-        self._fused_metric_labels = [id(l) for l in
-                                     (data_batch.label or [])]
+        # the counts are valid only for THIS batch's labels: hold the
+        # label objects themselves (bare id()s could be reused by the
+        # allocator after the batch dies and wrongly match new labels)
+        self._fused_metric_labels = list(data_batch.label or [])
         ad = exe.arg_dict
         for nm in self._fused_watched:
             ad[nm]._set(new_w[nm])
@@ -446,9 +456,14 @@ class DataParallelExecutorGroup:
         if (scalars and type(eval_metric) is Accuracy
                 and eval_metric.num is None
                 and len(scalars) == len(labels or [])
+                # same label/output count contract the staged path's
+                # check_label_shapes enforces — never mask a violation
+                and len(labels) == len(self.executor.outputs)
                 # the counts belong to the fused batch's label objects;
                 # a caller scoring different labels gets the general path
-                and [id(l) for l in labels] == self._fused_metric_labels):
+                and len(labels) == len(self._fused_metric_labels)
+                and all(a is b for a, b in
+                        zip(labels, self._fused_metric_labels))):
             self._fused_metric_scalars = None
             for correct, size in scalars:
                 eval_metric._accumulate_device(correct, size)
