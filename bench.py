@@ -272,9 +272,35 @@ class _PairedRound:
 
 def main():
     import statistics
+    import threading
 
     import jax
-    dev = jax.devices()[0]
+
+    # Bounded backend startup: a dead chip tunnel makes jax.devices()
+    # block indefinitely inside backend discovery — fail legibly with a
+    # JSON error instead of hanging the driver. (Compiles are NOT under
+    # this timeout; only backend init.)
+    ready = threading.Event()
+    box, err = [], []
+
+    def _init():
+        try:
+            box.append(jax.devices())
+        except Exception as e:          # report the real failure, not
+            err.append(f"{type(e).__name__}: {e}")   # a fake timeout
+        finally:
+            ready.set()
+
+    threading.Thread(target=_init, daemon=True).start()
+    if not ready.wait(900) or err:
+        print(json.dumps({
+            "metric": "resnet50_bf16_b256_train_img_per_sec_vs_flax_1chip",
+            "value": None, "unit": "img/s", "vs_baseline": None,
+            "error": err[0] if err else
+                     "TPU backend unavailable: jax.devices() did not "
+                     "return within 900s (tunnel down?)"}))
+        sys.exit(1)
+    dev = box[0][0]
     peak = PEAK_BF16.get(dev.device_kind)
     rng = np.random.RandomState(0)
     imgs, labels = _synthetic(rng)

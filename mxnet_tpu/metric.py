@@ -52,6 +52,16 @@ def _each(labels, preds, check=True):
         yield _host(label), _host(pred)
 
 
+def _device_pair(lab, pred):
+    """(lab_jax, pred_jax) when both live on the same device — the
+    device-side metric fast path (no per-batch host pull); else None."""
+    if isinstance(pred, NDArray) and isinstance(lab, NDArray):
+        pj, lj = pred.asjax(), lab.asjax()
+        if pj.devices() == lj.devices():
+            return lj, pj
+    return None
+
+
 class EvalMetric:
     """Base class: a running (sum, count) with named readout.
 
@@ -125,9 +135,10 @@ class EvalMetric:
             return
         import jax
         pend, self._pending = self._pending, []
-        totals = jax.device_get([t for t, _ in pend])   # one pull
-        for total, (_, count) in zip(totals, pend):
-            self._accumulate(float(total), count)
+        # one pull for everything queued; counts may themselves be
+        # device scalars (e.g. Perplexity's ignore-label keep count)
+        for total, count in jax.device_get(pend):
+            self._accumulate(float(total), int(count))
 
     def update(self, labels, preds):
         raise NotImplementedError
@@ -192,13 +203,13 @@ class Accuracy(EvalMetric):
     def update(self, labels, preds):
         check_label_shapes(labels, preds)
         for lab, pred in zip(labels, preds):
-            if isinstance(pred, NDArray) and isinstance(lab, NDArray) \
-                    and pred.asjax().devices() == lab.asjax().devices():
+            dp = _device_pair(lab, pred)
+            if dp is not None:
                 # device-side argmax + compare: no per-batch host sync
                 import jax.numpy as jnp
-                p = pred.asjax()
-                l = lab.asjax().astype(jnp.int32).ravel()
-                if p.ndim > 1 and p.shape != lab.shape:
+                l, p = dp
+                l = l.astype(jnp.int32).ravel()
+                if p.ndim > 1 and p.shape != dp[0].shape:
                     p = jnp.argmax(p, axis=-1)
                 correct = jnp.sum(p.astype(jnp.int32).ravel() == l)
                 self._accumulate_device(correct, int(l.size))
@@ -222,7 +233,20 @@ class TopKAccuracy(EvalMetric):
         self.top_k = top_k
 
     def update(self, labels, preds):
-        for lab, pred in _each(labels, preds):
+        check_label_shapes(labels, preds)
+        for lab, pred in zip(labels, preds):
+            dp = _device_pair(lab, pred)
+            if dp is not None and dp[1].ndim == 2:
+                import jax
+                import jax.numpy as jnp
+                l, p = dp
+                k = min(self.top_k, p.shape[1])
+                _, top = jax.lax.top_k(p, k)
+                hits = jnp.sum(jnp.any(
+                    top == l.astype(jnp.int32)[:, None], axis=1))
+                self._accumulate_device(hits, int(l.shape[0]))
+                continue
+            lab, pred = _host(lab), _host(pred)
             lab = lab.astype(_np.int32)
             if pred.ndim == 1:
                 hits = int((pred.astype(_np.int32) == lab).sum())
@@ -266,7 +290,25 @@ class Perplexity(EvalMetric):
     def update(self, labels, preds):
         assert len(labels) == len(preds)
         nll, count = 0.0, 0
-        for lab, prob in _each(labels, preds, check=False):
+        for lab_in, prob_in in zip(labels, preds):
+            dp = _device_pair(lab_in, prob_in)
+            if dp is not None:
+                import jax.numpy as jnp
+                l, p = dp
+                li = l.astype(jnp.int32).ravel()
+                ncls = p.shape[self.axis]
+                p2 = jnp.moveaxis(p, self.axis, -1).reshape(-1, ncls)
+                p_t = p2[jnp.arange(li.shape[0]), li]
+                if self.ignore_label is not None:
+                    keep = li != self.ignore_label
+                    p_t = jnp.where(keep, p_t, 1.0)
+                    cnt = jnp.sum(keep)          # device count: flushed
+                else:                             # with the total
+                    cnt = li.shape[0]
+                self._accumulate_device(
+                    -jnp.sum(jnp.log(jnp.maximum(p_t, 1e-10))), cnt)
+                continue
+            lab, prob = _host(lab_in), _host(prob_in)
             lab = lab.astype(_np.int64).ravel()
             ncls = prob.shape[self.axis]
             prob = _np.moveaxis(prob, self.axis, -1).reshape(-1, ncls)
@@ -291,18 +333,32 @@ class Perplexity(EvalMetric):
 
 
 class _RegressionMetric(EvalMetric):
-    """Shared shell for elementwise-error metrics (one hook to fill in)."""
+    """Shared shell for elementwise-error metrics (one hook to fill in;
+    ``_error`` must be written in array operators + the ``_xp`` module
+    handle so the same body runs on numpy (host) and jnp (device))."""
 
-    def _error(self, lab, pred):
+    def _error(self, xp, lab, pred):
         raise NotImplementedError
 
     def update(self, labels, preds):
-        for lab, pred in _each(labels, preds):
+        check_label_shapes(labels, preds)
+        for lab, pred in zip(labels, preds):
+            dp = _device_pair(lab, pred)
+            if dp is not None:
+                import jax.numpy as jnp
+                l, p = dp
+                if l.ndim == 1:
+                    l = l[:, None]
+                if p.shape != l.shape:
+                    p = p.reshape(l.shape)
+                self._accumulate_device(self._error(jnp, l, p), 1)
+                continue
+            lab, pred = _host(lab), _host(pred)
             if lab.ndim == 1:
                 lab = lab[:, None]
             if pred.shape != lab.shape:
                 pred = pred.reshape(lab.shape)
-            self._accumulate(float(self._error(lab, pred)), 1)
+            self._accumulate(float(self._error(_np, lab, pred)), 1)
 
 
 @_register("mae")
@@ -310,8 +366,8 @@ class MAE(_RegressionMetric):
     def __init__(self):
         super().__init__("mae")
 
-    def _error(self, lab, pred):
-        return _np.abs(lab - pred).mean()
+    def _error(self, xp, lab, pred):
+        return xp.abs(lab - pred).mean()
 
 
 @_register("mse")
@@ -319,7 +375,7 @@ class MSE(_RegressionMetric):
     def __init__(self):
         super().__init__("mse")
 
-    def _error(self, lab, pred):
+    def _error(self, xp, lab, pred):
         return ((lab - pred) ** 2).mean()
 
 
@@ -328,8 +384,8 @@ class RMSE(_RegressionMetric):
     def __init__(self):
         super().__init__("rmse")
 
-    def _error(self, lab, pred):
-        return _np.sqrt(((lab - pred) ** 2).mean())
+    def _error(self, xp, lab, pred):
+        return xp.sqrt(((lab - pred) ** 2).mean())
 
 
 @_register("ce", "cross-entropy")
@@ -341,7 +397,22 @@ class CrossEntropy(EvalMetric):
         self.eps = eps
 
     def update(self, labels, preds):
-        for lab, prob in _each(labels, preds):
+        check_label_shapes(labels, preds)
+        for lab, prob in zip(labels, preds):
+            dp = _device_pair(lab, prob)
+            if dp is not None and dp[1].ndim == 2 \
+                    and dp[0].size == dp[1].shape[0]:
+                # NOTE: like every XLA gather, out-of-range label values
+                # clamp instead of raising — run the host path (numpy
+                # inputs) to surface label-range bugs loudly
+                import jax.numpy as jnp
+                l, p = dp
+                li = l.astype(jnp.int32).ravel()
+                p_t = p[jnp.arange(li.shape[0]), li]
+                self._accumulate_device(-jnp.sum(jnp.log(p_t + self.eps)),
+                                        int(li.shape[0]))
+                continue
+            lab, prob = _host(lab), _host(prob)
             lab = lab.astype(_np.int64).ravel()
             assert lab.shape[0] == prob.shape[0]
             p_target = prob[_np.arange(lab.size), lab]

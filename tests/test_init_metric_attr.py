@@ -187,6 +187,36 @@ def test_name_manager():
     assert s3.name.startswith("pre_")
 
 
+def test_device_metric_paths_match_host():
+    """Every metric with a device-side accumulate branch must agree
+    exactly with the host-numpy branch on identical data (NDArray
+    inputs take the device path; raw numpy takes the host path)."""
+    rs = np.random.RandomState(12)
+    prob = rs.rand(16, 5).astype(np.float32)
+    prob /= prob.sum(axis=1, keepdims=True)
+    lab = rs.randint(0, 5, (16,)).astype(np.float32)
+    reg_pred = rs.randn(16, 1).astype(np.float32)
+    reg_lab = rs.randn(16).astype(np.float32)
+    cases = [
+        (lambda: mx.metric.Accuracy(), lab, prob),
+        (lambda: mx.metric.TopKAccuracy(top_k=3), lab, prob),
+        (lambda: mx.metric.CrossEntropy(), lab, prob),
+        (lambda: mx.metric.Perplexity(ignore_label=None), lab, prob),
+        (lambda: mx.metric.Perplexity(ignore_label=0), lab, prob),
+        (lambda: mx.metric.MSE(), reg_lab, reg_pred),
+        (lambda: mx.metric.MAE(), reg_lab, reg_pred),
+        (lambda: mx.metric.RMSE(), reg_lab, reg_pred),
+    ]
+    for make, l, p in cases:
+        dev, host = make(), make()
+        dev.update([mx.nd.array(l)], [mx.nd.array(p)])
+        host.update([l.copy()], [p.copy()])
+        name, dv = dev.get()
+        _, hv = host.get()
+        np.testing.assert_allclose(dv, hv, rtol=1e-5, atol=1e-6,
+                                   err_msg=name)
+
+
 def test_perplexity_multi_batch_unbiased():
     """ADVICE r2 (medium): get() must be exp(total_nll/total_count), not
     the arithmetic mean of per-batch perplexities (biased high)."""
