@@ -12,9 +12,22 @@ import logging
 import time
 
 __all__ = ["Speedometer", "do_checkpoint", "log_train_metric",
-           "module_checkpoint", "ProgressBar"]
+           "module_checkpoint", "ProgressBar",
+           "LogValidationMetricsCallback"]
+
 
 log = logging.getLogger(__name__)
+
+
+class LogValidationMetricsCallback:
+    """Log eval metrics at epoch end (reference: callback.py:159-167)."""
+
+    def __call__(self, param):
+        if not param.eval_metric:
+            return
+        for name, value in param.eval_metric.get_name_value():
+            log.info("Epoch[%d] Validation-%s=%f", param.epoch, name,
+                     value)
 
 
 def _metric_text(eval_metric, reset=False):

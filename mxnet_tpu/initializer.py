@@ -21,11 +21,25 @@ from .base import MXNetError
 from .ndarray import NDArray
 from . import random as _random
 
-__all__ = ["Initializer", "Load", "Mixed", "Zero", "One", "Constant",
-           "Uniform", "Normal", "Orthogonal", "Xavier", "MSRAPrelu",
-           "Bilinear", "LSTMBias", "register", "init_registry"]
+__all__ = ["InitDesc", "Initializer", "Load", "Mixed", "Zero", "One",
+           "Constant", "Uniform", "Normal", "Orthogonal", "Xavier",
+           "MSRAPrelu", "Bilinear", "LSTMBias", "register",
+           "init_registry"]
 
 init_registry = {}
+
+
+class InitDesc(str):
+    """Name descriptor passed to initializers (reference:
+    initializer.py:14-33): a str subclass carrying the variable's attrs
+    and the global initializer to fall back to. Plain strings work
+    everywhere an InitDesc does (Initializer dispatches on the name)."""
+
+    def __new__(cls, name, attrs=None, global_init=None):
+        ret = super().__new__(cls, name)
+        ret.attrs = attrs or {}
+        ret.global_init = global_init
+        return ret
 
 
 def register(klass):

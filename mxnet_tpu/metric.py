@@ -236,25 +236,26 @@ class TopKAccuracy(EvalMetric):
         check_label_shapes(labels, preds)
         for lab, pred in zip(labels, preds):
             dp = _device_pair(lab, pred)
-            if dp is not None and dp[1].ndim == 2:
+            if dp is not None and dp[1].ndim == 2 \
+                    and dp[0].size == dp[1].shape[0]:
                 import jax
                 import jax.numpy as jnp
                 l, p = dp
                 k = min(self.top_k, p.shape[1])
                 _, top = jax.lax.top_k(p, k)
-                hits = jnp.sum(jnp.any(
-                    top == l.astype(jnp.int32)[:, None], axis=1))
-                self._accumulate_device(hits, int(l.shape[0]))
+                li = l.astype(jnp.int32).ravel()   # (N,1) labels too
+                hits = jnp.sum(jnp.any(top == li[:, None], axis=1))
+                self._accumulate_device(hits, int(li.size))
                 continue
             lab, pred = _host(lab), _host(pred)
-            lab = lab.astype(_np.int32)
+            lab = lab.astype(_np.int32).ravel()
             if pred.ndim == 1:
                 hits = int((pred.astype(_np.int32) == lab).sum())
             else:
                 k = min(self.top_k, pred.shape[1])
                 top = _np.argpartition(pred, -k, axis=1)[:, -k:]
                 hits = int((top == lab[:, None]).any(axis=1).sum())
-            self._accumulate(hits, lab.shape[0])
+            self._accumulate(hits, lab.size)
 
 
 @_register("f1")
@@ -292,7 +293,10 @@ class Perplexity(EvalMetric):
         nll, count = 0.0, 0
         for lab_in, prob_in in zip(labels, preds):
             dp = _device_pair(lab_in, prob_in)
-            if dp is not None:
+            # same size guard as CrossEntropy: a mismatched gather would
+            # clamp silently on device; fall to the loud host path
+            if dp is not None and \
+                    dp[0].size == dp[1].size // dp[1].shape[self.axis]:
                 import jax.numpy as jnp
                 l, p = dp
                 li = l.astype(jnp.int32).ravel()

@@ -35,7 +35,8 @@ import jax.numpy as jnp
 from .base import MXNetError
 from .ops.registry import register as _register_op
 
-__all__ = ["CustomOp", "CustomOpProp", "register", "get_all_registered"]
+__all__ = ["CustomOp", "CustomOpProp", "register", "get_all_registered",
+           "PythonOp", "NumpyOp", "NDArrayOp"]
 
 _CUSTOM_PROPS: dict = {}
 
@@ -261,3 +262,124 @@ _register_op("Custom", inputs=_custom_inputs, aux=_custom_aux,
              full=_custom_forward,
              doc="Python-defined operator (op_type= selects the "
                  "registered CustomOpProp)")
+
+
+# ------------------------------------------------- legacy PythonOp family
+class PythonOp:
+    """DEPRECATED reference API (reference: operator.py:19-130 — kept so
+    pre-CustomOp scripts run): subclass, override forward/backward/
+    infer_shape/list_*, call the instance on input symbols. Realized as
+    a thin adapter over the CustomOp bridge (same pure_callback +
+    custom_vjp plumbing); prefer CustomOp/CustomOpProp for new code."""
+
+    _counter = [0]
+
+    def __init__(self, need_top_grad=True):
+        self.info_ = None
+        self.need_top_grad_ = need_top_grad
+
+    def __call__(self, *args, **kwargs):
+        return self.get_symbol(*args, **kwargs)
+
+    def get_symbol(self, *args, **kwargs):
+        raise NotImplementedError("Must override this")
+
+    def forward(self, in_data, out_data):
+        out_data[0][:] = in_data[0]
+
+    def backward(self, out_grad, in_data, out_data, in_grad):
+        in_grad[0][:] = 1.0
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]]
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def need_top_grad(self):
+        return self.need_top_grad_
+
+    # -------------------------------------------------------- adapter
+    def _register(self):
+        """Wrap this instance in a CustomOpProp and register it under a
+        unique name; memoized on the instance (``info_``, the
+        reference's slot for this) so repeat get_symbol calls reuse one
+        registration and one compiled bridge."""
+        if self.info_ is not None:
+            return self.info_
+        outer = self
+        PythonOp._counter[0] += 1
+        op_type = f"_python_op_{type(self).__name__}_{self._counter[0]}"
+
+        class _LegacyAdapter(CustomOp):
+            def forward(self, is_train, req, in_data, out_data, aux):
+                ins, outs = outer._adapt(in_data), outer._adapt(out_data)
+                outer.forward(in_data=ins, out_data=outs)
+                for dst, r, val in zip(out_data, req, outs):
+                    self.assign(dst, r or "write", val)
+
+            def backward(self, req, out_grad, in_data, out_data, in_grad,
+                         aux):
+                og, ind, outd, ing = (outer._adapt(out_grad),
+                                      outer._adapt(in_data),
+                                      outer._adapt(out_data),
+                                      outer._adapt(in_grad))
+                outer.backward(out_grad=og, in_data=ind, out_data=outd,
+                               in_grad=ing)
+                for dst, r, val in zip(in_grad, req, ing):
+                    self.assign(dst, r or "write", val)
+
+        class _LegacyProp(CustomOpProp):
+            def __init__(self, **_ignored):
+                super().__init__(need_top_grad=outer.need_top_grad())
+
+            def list_arguments(self):
+                return outer.list_arguments()
+
+            def list_outputs(self):
+                return outer.list_outputs()
+
+            def infer_shape(self, in_shape):
+                res = outer.infer_shape(in_shape)
+                ishape, oshape = res[0], res[1]
+                aux = res[2] if len(res) > 2 else []
+                return ishape, oshape, aux
+
+            def create_operator(self, ctx, in_shapes, in_dtypes=None):
+                return _LegacyAdapter()
+
+        register(op_type)(_LegacyProp)
+        self.info_ = op_type
+        return op_type
+
+
+class NumpyOp(PythonOp):
+    """DEPRECATED: PythonOp whose forward/backward see numpy arrays
+    (reference: operator.py NumpyOp). Mutate ``out_data[i][:]`` /
+    ``in_grad[i][:]`` in place; the adapter copies the buffers back."""
+
+    def _adapt(self, arrays):
+        from .ndarray import NDArray
+        # writable copies: asnumpy() views of device buffers are
+        # read-only, and this API's contract is in-place mutation
+        return [np.array(a.asnumpy() if isinstance(a, NDArray) else a)
+                for a in arrays]
+
+    def get_symbol(self, *args, **kwargs):
+        from . import symbol as sym
+        return sym.Custom(*args, op_type=self._register(), **kwargs)
+
+
+class NDArrayOp(PythonOp):
+    """DEPRECATED: PythonOp whose forward/backward see NDArrays
+    (reference: operator.py NDArrayOp)."""
+
+    def _adapt(self, arrays):
+        return list(arrays)          # already NDArray cells
+
+    def get_symbol(self, *args, **kwargs):
+        from . import symbol as sym
+        return sym.Custom(*args, op_type=self._register(), **kwargs)

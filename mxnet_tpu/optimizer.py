@@ -24,7 +24,7 @@ from . import ndarray as nd
 
 __all__ = ["Optimizer", "SGD", "DCASGD", "NAG", "SGLD", "ccSGD", "Adam",
            "AdaGrad", "RMSProp", "AdaDelta", "Ftrl", "Test", "create",
-           "get_updater", "register"]
+           "get_updater", "Updater", "register"]
 
 
 class Optimizer:
@@ -478,16 +478,30 @@ class Test(Optimizer):
         state._set(weight.asjax())
 
 
+class Updater:
+    """KVStore updater (reference: optimizer.py:722-740) — states
+    created lazily per key; picklable state transport for the dist
+    server protocol's set/get."""
+
+    def __init__(self, optimizer):
+        self.optimizer = optimizer
+        self.states = {}
+
+    def __call__(self, index, grad, weight):
+        if index not in self.states:
+            self.states[index] = self.optimizer.create_state(index,
+                                                             weight)
+        self.optimizer.update(index, weight, grad, self.states[index])
+
+    def set_states(self, states):
+        import pickle
+        self.states = pickle.loads(states)
+
+    def get_states(self):
+        import pickle
+        return pickle.dumps(self.states)
+
+
 def get_updater(optimizer):
-    """Closure for the KVStore local-update path. reference: optimizer.py
-    get_updater — states created lazily per key."""
-    states = {}
-
-    def updater(index, grad, weight):
-        if index not in states:
-            states[index] = optimizer.create_state(index, weight)
-        optimizer.update(index, weight, grad, states[index])
-
-    updater.optimizer = optimizer
-    updater.states = states
-    return updater
+    """reference: optimizer.py get_updater -> Updater instance."""
+    return Updater(optimizer)

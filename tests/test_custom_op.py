@@ -88,3 +88,60 @@ def test_custom_trains_in_module():
     preds = mod.predict(it).asnumpy().ravel()[:n]
     acc = ((preds > 0.5) == (labels.ravel()[:len(preds)] > 0.5)).mean()
     assert acc > 0.9, f"custom-op logistic regression accuracy {acc}"
+
+
+def test_legacy_numpy_op():
+    """DEPRECATED reference API parity (reference operator.py NumpyOp):
+    numpy forward/backward mutated in place, symbol via instance call."""
+    class NumpySigmoid(mx.operator.NumpyOp):
+        def __init__(self):
+            super().__init__(need_top_grad=True)
+
+        def list_arguments(self):
+            return ["data"]
+
+        def list_outputs(self):
+            return ["output"]
+
+        def infer_shape(self, in_shape):
+            return in_shape, [in_shape[0]]
+
+        def forward(self, in_data, out_data):
+            out_data[0][:] = 1.0 / (1.0 + np.exp(-in_data[0]))
+
+        def backward(self, out_grad, in_data, out_data, in_grad):
+            y = out_data[0]
+            in_grad[0][:] = out_grad[0] * y * (1.0 - y)
+
+    op = NumpySigmoid()
+    x = sym.var("x")
+    s = op(x, name="legsig")
+    exe = s.simple_bind(mx.cpu(), x=(4, 3), grad_req="write")
+    xv = np.random.RandomState(0).randn(4, 3).astype(np.float32)
+    exe.arg_dict["x"][:] = xv
+    out = exe.forward(is_train=True)[0].asnumpy()
+    np.testing.assert_allclose(out, 1 / (1 + np.exp(-xv)), rtol=1e-5)
+    exe.backward([mx.nd.array(np.ones((4, 3), np.float32))])
+    expect = out * (1 - out)
+    np.testing.assert_allclose(exe.grad_dict["x"].asnumpy(), expect,
+                               rtol=1e-5)
+
+
+def test_legacy_ndarray_op():
+    """reference operator.py NDArrayOp: bodies see NDArrays."""
+    class NdScale(mx.operator.NDArrayOp):
+        def forward(self, in_data, out_data):
+            out_data[0][:] = in_data[0].asnumpy() * 3.0
+
+        def backward(self, out_grad, in_data, out_data, in_grad):
+            in_grad[0][:] = out_grad[0].asnumpy() * 3.0
+
+    op = NdScale()
+    x = sym.var("x")
+    exe = op(x).simple_bind(mx.cpu(), x=(2, 2), grad_req="write")
+    exe.arg_dict["x"][:] = np.ones((2, 2), np.float32)
+    out = exe.forward(is_train=True)[0].asnumpy()
+    np.testing.assert_allclose(out, 3.0 * np.ones((2, 2)))
+    exe.backward([mx.nd.array(np.full((2, 2), 2.0, np.float32))])
+    np.testing.assert_allclose(exe.grad_dict["x"].asnumpy(),
+                               np.full((2, 2), 6.0))

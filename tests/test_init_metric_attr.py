@@ -200,6 +200,9 @@ def test_device_metric_paths_match_host():
     cases = [
         (lambda: mx.metric.Accuracy(), lab, prob),
         (lambda: mx.metric.TopKAccuracy(top_k=3), lab, prob),
+        # (N,1)-shaped labels (the softmax-label convention): must not
+        # broadcast cross-sample, and top-k accuracy stays <= 1
+        (lambda: mx.metric.TopKAccuracy(top_k=3), lab[:, None], prob),
         (lambda: mx.metric.CrossEntropy(), lab, prob),
         (lambda: mx.metric.Perplexity(ignore_label=None), lab, prob),
         (lambda: mx.metric.Perplexity(ignore_label=0), lab, prob),
@@ -215,6 +218,8 @@ def test_device_metric_paths_match_host():
         _, hv = host.get()
         np.testing.assert_allclose(dv, hv, rtol=1e-5, atol=1e-6,
                                    err_msg=name)
+        if "accuracy" in name:
+            assert 0.0 <= dv <= 1.0, (name, dv)
 
 
 def test_perplexity_multi_batch_unbiased():
