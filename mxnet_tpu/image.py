@@ -69,6 +69,18 @@ def _resize_short_np(src, size, interp=2):
     return _resize(img, new_w, new_h, interp)
 
 
+def scale_down(src_size, size):
+    """Shrink a crop size to fit inside the image (reference:
+    image.py:62-70, aspect preserved)."""
+    w, h = size
+    sw, sh = src_size
+    if sh < h:
+        w, h = float(w * sh) / h, sh
+    if sw < w:
+        w, h = sw, float(h * sw) / w
+    return int(w), int(h)
+
+
 def resize_short(src, size, interp=2):
     """Resize shorter edge to `size`. reference: image.py resize_short."""
     return array(_resize_short_np(src, size, interp))

@@ -31,7 +31,11 @@ from .ops.registry import OP_REGISTRY, get_op
 from . import random as _random
 
 __all__ = ["NDArray", "array", "zeros", "ones", "full", "empty", "arange",
-           "concatenate", "load", "save", "waitall", "imperative_invoke"]
+           "concatenate", "load", "save", "waitall", "imperative_invoke",
+           "add", "subtract", "multiply", "divide", "true_divide",
+           "power", "maximum", "minimum", "equal", "not_equal", "greater",
+           "greater_equal", "lesser", "lesser_equal", "moveaxis",
+           "onehot_encode", "imdecode"]
 
 # Registry op functions (slice, abs, sum, ...) are injected into this module
 # at package init (_op_gen), shadowing python builtins of the same name —
@@ -469,3 +473,82 @@ def imperative_invoke(op_name, *inputs, out=None, **kwargs):
     if len(results) == 1:
         return results[0]
     return results
+
+
+# ---------------------------------------------------------------------
+# module-level arithmetic/comparison helpers (reference: ndarray.py's
+# add/maximum/... — scalar-or-array dispatch over the broadcast ops)
+def _binary_fn(jnp_op, name):
+    def fn(lhs, rhs):
+        a = lhs.asjax() if isinstance(lhs, NDArray) else lhs
+        b = rhs.asjax() if isinstance(rhs, NDArray) else rhs
+        ctx = lhs.context if isinstance(lhs, NDArray) else \
+            rhs.context if isinstance(rhs, NDArray) else None
+        out = jnp_op(a, b)
+        if out.dtype == jnp.bool_:        # reference comparisons return
+            out = out.astype(jnp.float32)  # 0/1 floats, not bools
+        return NDArray(out, ctx=ctx)
+    fn.__name__ = name
+    fn.__doc__ = (f"Element-wise broadcasting ``{name}`` of scalar/array "
+                  "operands (reference: ndarray.py module helpers).")
+    return fn
+
+
+add = _binary_fn(jnp.add, "add")
+subtract = _binary_fn(jnp.subtract, "subtract")
+multiply = _binary_fn(jnp.multiply, "multiply")
+divide = _binary_fn(jnp.divide, "divide")
+true_divide = _binary_fn(jnp.true_divide, "true_divide")
+power = _binary_fn(jnp.power, "power")
+maximum = _binary_fn(jnp.maximum, "maximum")
+minimum = _binary_fn(jnp.minimum, "minimum")
+equal = _binary_fn(jnp.equal, "equal")
+not_equal = _binary_fn(jnp.not_equal, "not_equal")
+greater = _binary_fn(jnp.greater, "greater")
+greater_equal = _binary_fn(jnp.greater_equal, "greater_equal")
+lesser = _binary_fn(jnp.less, "lesser")
+lesser_equal = _binary_fn(jnp.less_equal, "lesser_equal")
+
+
+def moveaxis(tensor, source, destination):
+    """Move ``source`` axis to ``destination`` (reference: ndarray.py
+    moveaxis)."""
+    return NDArray(jnp.moveaxis(tensor.asjax(), source, destination),
+                   ctx=tensor.context)
+
+
+def onehot_encode(indices, out):
+    """One-hot encode indices into ``out`` (reference: ndarray.py
+    onehot_encode -> _internal._onehot_encode; depth = out.shape[1])."""
+    depth = out.shape[1]
+    idx = indices.asjax().astype(jnp.int32).ravel()
+    out._set(jax.nn.one_hot(idx, depth, dtype=out.dtype))
+    return out
+
+
+def imdecode(str_img, clip_rect=(0, 0, 0, 0), out=None, index=0,
+             channels=3, mean=None):
+    """Decode an image bytestring to a (H, W, C) float NDArray
+    (reference: ndarray.py imdecode over the opencv plugin). With a
+    batched ``out`` (N, H, W, C), writes slot ``index``."""
+    from .image import _imdecode_np          # cv2-or-PIL, raises MXNetError
+    img = _imdecode_np(np.frombuffer(str_img, dtype=np.uint8),
+                       to_rgb=channels == 3)
+    if channels == 1 and img.ndim == 3:
+        img = img.mean(axis=2, keepdims=True)
+    elif img.ndim == 2:
+        img = img[:, :, None]
+    x0, y0, x1, y1 = clip_rect
+    if x1 > 0 and y1 > 0:
+        img = img[y0:y1, x0:x1]
+    img = img.astype(np.float32)
+    if mean is not None:
+        img = img - (mean.asnumpy() if isinstance(mean, NDArray)
+                     else np.asarray(mean, np.float32))
+    if out is not None:
+        if out.ndim == img.ndim + 1:         # batched buffer: one slot
+            out[index] = img
+        else:
+            out._set(jnp.asarray(img.reshape(out.shape), dtype=out.dtype))
+        return out
+    return NDArray(jnp.asarray(img))

@@ -646,3 +646,34 @@ def arange(start, stop=None, step=1.0, repeat=1, dtype=None, name=None):
     return _create("_arange", [], name=name, start=start, stop=stop,
                    step=step, repeat=repeat,
                    dtype=str(np.dtype(dtype or "float32")))
+
+
+# ------------------------------------------------- scalar/symbol helpers
+def _sym_scalar_dispatch(both, lscalar, rscalar, pyfn, name):
+    """reference: symbol.py pow/maximum/minimum/hypot — dispatch on
+    Symbol-vs-Number operand combinations over the injected ops."""
+    def fn(left, right):
+        g = globals()
+        if isinstance(left, Symbol) and isinstance(right, Symbol):
+            return g[both](left, right)
+        if isinstance(left, Symbol):
+            return g[lscalar](left, scalar=float(right))
+        if isinstance(right, Symbol):
+            return g[rscalar](right, scalar=float(left))
+        return pyfn(left, right)
+    fn.__name__ = name
+    fn.__doc__ = (f"``{name}(left, right)`` over Symbol/Number operands "
+                  "(reference: symbol.py module helpers).")
+    return fn
+
+
+pow = _sym_scalar_dispatch("_power", "_power_scalar", "_rpower_scalar",
+                           lambda a, b: a ** b, "pow")
+maximum = _sym_scalar_dispatch("_maximum", "_maximum_scalar",
+                               "_maximum_scalar",
+                               lambda a, b: a if a > b else b, "maximum")
+minimum = _sym_scalar_dispatch("_minimum", "_minimum_scalar",
+                               "_minimum_scalar",
+                               lambda a, b: a if a < b else b, "minimum")
+hypot = _sym_scalar_dispatch("_hypot", "_hypot_scalar", "_hypot_scalar",
+                             lambda a, b: float(np.hypot(a, b)), "hypot")

@@ -172,3 +172,69 @@ def test_ndarray_scalar_ops():
     assert float(a.asscalar()) == 2.0
     assert bool(mx.nd.array([1.0]))
     assert len(mx.nd.zeros((5, 2))) == 5
+
+
+def test_module_level_arithmetic_helpers():
+    """reference ndarray.py module helpers: scalar-or-array dispatch,
+    comparisons returning 0/1 floats."""
+    a = mx.nd.array([[1.0, 5.0], [3.0, 2.0]])
+    b = mx.nd.array([[4.0, 1.0], [3.0, 6.0]])
+    np.testing.assert_allclose(mx.nd.add(a, 1.0).asnumpy(),
+                               a.asnumpy() + 1)
+    np.testing.assert_allclose(mx.nd.maximum(a, b).asnumpy(),
+                               np.maximum(a.asnumpy(), b.asnumpy()))
+    np.testing.assert_allclose(mx.nd.minimum(a, 3.0).asnumpy(),
+                               np.minimum(a.asnumpy(), 3.0))
+    np.testing.assert_allclose(mx.nd.power(2.0, a).asnumpy(),
+                               2.0 ** a.asnumpy())
+    eq = mx.nd.equal(a, b).asnumpy()
+    assert eq.dtype == np.float32
+    np.testing.assert_allclose(
+        eq, (a.asnumpy() == b.asnumpy()).astype(np.float32))
+    np.testing.assert_allclose(
+        mx.nd.lesser_equal(a, b).asnumpy(),
+        (a.asnumpy() <= b.asnumpy()).astype(np.float32))
+    mv = mx.nd.moveaxis(mx.nd.array(np.zeros((2, 3, 4))), 0, 2)
+    assert mv.shape == (3, 4, 2)
+
+
+def test_onehot_encode_and_sym_helpers():
+    idx = mx.nd.array([0.0, 2.0, 1.0])
+    out = mx.nd.zeros((3, 4))
+    res = mx.nd.onehot_encode(idx, out)
+    expect = np.zeros((3, 4), np.float32)
+    expect[[0, 1, 2], [0, 2, 1]] = 1
+    np.testing.assert_allclose(res.asnumpy(), expect)
+    # symbol-level pow/maximum/minimum/hypot over Symbol/scalar mixes
+    import mxnet_tpu.symbol as S
+    x = mx.sym.var("x")
+    exe = S.pow(x, 2.0).simple_bind(mx.cpu(), x=(2,), grad_req="null")
+    exe.arg_dict["x"][:] = np.array([3.0, 4.0], np.float32)
+    np.testing.assert_allclose(exe.forward()[0].asnumpy(), [9.0, 16.0])
+    exe = S.hypot(x, 4.0).simple_bind(mx.cpu(), x=(1,), grad_req="null")
+    exe.arg_dict["x"][:] = np.array([3.0], np.float32)
+    np.testing.assert_allclose(exe.forward()[0].asnumpy(), [5.0])
+    exe = S.maximum(2.0, x).simple_bind(mx.cpu(), x=(2,), grad_req="null")
+    exe.arg_dict["x"][:] = np.array([1.0, 7.0], np.float32)
+    np.testing.assert_allclose(exe.forward()[0].asnumpy(), [2.0, 7.0])
+    assert S.pow(2.0, 3.0) == 8.0
+
+
+def test_nd_imdecode():
+    import io as _io
+    sys_path = __import__("sys").path
+    sys_path.insert(0, "tools")
+    import im2rec
+    img = (np.arange(24 * 32 * 3, dtype=np.uint8) % 255).reshape(24, 32, 3)
+    buf = im2rec._encode(img, quality=95)
+    dec = mx.nd.imdecode(bytes(buf))
+    assert dec.shape == (24, 32, 3)
+    # batched out + index slot
+    out = mx.nd.zeros((2, 24, 32, 3))
+    mx.nd.imdecode(bytes(buf), out=out, index=1)
+    host = out.asnumpy()
+    assert host[0].sum() == 0 and host[1].sum() > 0
+    np.testing.assert_allclose(host[1], dec.asnumpy())
+    # clip_rect
+    clipped = mx.nd.imdecode(bytes(buf), clip_rect=(4, 2, 20, 14))
+    assert clipped.shape == (12, 16, 3)
