@@ -11,6 +11,8 @@ from __future__ import annotations
 import logging
 import time
 
+from . import telemetry as _telemetry
+
 __all__ = ["Speedometer", "do_checkpoint", "log_train_metric",
            "module_checkpoint", "ProgressBar",
            "LogValidationMetricsCallback"]
@@ -107,6 +109,16 @@ class Speedometer:
         log.info("Epoch[%d] Batch[%d] speed=%.2f samples/s%s",
                  param.epoch, param.nbatch, speed,
                  " " + text if text else "")
+        # telemetry registry sees the same reading the log line carries,
+        # so one snapshot()/jsonl dump holds the whole training step
+        if _telemetry.enabled():
+            _telemetry.gauge("speedometer.samples_per_sec").set(speed)
+            _telemetry.histogram(
+                "speedometer.samples_per_sec.hist",
+                buckets=(10, 100, 1e3, 1e4, 1e5, 1e6, 1e7)).observe(speed)
+            _telemetry.record_event("speed", epoch=param.epoch,
+                                    nbatch=param.nbatch,
+                                    samples_per_sec=speed)
         self._window_start = time.time()
 
 

@@ -39,6 +39,7 @@ from .context import current_context
 from .ndarray import NDArray, zeros as nd_zeros
 from .ops.registry import get_op
 from . import random as _random
+from . import telemetry as _telemetry
 
 __all__ = ["Executor", "naive_engine_active"]
 
@@ -187,7 +188,17 @@ def _build_graph_runner(symbol, shape_overrides=None, tap=None, mp_plan=None,
             else in_entries
         aux = in_entries[len(in_entries) - aux_n:] if aux_n else []
         krng = jax.random.fold_in(rng, i) if opdef.need_rng else None
-        with jax.named_scope(node.name):
+        # per-op attribution: dispatch counts per registered op plus a
+        # span per node execution. Under jax.jit this fires at trace time
+        # (once per compile — the spans nest under executor.compile);
+        # under the NaiveEngine/tapped runners it fires per step with
+        # real per-op wall time, the reference's per-op profile records.
+        if _telemetry.enabled():
+            _telemetry.counter("executor.op_dispatch", op=node.op).inc()
+            op_span = _telemetry.span("op." + node.op, node=node.name)
+        else:
+            op_span = _telemetry.null_span
+        with op_span, jax.named_scope(node.name):
             out_tags = None
             if layout_opt:
                 res = _layout.nhwc_exec(opdef, attrs, regular, aux,
@@ -395,11 +406,14 @@ class Executor:
                                        shapes_by_name)
 
         self._shape_overrides = shape_overrides
-        self._runner, self.arg_names, self.aux_names, self._loss_mask = \
-            _build_graph_runner(symbol, shape_overrides,
-                                mp_plan=self._mp_plan,
-                                compute_dtype=compute_dtype,
-                                remat_segments=self._remat_segments)
+        with _telemetry.span("executor.bind",
+                             _hist="executor.bind.seconds",
+                             outputs=len(self.output_names)):
+            self._runner, self.arg_names, self.aux_names, self._loss_mask = \
+                _build_graph_runner(symbol, shape_overrides,
+                                    mp_plan=self._mp_plan,
+                                    compute_dtype=compute_dtype,
+                                    remat_segments=self._remat_segments)
         self.aux_arrays = self._normalize_args(aux_states, self.aux_names,
                                                "aux_states", allow_none=True)
         self.grad_req = self._normalize_req(grad_req)
@@ -516,7 +530,11 @@ class Executor:
         cache_key = (kind, naive)
         fn = self._jit_cache.get(cache_key)
         if fn is not None:
+            if _telemetry.enabled():
+                _telemetry.counter("executor.jit_cache.hit").inc()
             return fn
+        if _telemetry.enabled():
+            _telemetry.counter("executor.jit_cache.miss").inc()
         runner = self._naive_runner_fn() if naive else self._runner
         if kind in ("fwd_infer", "fwd_train"):
             is_train = kind == "fwd_train"
@@ -524,7 +542,8 @@ class Executor:
             def prog(arg_vals, aux_vals, rng):
                 return runner(arg_vals, aux_vals, is_train, rng)
 
-            fn = prog if naive else jax.jit(prog)
+            fn = _telemetry.wrap_dispatch(prog, kind, compiled=False) \
+                if naive else _telemetry.wrap_dispatch(jax.jit(prog), kind)
         elif kind == "fwd_bwd":
             watched = self._watched()
 
@@ -542,7 +561,8 @@ class Executor:
                 grads, = vjp_fn(head_grads)
                 return outs, new_aux, grads
 
-            fn = prog if naive else jax.jit(prog)
+            fn = _telemetry.wrap_dispatch(prog, kind, compiled=False) \
+                if naive else _telemetry.wrap_dispatch(jax.jit(prog), kind)
         else:
             raise ValueError(kind)
         self._jit_cache[cache_key] = fn

@@ -19,6 +19,7 @@ from .base import MXNetError
 from .ndarray import NDArray, array
 from .io import DataIter, DataBatch, DataDesc
 from . import recordio
+from . import telemetry as _telemetry
 
 
 def _cv2():
@@ -409,7 +410,15 @@ class ImageIter(DataIter):
         if not items:
             raise StopIteration
         pad = self.batch_size - len(items)
-        decoded = list(self._pool.map(self._decode_augment, items))
+        if _telemetry.enabled():
+            _telemetry.counter("io.batches", iter=type(self).__name__).inc()
+            _telemetry.counter("io.images_decoded").inc(len(items))
+            decode_span = _telemetry.span(
+                "io.decode", _hist="io.decode.seconds", images=len(items))
+        else:
+            decode_span = _telemetry.null_span
+        with decode_span:
+            decoded = list(self._pool.map(self._decode_augment, items))
         data = np.zeros((self.batch_size,) + self.data_shape,
                         dtype=np.float32)
         labels = np.zeros((self.batch_size, self.label_width),

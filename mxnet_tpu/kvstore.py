@@ -30,8 +30,18 @@ import jax.numpy as jnp
 from .base import MXNetError
 from .ndarray import NDArray, zeros
 from . import optimizer as opt
+from . import telemetry as _telemetry
 
 __all__ = ["KVStore", "create", "init_distributed"]
+
+
+def _payload_bytes(vals):
+    """Total bytes across a normalized list-of-list-of-NDArray payload."""
+    n = 0
+    for vlist in vals:
+        for v in vlist:
+            n += int(v.size) * np.dtype(v.dtype).itemsize
+    return n
 
 
 def init_distributed():
@@ -154,33 +164,51 @@ class KVStore:
         """Reduce values; run updater or assign (reference semantics:
         kvstore_local.h Push -> Comm::Reduce -> updater/assign)."""
         keys, vals = _ctype_key_value(key, value)
-        for k, vlist in zip(keys, vals):
-            if k not in self._store:
-                raise MXNetError(f"key {k!r} not initialized")
-            if len(vlist) == 1:
-                merged = vlist[0].copy()
-            else:
-                acc = vlist[0].asjax()
-                for v in vlist[1:]:
-                    acc = acc + v.asjax()
-                merged = NDArray(acc, ctx=vlist[0].context)
-            if self._updater is not None:
-                self._updater(k, merged, self._store[k])
-            else:
-                self._store[k]._set(merged.asjax())
+        if _telemetry.enabled():
+            nbytes = _payload_bytes(vals)
+            _telemetry.counter("kvstore.push.bytes").inc(nbytes)
+            push_span = _telemetry.span(
+                "kvstore.push", _hist="kvstore.push.seconds",
+                keys=len(keys), bytes=nbytes)
+        else:
+            push_span = _telemetry.null_span
+        with push_span:
+            for k, vlist in zip(keys, vals):
+                if k not in self._store:
+                    raise MXNetError(f"key {k!r} not initialized")
+                if len(vlist) == 1:
+                    merged = vlist[0].copy()
+                else:
+                    acc = vlist[0].asjax()
+                    for v in vlist[1:]:
+                        acc = acc + v.asjax()
+                    merged = NDArray(acc, ctx=vlist[0].context)
+                if self._updater is not None:
+                    self._updater(k, merged, self._store[k])
+                else:
+                    self._store[k]._set(merged.asjax())
 
     def pull(self, key, out=None, priority=0):
         """Broadcast stored value into out arrays."""
         assert out is not None
         keys, outs = _ctype_key_value(key, out)
-        for k, olist in zip(keys, outs):
-            if k not in self._store:
-                raise MXNetError(f"key {k!r} not initialized")
-            src = self._store[k]
-            for o in olist:
-                # land the value in the destination's existing placement
-                # (keeps mesh-sharded arrays sharded)
-                o._set(jax.device_put(src.asjax(), o.asjax().sharding))
+        if _telemetry.enabled():
+            nbytes = _payload_bytes(outs)
+            _telemetry.counter("kvstore.pull.bytes").inc(nbytes)
+            pull_span = _telemetry.span(
+                "kvstore.pull", _hist="kvstore.pull.seconds",
+                keys=len(keys), bytes=nbytes)
+        else:
+            pull_span = _telemetry.null_span
+        with pull_span:
+            for k, olist in zip(keys, outs):
+                if k not in self._store:
+                    raise MXNetError(f"key {k!r} not initialized")
+                src = self._store[k]
+                for o in olist:
+                    # land the value in the destination's existing
+                    # placement (keeps mesh-sharded arrays sharded)
+                    o._set(jax.device_put(src.asjax(), o.asjax().sharding))
 
     # ------------------------------------------------------------ optimizer
     def set_optimizer(self, optimizer):
@@ -289,19 +317,28 @@ class KVStoreDistSync(KVStore):
         """
         from jax.experimental import multihost_utils
         self._ensure_mesh()
-        n = flat.shape[0]
-        pad = (-n) % self._local
-        if pad:
-            flat = jnp.concatenate(
-                [flat, jnp.zeros((pad,), flat.dtype)])
-        x = flat.reshape(1, self._local, -1)
-        glob = multihost_utils.host_local_array_to_global_array(
-            x, self._mesh, self._pspec("proc", "dev"))
-        red = self._sum_jit(glob)
-        loc = multihost_utils.global_array_to_host_local_array(
-            red, self._mesh, self._pspec("dev"))
-        out = jnp.ravel(loc)
-        return out[:n] if pad else out
+        if _telemetry.enabled():
+            nbytes = int(flat.size) * flat.dtype.itemsize
+            _telemetry.counter("kvstore.allreduce.bytes").inc(nbytes)
+            ar_span = _telemetry.span(
+                "kvstore.allreduce", _hist="kvstore.allreduce.seconds",
+                bytes=nbytes)
+        else:
+            ar_span = _telemetry.null_span
+        with ar_span:
+            n = flat.shape[0]
+            pad = (-n) % self._local
+            if pad:
+                flat = jnp.concatenate(
+                    [flat, jnp.zeros((pad,), flat.dtype)])
+            x = flat.reshape(1, self._local, -1)
+            glob = multihost_utils.host_local_array_to_global_array(
+                x, self._mesh, self._pspec("proc", "dev"))
+            red = self._sum_jit(glob)
+            loc = multihost_utils.global_array_to_host_local_array(
+                red, self._mesh, self._pspec("dev"))
+            out = jnp.ravel(loc)
+            return out[:n] if pad else out
 
     def _allreduce(self, arrs):
         """Batched all-reduce: bucket same-dtype arrays into flat buffers
@@ -340,33 +377,46 @@ class KVStoreDistSync(KVStore):
     # ----------------------------------------------------------------- push
     def push(self, key, value, priority=0):
         keys, vals = _ctype_key_value(key, value)
-        merged = []
-        for k, vlist in zip(keys, vals):
-            if k not in self._store:
-                raise MXNetError(f"key {k!r} not initialized")
-            acc = vlist[0].asjax()
-            for v in vlist[1:]:
-                acc = acc + v.asjax()
-            merged.append((k, vlist[0].context, acc))
-        if self._nproc > 1:
-            reduced = self._allreduce([a for _, _, a in merged])
+        if _telemetry.enabled():
+            nbytes = _payload_bytes(vals)
+            _telemetry.counter("kvstore.push.bytes").inc(nbytes)
+            push_span = _telemetry.span(
+                "kvstore.push", _hist="kvstore.push.seconds",
+                keys=len(keys), bytes=nbytes, dist=True)
         else:
-            reduced = [a for _, _, a in merged]
-        for (k, ctx, _), red in zip(merged, reduced):
-            # The bucketed all-reduce hands back each value sharded over the
-            # local `dev` mesh axis (bandwidth layout). The store replica and
-            # its optimizer state live wherever the user placed the weight —
-            # re-place the reduced gradient there so the updater's inputs are
-            # colocated (the analog of the reference copying the merged
-            # buffer back to each GPU, comm.h Broadcast).
-            store_sharding = self._store[k].asjax().sharding
-            if red.sharding != store_sharding:
-                red = jax.device_put(red, store_sharding)
-            nd_val = NDArray(red, ctx=ctx)
-            if self._updater is not None:
-                self._updater(k, nd_val, self._store[k])
+            push_span = _telemetry.null_span
+        return self._push_reduced(keys, vals, push_span)
+
+    def _push_reduced(self, keys, vals, push_span):
+        with push_span:
+            merged = []
+            for k, vlist in zip(keys, vals):
+                if k not in self._store:
+                    raise MXNetError(f"key {k!r} not initialized")
+                acc = vlist[0].asjax()
+                for v in vlist[1:]:
+                    acc = acc + v.asjax()
+                merged.append((k, vlist[0].context, acc))
+            if self._nproc > 1:
+                reduced = self._allreduce([a for _, _, a in merged])
             else:
-                self._store[k]._set(nd_val.asjax())
+                reduced = [a for _, _, a in merged]
+            for (k, ctx, _), red in zip(merged, reduced):
+                # The bucketed all-reduce hands back each value sharded
+                # over the local `dev` mesh axis (bandwidth layout). The
+                # store replica and its optimizer state live wherever the
+                # user placed the weight — re-place the reduced gradient
+                # there so the updater's inputs are colocated (the analog
+                # of the reference copying the merged buffer back to each
+                # GPU, comm.h Broadcast).
+                store_sharding = self._store[k].asjax().sharding
+                if red.sharding != store_sharding:
+                    red = jax.device_put(red, store_sharding)
+                nd_val = NDArray(red, ctx=ctx)
+                if self._updater is not None:
+                    self._updater(k, nd_val, self._store[k])
+                else:
+                    self._store[k]._set(nd_val.asjax())
 
     def _barrier(self):
         if self._nproc > 1:

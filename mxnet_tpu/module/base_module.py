@@ -14,6 +14,7 @@ import time
 
 from .. import metric as metric_mod
 from .. import ndarray as nd
+from .. import telemetry as _telemetry
 from ..model import BatchEndParam
 
 __all__ = ["BaseModule"]
@@ -82,8 +83,18 @@ class BaseModule:
         for nbatch, batch in enumerate(train_data):
             if monitor is not None:
                 monitor.tic()
-            self.forward_backward(batch)
-            self.update()
+            batch_span = _telemetry.span(
+                "module.fit.batch", _hist="module.fit.batch.seconds",
+                epoch=epoch, nbatch=nbatch)
+            with batch_span:
+                self.forward_backward(batch)
+                self.update()
+            if _telemetry.enabled():
+                _telemetry.counter("module.fit.batches").inc()
+                _telemetry.record_event(
+                    "batch_end", epoch=epoch, nbatch=nbatch,
+                    duration_us=batch_span.dur,
+                    batch_size=getattr(train_data, "batch_size", 0))
             self.update_metric(eval_metric, batch.label)
             if monitor is not None:
                 monitor.toc_print()
@@ -116,13 +127,21 @@ class BaseModule:
         for epoch in range(begin_epoch, num_epoch):
             start = time.time()
             eval_metric.reset()
-            self._fit_epoch(epoch, train_data, eval_metric,
-                            batch_end_callback, monitor)
+            with _telemetry.span("module.fit.epoch",
+                                 _hist="module.fit.epoch.seconds",
+                                 epoch=epoch):
+                self._fit_epoch(epoch, train_data, eval_metric,
+                                batch_end_callback, monitor)
 
-            for name, val in eval_metric.get_name_value():
+            name_values = eval_metric.get_name_value()
+            for name, val in name_values:
                 self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
-            self.logger.info("Epoch[%d] Time cost=%.3f", epoch,
-                             time.time() - start)
+            time_cost = time.time() - start
+            self.logger.info("Epoch[%d] Time cost=%.3f", epoch, time_cost)
+            if _telemetry.enabled():
+                _telemetry.record_event(
+                    "epoch_end", epoch=epoch, time_cost_s=time_cost,
+                    metrics={n: float(v) for n, v in name_values})
 
             # pull the trained params off-device once per epoch so callbacks
             # (checkpointing) see current values

@@ -29,6 +29,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..base import MXNetError
 from ..ndarray import NDArray, zeros as nd_zeros
 from ..io import DataDesc
+from .. import telemetry as _telemetry
 
 __all__ = ["DataParallelExecutorGroup"]
 
@@ -284,7 +285,10 @@ class DataParallelExecutorGroup:
         # out from under it (measured: "Array has been deleted" in eval
         # paths sharing those arrays). Aux (BN stats) stays undonated for
         # the same reason: eval paths read the same cells mid-epoch.
-        self._fused_prog = jax.jit(step, donate_argnums=(0, 4))
+        if _telemetry.enabled():
+            _telemetry.counter("executor.jit_cache.miss").inc()
+        self._fused_prog = _telemetry.wrap_dispatch(
+            jax.jit(step, donate_argnums=(0, 4)), "fused_step")
         self._fused_watched = watched
         from .. import random as _random
         self._fused_key = _random.next_key()   # device-chained thereafter
@@ -414,6 +418,8 @@ class DataParallelExecutorGroup:
     def _load_batch(self, data_batch):
         """Shard the batch's data (and labels, which eval graphs read)
         into the bound input arrays."""
+        load_span = _telemetry.span("io.load_batch")
+
         def load(names, arrays):
             for name, arr in zip(names, arrays):
                 dst = self.executor.arg_dict.get(name)
@@ -423,9 +429,10 @@ class DataParallelExecutorGroup:
                     jnp.asarray(np.asarray(arr))
                 dst._set(self._place(val.astype(dst.dtype), "data"))
 
-        load(self.data_names, data_batch.data)
-        if self.label_names and data_batch.label:
-            load(self.label_names, data_batch.label)
+        with load_span:
+            load(self.data_names, data_batch.data)
+            if self.label_names and data_batch.label:
+                load(self.label_names, data_batch.label)
 
     def backward(self, out_grads=None):
         assert self.for_training, "re-bind with for_training=True"

@@ -19,6 +19,7 @@ import re
 
 from .ndarray import NDArray
 from . import ndarray as nd
+from . import telemetry as _telemetry
 
 __all__ = ["Monitor"]
 
@@ -42,12 +43,14 @@ class Monitor:
         self._executors = []
         self._records = []       # (step, tensor_name, stat)
         self._step = 0
+        self._window_step = 0    # the step the open window belongs to
         self._recording = False
 
     # the executor calls this for every op output while recording
     def _observe(self, name, array):
         if self._recording and self._pattern.match(name):
-            self._records.append((self._step, name, self.stat_func(array)))
+            self._records.append(
+                (self._window_step, name, self.stat_func(array)))
 
     def install(self, exe):
         """Attach to an Executor (Module installs on its sharded exec)."""
@@ -62,24 +65,45 @@ class Monitor:
         if self._step % self.interval == 0:
             self._records = []
             self._recording = True
+            self._window_step = self._step
         self._step += 1
 
     def toc(self):
-        """Close the window; returns [(step, name, stat_str)] collected."""
+        """Close the window; returns [(step, name, stat_str)] collected.
+
+        Every tuple — op outputs observed during the window AND the
+        weights sampled here — carries the same step: the step the
+        window was opened on (``tic`` time), so records key consistently
+        as (step, name) across a whole training run."""
         if not self._recording:
             return []
-        self._recording = True
         # sample bound weights too, like the reference toc does
         for exe in self._executors:
             for name, arr in zip(exe.arg_names, exe.arg_arrays):
                 if arr is not None and self._pattern.match(name):
                     self._records.append(
-                        (self._step, name, self.stat_func(arr)))
+                        (self._window_step, name, self.stat_func(arr)))
         self._recording = False
-        out = sorted(self._records, key=lambda r: r[1]) if self.sort \
-            else list(self._records)
+        out = sorted(self._records, key=lambda r: (r[1], r[0])) \
+            if self.sort else list(self._records)
         self._records = []
+        if _telemetry.enabled():
+            for step, name, val in out:
+                try:
+                    fval = float(val)
+                except (TypeError, ValueError):
+                    continue
+                _telemetry.gauge("monitor.stat", tensor=name).set(fval)
+                _telemetry.record_event("monitor", step=step, name=name,
+                                        value=fval)
         return [(step, name, str(val)) for step, name, val in out]
+
+    def flush(self):
+        """Drop any queued stats and close the window, so interrupted
+        tic/toc cycles (an exception mid-batch, interval changes, or a
+        toc that never came) can't leak entries into the next window."""
+        self._records = []
+        self._recording = False
 
     def toc_print(self):
         """toc() + log each record."""

@@ -1,0 +1,63 @@
+"""Unified telemetry: structured spans + metrics registry + exporters.
+
+The measurement layer the reference implements engine-side in
+``src/engine/profiler.cc`` (per-op exec records -> chrome://tracing via
+MXDumpProfile), rebuilt framework-wide: every layer — executor
+(compile/run), KVStore (push/pull/collectives), the IO pipeline, and
+Module.fit — records into ONE process-wide tracer + registry, and three
+exporters serialize it:
+
+* ``telemetry.chrome_trace`` — chrome://tracing / Perfetto JSON (also
+  reachable through the reference-shaped ``mx.profiler.dump_profile()``);
+* ``telemetry.prometheus`` — Prometheus text exposition format;
+* ``telemetry.jsonl`` — JSON-lines event log (tools/parse_log.py reads it).
+
+Usage::
+
+    mx.telemetry.enable()                      # off by default
+    with mx.telemetry.span("my.phase", step=3):
+        ...
+    mx.telemetry.counter("my.items").inc(8)
+    mx.telemetry.snapshot()                    # everything, as one dict
+    mx.telemetry.chrome_trace.dump("trace.json")
+
+Naming conventions: dotted lowercase ``layer.what[.unit]`` —
+``executor.compile``, ``kvstore.push.bytes``, ``io.next.seconds``,
+``module.fit.batch.seconds``. Histograms end in a unit; counters of
+bytes end in ``.bytes``. Off by default: the disabled fast path is one
+branch per site (gated <2% on a small fit loop by
+benchmarks/telemetry_overhead.py).
+"""
+from __future__ import annotations
+
+from .core import (span, event, record_event, enable, disable, enabled,
+                   clear, get_spans, get_events, null_span, wrap_dispatch)
+from .metrics import (Counter, Gauge, Histogram, counter, gauge, histogram,
+                      get_metric)
+from . import core
+from . import metrics
+from . import chrome_trace
+from . import prometheus
+from . import jsonl
+
+__all__ = ["span", "event", "record_event", "enable", "disable", "enabled",
+           "clear", "get_spans", "get_events", "null_span", "wrap_dispatch",
+           "Counter", "Gauge", "Histogram", "counter", "gauge", "histogram",
+           "get_metric", "snapshot", "reset",
+           "chrome_trace", "prometheus", "jsonl"]
+
+
+def snapshot():
+    """The whole training step at a glance: the metrics registry plus
+    span/event buffer depths."""
+    snap = metrics.snapshot()
+    snap["spans"] = len(core.get_spans())
+    snap["events"] = len(core.get_events())
+    return snap
+
+
+def reset():
+    """Clear spans, events, and the metrics registry (the enabled/disabled
+    switch is left as-is)."""
+    core.clear()
+    metrics.reset()

@@ -1,0 +1,65 @@
+"""chrome://tracing JSON exporter.
+
+Serializes the span buffer to the Trace Event Format the reference's
+``MXDumpProfile`` emits (reference: src/engine/profiler.cc EmitPid/
+EmitEvent — "X" complete events with ts/dur in microseconds, pid/tid
+lanes, plus "M" metadata naming the lanes). The output loads in
+chrome://tracing and Perfetto alongside (or instead of) the JAX xplane
+trace dir the profiler also produces.
+"""
+from __future__ import annotations
+
+import json
+import os
+
+from . import core
+
+__all__ = ["trace_events", "render", "dump"]
+
+
+def trace_events(spans=None, events=None):
+    """Build the traceEvents list: one metadata event per (pid, tid)
+    lane, one "X" complete event per span, one "i" instant per event."""
+    spans = core.get_spans() if spans is None else spans
+    events = core.get_events() if events is None else events
+    out = []
+    lanes = {}
+    for s in spans:
+        lanes.setdefault((s.pid, s.tid), None)
+    for e in events:
+        lanes.setdefault((e["pid"], e["tid"]), None)
+    for i, (pid, tid) in enumerate(sorted(lanes)):
+        out.append({"name": "process_name", "ph": "M", "pid": pid,
+                    "args": {"name": "mxnet_tpu"}})
+        out.append({"name": "thread_name", "ph": "M", "pid": pid,
+                    "tid": tid, "args": {"name": f"thread-{i}"}})
+    for s in spans:
+        args = dict(s.args)
+        if s.parent is not None:
+            args["parent"] = s.parent
+        out.append({"name": s.name, "cat": s.name.split(".")[0],
+                    "ph": "X", "ts": s.ts, "dur": s.dur,
+                    "pid": s.pid, "tid": s.tid, "args": args})
+    for e in events:
+        out.append({"name": e["kind"], "cat": "event", "ph": "i",
+                    "ts": e["ts_us"], "pid": e["pid"], "tid": e["tid"],
+                    "s": "t", "args": dict(e["payload"])})
+    return out
+
+
+def render(metadata=None, spans=None, events=None):
+    """The full trace document as a dict."""
+    return {"traceEvents": trace_events(spans, events),
+            "displayTimeUnit": "ms",
+            "otherData": dict(metadata or {})}
+
+
+def dump(path, metadata=None, spans=None, events=None):
+    """Write the trace JSON; returns the path."""
+    doc = render(metadata, spans, events)
+    d = os.path.dirname(os.path.abspath(path))
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    return path

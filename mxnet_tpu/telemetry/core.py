@@ -1,0 +1,193 @@
+"""Span tracer core: thread-local span stack over monotonic clocks.
+
+The reference collects per-op exec records engine-side into
+``profiler.cc``'s ProfileStat ring and serializes them to chrome://tracing
+JSON on MXDumpProfile. Here the analogous record is a *span*: a named,
+nested interval measured with ``time.perf_counter_ns`` (monotonic,
+ns-resolution) carrying the thread/process ids chrome://tracing wants.
+
+Design constraints:
+
+* **Off by default, near-zero when off.** ``span()`` returns a shared
+  no-op context manager without allocating when telemetry is disabled, so
+  instrumented hot paths (Module.fit's batch loop, KVStore.push) cost one
+  function call and one branch — the tier-1 suites and production fit
+  loops are unaffected (benchmarks/telemetry_overhead.py gates this).
+* **Thread-safe.** The span *stack* (for parent attribution) is
+  thread-local; the finished-span buffer is shared under one lock, so
+  PrefetchingIter's producer thread and the main loop interleave safely.
+* **Pure stdlib.** No jax/numpy imports — any layer of the framework can
+  import telemetry without ordering constraints.
+
+Spans are buffered in-process until an exporter (chrome_trace, prometheus,
+jsonl) drains a copy; ``clear()`` resets between runs.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+__all__ = ["span", "event", "record_event", "enable", "disable", "enabled",
+           "clear", "get_spans", "get_events", "null_span", "wrap_dispatch"]
+
+_lock = threading.Lock()
+_local = threading.local()
+_spans = []        # finished Span objects, completion order
+_events = []       # instant events: dicts with kind/ts_us/pid/tid/payload
+_enabled = False
+
+
+def _stack():
+    st = getattr(_local, "stack", None)
+    if st is None:
+        st = _local.stack = []
+    return st
+
+
+class _NullSpan:
+    """Shared do-nothing span for the disabled fast path."""
+
+    __slots__ = ()
+    dur = 0
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def set(self, **kwargs):
+        return self
+
+
+null_span = _NullSpan()
+
+
+class Span:
+    """One named interval. ``ts``/``dur`` are microseconds on the
+    perf_counter timeline (chrome://tracing's native unit)."""
+
+    __slots__ = ("name", "args", "ts", "dur", "pid", "tid", "parent",
+                 "depth", "_hist")
+
+    def __init__(self, name, args, hist=None):
+        self.name = name
+        self.args = args
+        self.ts = 0
+        self.dur = 0
+        self.pid = os.getpid()
+        self.tid = threading.get_ident()
+        self.parent = None
+        self.depth = 0
+        self._hist = hist
+
+    def set(self, **kwargs):
+        self.args.update(kwargs)
+        return self
+
+    def __enter__(self):
+        st = _stack()
+        if st:
+            self.parent = st[-1].name
+            self.depth = len(st)
+        st.append(self)
+        self.ts = time.perf_counter_ns() // 1000
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.dur = time.perf_counter_ns() // 1000 - self.ts
+        st = _stack()
+        if st and st[-1] is self:
+            st.pop()
+        with _lock:
+            _spans.append(self)
+        if self._hist is not None:
+            from .metrics import histogram
+            histogram(self._hist).observe(self.dur / 1e6)
+        return False
+
+
+def span(name, _hist=None, **args):
+    """Context manager measuring a named interval.
+
+    No-op (shared singleton, no allocation) while telemetry is disabled.
+    ``_hist`` names a histogram that additionally receives the duration
+    in seconds, so one call site feeds both the trace and the registry.
+    """
+    if not _enabled:
+        return null_span
+    return Span(name, args, hist=_hist)
+
+
+def event(kind, **payload):
+    """Record an instant event (chrome 'i' phase / one jsonl line)."""
+    if not _enabled:
+        return
+    rec = {"kind": kind, "ts_us": time.perf_counter_ns() // 1000,
+           "pid": os.getpid(), "tid": threading.get_ident(),
+           "payload": payload}
+    with _lock:
+        _events.append(rec)
+
+
+# the structured-log spelling of the same record (jsonl exporter)
+record_event = event
+
+
+def enable():
+    global _enabled
+    _enabled = True
+
+
+def disable():
+    global _enabled
+    _enabled = False
+
+
+def enabled():
+    return _enabled
+
+
+def clear():
+    """Drop buffered spans/events (metrics have their own reset)."""
+    with _lock:
+        del _spans[:]
+        del _events[:]
+
+
+def get_spans():
+    with _lock:
+        return list(_spans)
+
+
+def get_events():
+    with _lock:
+        return list(_events)
+
+
+def wrap_dispatch(fn, kind, compiled=True):
+    """Wrap a (possibly jitted) program so each dispatch records a span.
+
+    The first dispatch of a jitted program is where jax traces + XLA
+    compiles, so it reports as ``executor.compile`` (the analog of the
+    reference's graph-init segment in its profile) and every later one as
+    ``executor.run``. Uncompiled programs (NaiveEngine) always report
+    ``executor.run``. Disabled telemetry costs one extra frame + branch.
+    """
+    state = {"first": compiled}
+
+    def dispatch(*args):
+        first, state["first"] = state["first"], False
+        if not _enabled:
+            return fn(*args)
+        name = "executor.compile" if first else "executor.run"
+        from .metrics import counter
+        counter(name + ".calls", kind=kind).inc()
+        with Span(name, {"kind": kind}, hist=name + ".seconds"):
+            return fn(*args)
+
+    dispatch.__wrapped__ = fn
+    if hasattr(fn, "lower"):     # keep jitted introspection reachable
+        dispatch.lower = fn.lower
+    return dispatch

@@ -1,0 +1,66 @@
+"""JSON-lines event-log exporter.
+
+One self-describing JSON object per line — the machine-readable training
+log that tools/parse_log.py consumes for throughput extraction (the
+structured sibling of the reference's Speedometer log lines):
+
+    {"type": "event", "kind": "batch_end", "epoch": 0, "nbatch": 3, ...}
+    {"type": "span", "name": "kvstore.push", "ts_us": ..., "dur_us": ...}
+    {"type": "counter", "name": "kvstore.push.bytes", "value": 123456}
+
+Events flatten their payload into the line (epoch/nbatch/duration at top
+level) so downstream line-oriented tooling (jq, parse_log) never digs
+through nesting.
+"""
+from __future__ import annotations
+
+import json
+import os
+
+from . import core
+from . import metrics as _metrics
+
+__all__ = ["lines", "render", "dump"]
+
+
+def lines(spans=True, events=True, metrics=True):
+    """Yield the log as dicts, events first (they are what log consumers
+    key on), then spans in completion order, then the registry."""
+    if events:
+        for e in core.get_events():
+            rec = {"type": "event", "kind": e["kind"], "ts_us": e["ts_us"]}
+            for k, v in e["payload"].items():
+                rec.setdefault(k, v)
+            yield rec
+    if spans:
+        for s in core.get_spans():
+            yield {"type": "span", "name": s.name, "ts_us": s.ts,
+                   "dur_us": s.dur, "pid": s.pid, "tid": s.tid,
+                   "parent": s.parent, "args": dict(s.args)}
+    if metrics:
+        for m in _metrics.all_metrics():
+            labels = dict(m.labels)
+            if isinstance(m, _metrics.Counter):
+                yield {"type": "counter", "name": m.name, "labels": labels,
+                       "value": m.value}
+            elif isinstance(m, _metrics.Gauge):
+                yield {"type": "gauge", "name": m.name, "labels": labels,
+                       "value": m.value}
+            elif isinstance(m, _metrics.Histogram):
+                yield {"type": "histogram", "name": m.name,
+                       "labels": labels, "count": m.count, "sum": m.sum,
+                       "min": m.min, "max": m.max, "mean": m.mean}
+
+
+def render(**kwargs):
+    return "\n".join(json.dumps(rec) for rec in lines(**kwargs)) + "\n"
+
+
+def dump(path, **kwargs):
+    """Write the event log; returns the path."""
+    d = os.path.dirname(os.path.abspath(path))
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "w") as f:
+        f.write(render(**kwargs))
+    return path

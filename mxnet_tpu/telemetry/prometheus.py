@@ -1,0 +1,121 @@
+"""Prometheus text-format exporter (exposition format 0.0.4).
+
+Renders the metrics registry as the plain-text scrape format: counters
+get a ``_total`` suffix, histograms expand into cumulative ``_bucket``
+series plus ``_sum``/``_count``, metric names are sanitized to the
+``[a-zA-Z_:][a-zA-Z0-9_:]*`` grammar (dots become underscores) and
+every family is prefixed ``mxnet_`` so a co-scraped process namespace
+stays clean. ``parse()`` reads the same format back — the round-trip
+used by the tests and by tools/parse_log.py.
+"""
+from __future__ import annotations
+
+import os
+import re
+
+from . import metrics as _metrics
+
+__all__ = ["render", "dump", "parse", "sanitize"]
+
+_NAME_OK = re.compile(r"[^a-zA-Z0-9_:]")
+
+PREFIX = "mxnet_"
+
+
+def sanitize(name):
+    """Metric-family name in Prometheus grammar, ``mxnet_`` prefixed."""
+    s = _NAME_OK.sub("_", name)
+    if not s.startswith(PREFIX):
+        s = PREFIX + s
+    return s
+
+
+def _labels_text(labels, extra=None):
+    items = list(labels) + list(extra or [])
+    if not items:
+        return ""
+    inner = ",".join(f'{_NAME_OK.sub("_", k)}="{v}"' for k, v in items)
+    return "{" + inner + "}"
+
+
+def _fmt(v):
+    if v is None:
+        return "NaN"
+    f = float(v)
+    return str(int(f)) if f == int(f) else repr(f)
+
+
+def render():
+    """The registry as exposition text."""
+    lines = []
+    seen_types = set()
+
+    def header(fam, typ):
+        if fam not in seen_types:
+            lines.append(f"# TYPE {fam} {typ}")
+            seen_types.add(fam)
+
+    for m in _metrics.all_metrics():
+        fam = sanitize(m.name)
+        if isinstance(m, _metrics.Counter):
+            fam += "_total"
+            header(fam, "counter")
+            lines.append(f"{fam}{_labels_text(m.labels)} {_fmt(m.value)}")
+        elif isinstance(m, _metrics.Gauge):
+            header(fam, "gauge")
+            lines.append(f"{fam}{_labels_text(m.labels)} {_fmt(m.value)}")
+        elif isinstance(m, _metrics.Histogram):
+            header(fam, "histogram")
+            for le, c in m.cumulative():
+                lines.append(
+                    f"{fam}_bucket"
+                    f"{_labels_text(m.labels, [('le', _fmt(le))])} {c}")
+            lines.append(
+                f"{fam}_bucket"
+                f"{_labels_text(m.labels, [('le', '+Inf')])} {m.count}")
+            lines.append(f"{fam}_sum{_labels_text(m.labels)} {_fmt(m.sum)}")
+            lines.append(f"{fam}_count{_labels_text(m.labels)} {m.count}")
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+def dump(path):
+    """Write the exposition text; returns the path."""
+    d = os.path.dirname(os.path.abspath(path))
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "w") as f:
+        f.write(render())
+    return path
+
+
+_SAMPLE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>[^}]*)\})?\s+(?P<value>\S+)\s*$")
+_LABEL = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="([^"]*)"')
+
+
+def parse(text):
+    """Exposition text -> {series_key: float}, with series_key rendered
+    exactly like ``Metric.key`` (name{k="v",...}) so round-trips compare
+    structurally. ``# TYPE`` lines come back under the "__types__" key."""
+    out = {}
+    types = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("#"):
+            parts = line.split()
+            if len(parts) >= 4 and parts[1] == "TYPE":
+                types[parts[2]] = parts[3]
+            continue
+        m = _SAMPLE.match(line)
+        if m is None:
+            raise ValueError(f"unparseable sample line: {line!r}")
+        labels = sorted(_LABEL.findall(m.group("labels") or ""))
+        key = m.group("name")
+        if labels:
+            key += "{" + ",".join(f'{k}="{v}"' for k, v in labels) + "}"
+        out[key] = float(m.group("value"))
+    out["__types__"] = types
+    return out

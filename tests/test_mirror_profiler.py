@@ -95,6 +95,43 @@ def test_mirror_reduces_backward_memory():
     assert res_bytes[True] < 0.6 * res_bytes[False], res_bytes
 
 
+def test_telemetry_per_op_attribution_matches_graph():
+    """The telemetry tracer sees the same per-op structure named_scope
+    bakes into HLO: one op_dispatch counter series per registered op and
+    op.* spans carrying node names, nested under executor.compile."""
+    from mxnet_tpu import telemetry as tm
+    tm.disable()
+    tm.reset()
+    try:
+        data = mx.sym.var("data")
+        c = mx.sym.Convolution(data, kernel=(3, 3), num_filter=4,
+                               name="tmconv")
+        a = mx.sym.Activation(c, act_type="relu", name="tmrelu")
+        out = mx.sym.FullyConnected(mx.sym.Flatten(a), num_hidden=3,
+                                    name="tmfc")
+        exe = out.simple_bind(ctx=mx.cpu(), data=(2, 3, 8, 8))
+        tm.enable()
+        exe.forward(is_train=False)
+        exe.outputs[0].asnumpy()
+        snap = tm.snapshot()
+        for op in ("Convolution", "Activation", "Flatten",
+                   "FullyConnected"):
+            key = f'executor.op_dispatch{{op="{op}"}}'
+            assert snap["counters"].get(key, 0) >= 1, (key,
+                                                       snap["counters"])
+        spans = tm.get_spans()
+        node_names = {s.args.get("node") for s in spans
+                      if s.name.startswith("op.")}
+        assert {"tmconv", "tmrelu", "tmfc"} <= node_names
+        # trace-time op spans nest under the compile-dispatch span
+        op_parents = {s.parent for s in spans if s.name.startswith("op.")}
+        assert "executor.compile" in op_parents
+        assert snap["counters"].get("executor.jit_cache.miss", 0) == 1
+    finally:
+        tm.disable()
+        tm.reset()
+
+
 def test_named_scope_carries_node_names_into_hlo():
     """Every graph node executes under jax.named_scope(node.name), so the
     compiled HLO metadata carries Symbol names (profiler trace mapping)."""

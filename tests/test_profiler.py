@@ -1,12 +1,25 @@
 """Profiler (reference: tests/python/unittest/test_profiler.py —
 set_config/run/stop writes a trace; per-op names flow into it via the
-executor's jax.named_scope wrapping)."""
+executor's jax.named_scope wrapping AND the telemetry span tracer, whose
+chrome://tracing JSON dump_profile() now emits like MXDumpProfile)."""
 import glob
+import json
 import os
 
 import numpy as np
+import pytest
 
 import mxnet_tpu as mx
+from mxnet_tpu import telemetry as tm
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    tm.disable()
+    tm.reset()
+    yield
+    tm.disable()
+    tm.reset()
 
 
 def test_profiler_trace_roundtrip(tmp_path):
@@ -20,13 +33,68 @@ def test_profiler_trace_roundtrip(tmp_path):
     exe.forward(is_train=False)
     exe.outputs[0].asnumpy()
     mx.profiler.profiler_set_state("stop")
-    trace_dir = mx.profiler.dump_profile()
-    assert trace_dir and os.path.isdir(trace_dir)
+    path = mx.profiler.dump_profile()
+    # the chrome trace JSON at the configured filename...
+    assert path == str(tmp_path / "prof.json") and os.path.isfile(path)
+    doc = json.load(open(path))
+    assert doc["traceEvents"], "trace is empty"
+    names = {e["name"] for e in doc["traceEvents"] if e["ph"] == "X"}
+    assert "executor.bind" in names
+    assert any(n.startswith("op.") for n in names)
+    # ...plus the JAX xplane trace dir referenced in its metadata
+    trace_dir = doc["otherData"]["jax_trace_dir"]
+    assert os.path.isdir(trace_dir)
     files = glob.glob(os.path.join(trace_dir, "**", "*"), recursive=True)
     assert any(os.path.isfile(f) for f in files), "no trace artifacts"
 
 
+def test_profiler_full_step_trace_schema(tmp_path):
+    """ISSUE 1 acceptance: run -> train 2 batches -> dump_profile()
+    yields schema-valid chrome://tracing JSON containing spans for
+    compile, op execution, kvstore push/pull, and data loading."""
+    mx.profiler.profiler_set_config(mode="all",
+                                    filename=str(tmp_path / "fit.json"))
+    mx.profiler.profiler_set_state("run")
+    X = np.random.rand(8, 10).astype("f")
+    Y = (np.random.rand(8) * 3).astype("f")
+    it = mx.io.NDArrayIter(X, Y, batch_size=4)    # 2 batches
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(mx.sym.var("data"), num_hidden=3),
+        name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.fit(it, num_epoch=1, kvstore="dist_sync",
+            optimizer_params={"learning_rate": 0.1})
+    path = mx.profiler.dump_profile()
+    doc = json.load(open(path))
+    events = doc["traceEvents"]
+    for e in events:                       # chrome trace event schema
+        assert isinstance(e["name"], str) and e["name"]
+        assert e["ph"] in ("X", "M", "i")
+        assert isinstance(e["pid"], int)
+        if e["ph"] == "X":
+            assert isinstance(e["tid"], int)
+            assert isinstance(e["ts"], int)
+            assert isinstance(e["dur"], int) and e["dur"] >= 0
+    names = {e["name"] for e in events if e["ph"] == "X"}
+    assert "executor.compile" in names         # compile
+    assert any(n.startswith("op.") for n in names)   # op execution
+    assert "kvstore.push" in names and "kvstore.pull" in names
+    assert "io.next" in names                  # data loading
+    assert "module.fit.batch" in names
+
+
+def test_dump_profile_without_trace_returns_filename(tmp_path):
+    """Satellite fix: dump_profile() with no trace ever started must
+    return the configured filename (a real written file), never None."""
+    target = str(tmp_path / "cold.json")
+    mx.profiler.profiler_set_config(filename=target)
+    path = mx.profiler.dump_profile()
+    assert path == target
+    assert os.path.isfile(path)
+    doc = json.load(open(path))
+    assert "traceEvents" in doc
+
+
 def test_profiler_rejects_bad_state():
-    import pytest
     with pytest.raises(ValueError):
         mx.profiler.profiler_set_state("pause")

@@ -1,0 +1,329 @@
+"""Telemetry subsystem: spans, metrics registry, exporters, integration.
+
+Covers the ISSUE 1 acceptance surface: span nesting/ordering, counter/
+histogram math, chrome-trace JSON schema (traceEvents with ph/ts/dur/
+pid/tid), Prometheus text round-trip, Speedometer/Monitor registry
+integration, and the end-to-end snapshot after a dist-sync fit smoke run
+(compile-cache hit/miss + KVStore byte counters nonzero).
+"""
+import json
+import logging
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import telemetry as tm
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    tm.disable()
+    tm.reset()
+    yield
+    tm.disable()
+    tm.reset()
+
+
+# --------------------------------------------------------------- span core
+def test_span_disabled_is_noop_singleton():
+    assert not tm.enabled()
+    s1 = tm.span("anything", k=1)
+    s2 = tm.span("else")
+    assert s1 is s2 is tm.null_span
+    with s1:
+        pass
+    assert tm.get_spans() == []
+
+
+def test_span_nesting_and_ordering():
+    tm.enable()
+    with tm.span("outer", phase=1):
+        with tm.span("inner.a"):
+            pass
+        with tm.span("inner.b"):
+            pass
+    spans = tm.get_spans()
+    # completion order: children close before the parent
+    assert [s.name for s in spans] == ["inner.a", "inner.b", "outer"]
+    by_name = {s.name: s for s in spans}
+    assert by_name["inner.a"].parent == "outer"
+    assert by_name["inner.b"].parent == "outer"
+    assert by_name["outer"].parent is None
+    assert by_name["inner.a"].depth == 1 and by_name["outer"].depth == 0
+    # children are contained in the parent's interval
+    o = by_name["outer"]
+    for child in ("inner.a", "inner.b"):
+        c = by_name[child]
+        assert c.ts >= o.ts
+        assert c.ts + c.dur <= o.ts + o.dur
+    assert o.args == {"phase": 1}
+
+
+def test_span_survives_exception_and_pops_stack():
+    tm.enable()
+    with pytest.raises(RuntimeError):
+        with tm.span("failing"):
+            raise RuntimeError("boom")
+    with tm.span("after"):
+        pass
+    spans = {s.name: s for s in tm.get_spans()}
+    assert set(spans) == {"failing", "after"}
+    assert spans["after"].parent is None  # stack fully unwound
+
+
+def test_span_feeds_histogram():
+    tm.enable()
+    with tm.span("timed", _hist="timed.seconds"):
+        pass
+    h = tm.get_metric("timed.seconds")
+    assert h is not None and h.count == 1
+
+
+# ----------------------------------------------------------------- metrics
+def test_counter_math_and_labels():
+    c = tm.counter("widgets")
+    c.inc().inc(4)
+    assert c.value == 5
+    assert tm.counter("widgets") is c          # create-or-get
+    c2 = tm.counter("widgets", kind="blue")
+    assert c2 is not c and c2.value == 0
+    with pytest.raises(ValueError):
+        c.inc(-1)
+    assert c2.key == 'widgets{kind="blue"}'
+
+
+def test_gauge_set_inc_dec():
+    g = tm.gauge("depth")
+    g.set(3.5)
+    assert g.value == 3.5
+    g.inc(2)
+    g.dec()
+    assert g.value == 4.5
+
+
+def test_histogram_buckets_and_stats():
+    h = tm.histogram("lat", buckets=(0.1, 1.0, 10.0))
+    for v in (0.05, 0.5, 5.0, 50.0):
+        h.observe(v)
+    assert h.count == 4
+    assert h.sum == pytest.approx(55.55)
+    assert h.min == 0.05 and h.max == 50.0
+    assert h.mean == pytest.approx(55.55 / 4)
+    # cumulative bucket counts: <=0.1 -> 1, <=1.0 -> 2, <=10.0 -> 3
+    assert h.cumulative() == [(0.1, 1), (1.0, 2), (10.0, 3)]
+
+
+def test_metric_type_collision_raises():
+    tm.counter("clash")
+    with pytest.raises(TypeError):
+        tm.gauge("clash")
+
+
+def test_snapshot_shape():
+    tm.counter("a").inc(2)
+    tm.gauge("b").set(7)
+    tm.histogram("c").observe(0.5)
+    snap = tm.snapshot()
+    assert snap["counters"]["a"] == 2
+    assert snap["gauges"]["b"] == 7.0
+    assert snap["histograms"]["c"]["count"] == 1
+    assert "spans" in snap and "events" in snap
+
+
+# ----------------------------------------------------------- chrome trace
+def _valid_trace_event(e):
+    assert isinstance(e["name"], str) and e["name"]
+    assert e["ph"] in ("X", "M", "i")
+    assert isinstance(e["pid"], int)
+    if e["ph"] == "X":
+        assert isinstance(e["tid"], int)
+        assert isinstance(e["ts"], int) and e["ts"] >= 0
+        assert isinstance(e["dur"], int) and e["dur"] >= 0
+        assert isinstance(e["args"], dict)
+
+
+def test_chrome_trace_schema(tmp_path):
+    tm.enable()
+    with tm.span("parent"):
+        with tm.span("child", op="FC"):
+            pass
+    tm.record_event("marker", epoch=0)
+    path = tm.chrome_trace.dump(str(tmp_path / "trace.json"),
+                                metadata={"mode": "test"})
+    doc = json.load(open(path))
+    assert set(doc) == {"traceEvents", "displayTimeUnit", "otherData"}
+    assert doc["otherData"]["mode"] == "test"
+    events = doc["traceEvents"]
+    for e in events:
+        _valid_trace_event(e)
+    complete = [e for e in events if e["ph"] == "X"]
+    assert {e["name"] for e in complete} == {"parent", "child"}
+    child = next(e for e in complete if e["name"] == "child")
+    assert child["args"]["op"] == "FC"
+    assert child["args"]["parent"] == "parent"
+    assert [e["name"] for e in events if e["ph"] == "i"] == ["marker"]
+    # lane metadata present for the emitting thread
+    assert any(e["ph"] == "M" and e["name"] == "thread_name"
+               for e in events)
+
+
+# ------------------------------------------------------------- prometheus
+def test_prometheus_round_trip():
+    tm.counter("kvstore.push.bytes").inc(1024)
+    tm.counter("executor.op_dispatch", op="Convolution").inc(3)
+    tm.gauge("speedometer.samples_per_sec").set(1234.5)
+    h = tm.histogram("module.fit.batch.seconds", buckets=(0.01, 0.1, 1.0))
+    h.observe(0.05)
+    h.observe(0.5)
+    text = tm.prometheus.render()
+    parsed = tm.prometheus.parse(text)
+    types = parsed.pop("__types__")
+    assert types["mxnet_kvstore_push_bytes_total"] == "counter"
+    assert types["mxnet_speedometer_samples_per_sec"] == "gauge"
+    assert types["mxnet_module_fit_batch_seconds"] == "histogram"
+    assert parsed["mxnet_kvstore_push_bytes_total"] == 1024
+    assert parsed[
+        'mxnet_executor_op_dispatch_total{op="Convolution"}'] == 3
+    assert parsed["mxnet_speedometer_samples_per_sec"] == 1234.5
+    assert parsed['mxnet_module_fit_batch_seconds_bucket{le="0.1"}'] == 1
+    assert parsed['mxnet_module_fit_batch_seconds_bucket{le="+Inf"}'] == 2
+    assert parsed["mxnet_module_fit_batch_seconds_count"] == 2
+    assert parsed["mxnet_module_fit_batch_seconds_sum"] == \
+        pytest.approx(0.55)
+
+
+# ------------------------------------------------------------------ jsonl
+def test_jsonl_event_log(tmp_path):
+    tm.enable()
+    tm.record_event("batch_end", epoch=0, nbatch=1, duration_us=2000,
+                    batch_size=32)
+    with tm.span("kvstore.push", bytes=64):
+        pass
+    tm.counter("io.batches", iter="NDArrayIter").inc(7)
+    path = tm.jsonl.dump(str(tmp_path / "events.jsonl"))
+    recs = [json.loads(ln) for ln in open(path) if ln.strip()]
+    by_type = {}
+    for r in recs:
+        by_type.setdefault(r["type"], []).append(r)
+    ev = by_type["event"][0]
+    assert ev["kind"] == "batch_end" and ev["epoch"] == 0
+    assert ev["batch_size"] == 32                # payload flattened
+    sp = by_type["span"][0]
+    assert sp["name"] == "kvstore.push" and sp["dur_us"] >= 0
+    ctr = by_type["counter"][0]
+    assert ctr["name"] == "io.batches" and ctr["value"] == 7
+    assert ctr["labels"] == {"iter": "NDArrayIter"}
+
+
+# ------------------------------------------------- monitor / speedometer
+def test_monitor_records_into_registry_and_flush():
+    tm.enable()
+    mon = mx.Monitor(interval=1, pattern=".*fc.*")
+    x = mx.sym.var("data")
+    out = mx.sym.FullyConnected(x, num_hidden=4, name="monfc")
+    exe = out.simple_bind(ctx=mx.cpu(), data=(2, 3))
+    mon.install(exe)
+    exe.arg_dict["data"][:] = np.ones((2, 3), "f")
+    mon.tic()
+    exe.forward(is_train=False)
+    records = mon.toc()
+    assert records, "monitor collected nothing"
+    steps = {r[0] for r in records}
+    assert steps == {0}, "all window records must share the tic step"
+    # registry gauges exist for observed tensors
+    names = [r[1] for r in records]
+    g = tm.get_metric("monitor.stat", tensor=names[0])
+    assert g is not None and g.value == pytest.approx(float(records[0][2]))
+    # monitor events landed in the buffer
+    kinds = [e["kind"] for e in tm.get_events()]
+    assert "monitor" in kinds
+
+    # flush drops queued entries so cycles don't leak
+    mon.tic()
+    exe.forward(is_train=False)
+    mon.flush()
+    assert mon.toc() == []          # window was discarded
+
+
+def test_monitor_repeated_cycles_do_not_leak():
+    mon = mx.Monitor(interval=1, pattern=".*fc.*")
+    x = mx.sym.var("data")
+    out = mx.sym.FullyConnected(x, num_hidden=4, name="leakfc")
+    exe = out.simple_bind(ctx=mx.cpu(), data=(2, 3))
+    mon.install(exe)
+    exe.arg_dict["data"][:] = np.ones((2, 3), "f")
+    sizes = []
+    for _ in range(3):
+        mon.tic()
+        exe.forward(is_train=False)
+        sizes.append(len(mon.toc()))
+    assert sizes[0] == sizes[1] == sizes[2], sizes
+
+
+def test_speedometer_records_into_registry():
+    tm.enable()
+    speedo = mx.callback.Speedometer(batch_size=32, frequent=2)
+    metric = mx.metric.create("acc")
+    from mxnet_tpu.model import BatchEndParam
+    for nbatch in range(1, 5):
+        speedo(BatchEndParam(epoch=0, nbatch=nbatch, eval_metric=None,
+                             locals=None))
+    g = tm.get_metric("speedometer.samples_per_sec")
+    assert g is not None and g.value > 0
+    speeds = [e for e in tm.get_events() if e["kind"] == "speed"]
+    assert speeds and speeds[-1]["payload"]["samples_per_sec"] == g.value
+
+
+# ------------------------------------------------------- fit integration
+def _fit_smoke(kvstore, num_epoch=1, batch_size=4, n=8):
+    X = np.random.rand(n, 10).astype("f")
+    Y = (np.random.rand(n) * 3).astype("f")
+    it = mx.io.NDArrayIter(X, Y, batch_size=batch_size)
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(mx.sym.var("data"), num_hidden=3),
+        name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu(),
+                        logger=logging.getLogger("telemetry_smoke"))
+    mod.fit(it, num_epoch=num_epoch, kvstore=kvstore,
+            optimizer_params={"learning_rate": 0.1})
+    return mod
+
+
+def test_snapshot_after_dist_sync_fit():
+    """ISSUE 1 acceptance: compile-cache hit/miss and KVStore byte
+    counters are nonzero after a dist-sync fit smoke run."""
+    tm.enable()
+    _fit_smoke("dist_sync")
+    snap = tm.snapshot()
+    c = snap["counters"]
+    assert c.get("executor.jit_cache.miss", 0) > 0
+    assert c.get("executor.jit_cache.hit", 0) > 0
+    assert c.get("kvstore.push.bytes", 0) > 0
+    assert c.get("kvstore.pull.bytes", 0) > 0
+    assert c.get("module.fit.batches", 0) == 2
+    # per-op dispatch attribution from the registry
+    assert any(k.startswith("executor.op_dispatch")
+               for k in c), list(c)
+    # span timeline covers the whole step
+    names = {s.name for s in tm.get_spans()}
+    for need in ("executor.compile", "kvstore.push", "kvstore.pull",
+                 "io.next", "io.load_batch", "module.fit.batch",
+                 "module.fit.epoch"):
+        assert need in names, (need, sorted(names))
+    assert any(n.startswith("op.") for n in names)
+    # batch histograms populated
+    h = snap["histograms"].get("module.fit.batch.seconds")
+    assert h and h["count"] == 2
+    # events for the jsonl log
+    kinds = [e["kind"] for e in tm.get_events()]
+    assert kinds.count("batch_end") == 2
+    assert kinds.count("epoch_end") == 1
+
+
+def test_fit_disabled_telemetry_records_nothing():
+    _fit_smoke("local")
+    assert tm.get_spans() == []
+    assert tm.get_events() == []
+    snap = tm.snapshot()
+    assert snap["counters"] == {}

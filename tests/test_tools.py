@@ -52,6 +52,59 @@ def test_parse_log_table_and_gate(tmp_path):
     assert bad.returncode == 1
 
 
+TELEMETRY_LOG = "\n".join([
+    '{"type": "event", "kind": "batch_end", "epoch": 0, "nbatch": 0,'
+    ' "duration_us": 100000, "batch_size": 32}',
+    '{"type": "event", "kind": "batch_end", "epoch": 0, "nbatch": 1,'
+    ' "duration_us": 100000, "batch_size": 32}',
+    '{"type": "event", "kind": "epoch_end", "epoch": 0,'
+    ' "time_cost_s": 10.5, "metrics": {"accuracy": 0.612}}',
+    '{"type": "event", "kind": "speed", "epoch": 1, "nbatch": 20,'
+    ' "samples_per_sec": 140.0}',
+    '{"type": "event", "kind": "epoch_end", "epoch": 1,'
+    ' "time_cost_s": 9.1, "metrics": {"accuracy": 0.89}}',
+    '{"type": "span", "name": "kvstore.push", "ts_us": 1, "dur_us": 2,'
+    ' "pid": 1, "tid": 1, "parent": null, "args": {}}',
+    '{"type": "counter", "name": "io.batches", "labels": {},'
+    ' "value": 2}',
+]) + "\n"
+
+
+def test_parse_log_telemetry_jsonl(tmp_path):
+    """The telemetry jsonl event log parses into the same epoch table:
+    epoch_end -> time/metrics, batch_end durations -> derived
+    throughput, Speedometer speed events preferred when present."""
+    sys.path.insert(0, TOOLS)
+    import parse_log
+    lines = TELEMETRY_LOG.splitlines()
+    assert parse_log.looks_like_telemetry(lines)
+    assert not parse_log.looks_like_telemetry(SAMPLE_LOG.splitlines())
+    table = parse_log.parse_telemetry(lines)
+    assert table[0]["train"]["accuracy"] == 0.612
+    assert table[0]["time"] == 10.5
+    # derived from batch_end: 32 samples / 0.1 s = 320 samples/s
+    assert table[0]["speed"] == pytest.approx(320.0)
+    # epoch 1 has an explicit speed event, which wins over derivation
+    assert table[1]["speed"] == pytest.approx(140.0)
+    assert table[1]["train"]["accuracy"] == 0.89
+
+    # the CLI auto-detects the format and the gate works on it
+    log = tmp_path / "telemetry.jsonl"
+    log.write_text(TELEMETRY_LOG)
+    cli = os.path.join(TOOLS, "parse_log.py")
+    ok = subprocess.run([sys.executable, cli, str(log), "--format", "csv",
+                         "--check-val", "accuracy:0.95"],
+                        capture_output=True, text=True)
+    # no validation metrics in this log -> gate reports missing (rc 2)
+    assert ok.returncode == 2, (ok.stdout, ok.stderr)
+    r = subprocess.run([sys.executable, cli, str(log), "--format", "csv"],
+                       capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    rows = r.stdout.strip().splitlines()
+    assert rows[0].startswith("epoch,")
+    assert "0.890000" in rows[2] and "140.0" in rows[2]
+
+
 def test_bandwidth_tool_local():
     r = subprocess.run(
         [sys.executable, os.path.join(TOOLS, "bandwidth.py"),

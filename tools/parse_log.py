@@ -10,8 +10,16 @@ Parses this framework's fit log lines:
     Epoch[3] Validation-accuracy=0.887000
     Epoch[3] Batch[40] speed=1234.56 samples/s ...
 
+Also parses the telemetry JSON-lines event log (mxnet_tpu.telemetry.jsonl
+— ``{"type": "event", "kind": "epoch_end"|"batch_end"|"speed", ...}`` one
+object per line): epoch times/metrics come from ``epoch_end`` records and
+throughput from ``batch_end`` durations (or ``speed`` events when a
+Speedometer ran). Detection is automatic — a log whose first
+non-blank line is a JSON object takes the telemetry path.
+
 Usage:
     python tools/parse_log.py train.log [--format markdown|csv]
+    python tools/parse_log.py telemetry.jsonl   (same table, same gates)
     python tools/parse_log.py train.log --check-val accuracy:0.85
         (exit 1 if the final validation metric is below the threshold —
          the nightly gating mode)
@@ -19,6 +27,7 @@ Usage:
 from __future__ import annotations
 
 import argparse
+import json
 import re
 import sys
 from collections import defaultdict
@@ -49,6 +58,61 @@ def parse(lines):
         m = BATCH_SPEED.search(line)
         if m:
             out[int(m.group(1))]["_speeds"].append(float(m.group(2)))
+    for rec in out.values():
+        sp = rec.pop("_speeds")
+        rec["speed"] = sum(sp) / len(sp) if sp else None
+    return dict(out)
+
+
+def looks_like_telemetry(lines):
+    """True when the first non-blank line is a JSON object (the
+    telemetry jsonl log); leaves nothing consumed for list inputs."""
+    for line in lines:
+        line = line.strip()
+        if line:
+            return line.startswith("{")
+    return False
+
+
+def parse_telemetry(lines):
+    """Telemetry jsonl -> the same table shape ``parse`` produces.
+
+    Epoch rows come from ``epoch_end`` events (time cost + train
+    metrics). Throughput prefers explicit Speedometer ``speed`` events;
+    otherwise it is derived from ``batch_end`` durations as
+    batch_size / duration (the batches/sec * batch-size identity).
+    """
+    out = defaultdict(lambda: {"train": {}, "val": {},
+                               "time": None, "_speeds": []})
+    derived = defaultdict(list)
+    has_speed_events = set()
+    for line in lines:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if rec.get("type") != "event":
+            continue
+        kind = rec.get("kind")
+        epoch = rec.get("epoch")
+        if kind == "epoch_end" and epoch is not None:
+            out[int(epoch)]["time"] = rec.get("time_cost_s")
+            for name, val in (rec.get("metrics") or {}).items():
+                out[int(epoch)]["train"][name] = float(val)
+        elif kind == "speed" and epoch is not None:
+            out[int(epoch)]["_speeds"].append(float(rec["samples_per_sec"]))
+            has_speed_events.add(int(epoch))
+        elif kind == "batch_end" and epoch is not None:
+            dur_us = rec.get("duration_us") or 0
+            bs = rec.get("batch_size") or 0
+            if dur_us > 0 and bs > 0:
+                derived[int(epoch)].append(bs / (dur_us / 1e6))
+    for epoch, speeds in derived.items():
+        if epoch not in has_speed_events:
+            out[epoch]["_speeds"].extend(speeds)
     for rec in out.values():
         sp = rec.pop("_speeds")
         rec["speed"] = sum(sp) / len(sp) if sp else None
@@ -92,7 +156,9 @@ def main():
                         "METRIC >= THRESHOLD (nightly gate mode)")
     args = p.parse_args()
     with open(args.logfile) as f:
-        table = parse(f)
+        lines = f.readlines()
+    table = parse_telemetry(lines) if looks_like_telemetry(lines) \
+        else parse(lines)
     if not table:
         print("no epochs found", file=sys.stderr)
         return 2
