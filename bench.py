@@ -325,9 +325,13 @@ def main():
         gc.collect()
         gc.disable()
         pr.start()
-        mod.fit(it, num_epoch=1, optimizer_params=opt_params,
-                batch_end_callback=pr)
-        gc.enable()
+        try:
+            mod.fit(it, num_epoch=1, optimizer_params=opt_params,
+                    batch_end_callback=pr)
+        finally:
+            # an exception mid-round must not leave GC off for the rest
+            # of the process (ADVICE r5)
+            gc.enable()
         # drop each round's first lap from BOTH sides: it carries fit's
         # epoch prologue (iterator/metric reset, re-bind guards), which
         # the flax closure has no analog of — steady-state throughput is

@@ -1,5 +1,5 @@
 #!/usr/bin/env python
-"""Disabled-telemetry fast-path overhead gate.
+"""Disabled-telemetry fast-path + always-on flight-recorder overhead gate.
 
 The telemetry subsystem promises that when it is OFF (the default), the
 instrumentation woven through executor/kvstore/io/Module.fit costs under
@@ -16,6 +16,11 @@ instrumentation woven through executor/kvstore/io/Module.fit costs under
    call sites hit per batch (counted by running one enabled epoch),
    divided by the measured disabled batch time. This is the analytic
    overhead bound and the asserted gate: it must stay < 2%.
+
+The flight recorder (telemetry/flightrec.py) is ALWAYS ON — its whole
+point is recording when nobody enabled anything — so its ring gets the
+same two measurements (A/B recorder-on vs recorder-off epochs, plus
+note()-cost x notes-per-batch analytic bound) under the same <2% gate.
 
 Run: JAX_PLATFORMS=cpu python benchmarks/telemetry_overhead.py
 Writes benchmarks/results/telemetry_overhead.json.
@@ -35,6 +40,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
 import mxnet_tpu as mx
 from mxnet_tpu import telemetry as tm
 from mxnet_tpu.telemetry import core as tm_core
+from mxnet_tpu.telemetry import flightrec as tm_flight
 
 GATE_PCT = 2.0
 BATCH = 32
@@ -136,6 +142,41 @@ def main():
                     / batch_s) * 100.0
     tm.reset()
 
+    # ---- 3. always-on flight-recorder ring ----------------------------
+    # A/B: ring recording (the shipped default) vs recorder disabled,
+    # interleaved like measurement 1
+    all_rec_on, all_rec_off = [], []
+    tm_flight.configure(enabled=True)
+    timed_epoch(mod, it)                    # settle
+    for _ in range(REPEATS):
+        try:
+            tm_flight.configure(enabled=True)
+            all_rec_on.append(timed_epoch(mod, it))
+            tm_flight.configure(enabled=False)
+            all_rec_off.append(timed_epoch(mod, it))
+        finally:
+            tm_flight.configure(enabled=True)
+    flight_ab_pct = (min(all_rec_on) / min(all_rec_off) - 1.0) * 100.0
+
+    # primitive: one ring note (dict build + clock + deque append)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        tm_flight.note("bench.note", i=1)
+    note_ns = (time.perf_counter() - t0) / reps * 1e9
+
+    # notes per batch, counted against a ring large enough not to wrap
+    tm_flight.configure(capacity=1_000_000)
+    tm_flight.clear()
+    it.reset()
+    for batch in it:
+        mod.forward_backward(batch)
+        mod.update()
+    notes_per_batch = len(tm_flight.get_records()) / nb
+    tm_flight.clear()
+    tm_flight.configure(capacity=512)
+    flight_analytic_pct = (notes_per_batch * note_ns / 1e9 / batch_s) \
+        * 100.0
+
     result = {
         "metric": "telemetry_disabled_overhead",
         "gate_pct": GATE_PCT,
@@ -151,6 +192,17 @@ def main():
         "enabled_call_ns": enabled_ns,
         "telemetry_sites_per_batch": sites_per_batch,
         "analytic_overhead_pct": analytic_pct,
+        "flight_recorder": {
+            "gate_pct": GATE_PCT,
+            "epoch_s_ring_on": min(all_rec_on),
+            "epoch_s_ring_off": min(all_rec_off),
+            "epoch_s_ring_on_all": all_rec_on,
+            "epoch_s_ring_off_all": all_rec_off,
+            "ab_overhead_pct": flight_ab_pct,
+            "note_call_ns": note_ns,
+            "notes_per_batch": notes_per_batch,
+            "analytic_overhead_pct": flight_analytic_pct,
+        },
     }
     out_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                            "results")
@@ -170,8 +222,17 @@ def main():
         raise AssertionError(
             f"disabled telemetry A/B overhead {ab_overhead_pct:.3f}% "
             f">= {GATE_PCT}% gate")
+    assert flight_analytic_pct < GATE_PCT, (
+        f"always-on flight-recorder analytic overhead "
+        f"{flight_analytic_pct:.3f}% >= {GATE_PCT}% gate")
+    if flight_ab_pct > GATE_PCT and flight_analytic_pct > GATE_PCT / 2:
+        raise AssertionError(
+            f"flight-recorder A/B overhead {flight_ab_pct:.3f}% "
+            f">= {GATE_PCT}% gate")
     print(f"OK: analytic {analytic_pct:.4f}% | A/B {ab_overhead_pct:+.2f}%"
           f" (< {GATE_PCT}% gate)")
+    print(f"OK: flight ring analytic {flight_analytic_pct:.4f}% | "
+          f"A/B {flight_ab_pct:+.2f}% (< {GATE_PCT}% gate)")
 
 
 if __name__ == "__main__":

@@ -439,6 +439,9 @@ class Executor:
         self._naive_runner = None    # NaiveEngine serial replay runner
         self._pending = None      # recorded inputs awaiting execution
         self._outputs = None      # computed output NDArrays
+        self._sentinel = None     # optional NaN/Inf tripwire (telemetry)
+        # param/grad/aux/output footprint -> registry gauges + flight ring
+        self.memory_footprint = _telemetry.memory.record_executor_bind(self)
 
     # ------------------------------------------------------------ normalize
     def _normalize_args(self, args, names, what, allow_none=False):
@@ -628,13 +631,17 @@ class Executor:
         if self._outputs is not None or self._pending is None:
             return
         kind, rng = self._pending
-        if self._monitor_callback is not None:
-            outs, new_aux = self._run_tapped(kind == "fwd_train", rng)
-            self._finish(outs, new_aux, monitored=True)
-            return
-        prog = self._get_program(kind)
-        outs, new_aux = prog(self._arg_vals(), self._aux_vals(), rng)
-        self._finish(outs, new_aux)
+        try:
+            if self._monitor_callback is not None:
+                outs, new_aux = self._run_tapped(kind == "fwd_train", rng)
+                self._finish(outs, new_aux, monitored=True)
+                return
+            prog = self._get_program(kind)
+            outs, new_aux = prog(self._arg_vals(), self._aux_vals(), rng)
+            self._finish(outs, new_aux)
+        except Exception as exc:
+            _telemetry.flightrec.on_crash(exc, where="executor.forward")
+            raise
 
     def _finish(self, outs, new_aux, grads=None, monitored=False):
         self._outputs = [NDArray(o, ctx=self._ctx) for o in outs]
@@ -656,6 +663,8 @@ class Executor:
         if self._monitor_callback is not None and not monitored:
             for nm, arr in zip(self.output_names, self._outputs):
                 self._monitor_callback(nm, arr)
+        if self._sentinel is not None:
+            self._sentinel.check_executor(self, grads_fresh=grads is not None)
 
     @property
     def outputs(self):
@@ -695,18 +704,23 @@ class Executor:
             heads = [h.asjax() if isinstance(h, NDArray) else jnp.asarray(h)
                      for h in heads]
         monitored = self._monitor_callback is not None
-        if monitored and self._outputs is None:
-            # training forward is lazy and the gradient path below runs as
-            # one fused XLA program, so the per-op tap would otherwise
-            # never fire under fit(monitor=...) — replay the forward
-            # eagerly (same rng) purely for the monitor's benefit. Skipped
-            # when outputs already materialized through the tapped path
-            # (a caller that read .outputs after forward) — the taps fired
-            # there.
-            self._run_tapped(True, rng)
-        prog = self._get_program("fwd_bwd")
-        outs, new_aux, grads = prog(arg_vals, self._aux_vals(), rng, heads)
-        self._finish(outs, new_aux, grads, monitored=monitored)
+        try:
+            if monitored and self._outputs is None:
+                # training forward is lazy and the gradient path below runs
+                # as one fused XLA program, so the per-op tap would
+                # otherwise never fire under fit(monitor=...) — replay the
+                # forward eagerly (same rng) purely for the monitor's
+                # benefit. Skipped when outputs already materialized
+                # through the tapped path (a caller that read .outputs
+                # after forward) — the taps fired there.
+                self._run_tapped(True, rng)
+            prog = self._get_program("fwd_bwd")
+            outs, new_aux, grads = prog(arg_vals, self._aux_vals(), rng,
+                                        heads)
+            self._finish(outs, new_aux, grads, monitored=monitored)
+        except Exception as exc:
+            _telemetry.flightrec.on_crash(exc, where="executor.backward")
+            raise
         self._pending = None
 
     # ------------------------------------------------------------- utilities

@@ -44,6 +44,20 @@ def _payload_bytes(vals):
     return n
 
 
+def _dist_initialized():
+    """jax.distributed.is_initialized(), version-portable: older jax has
+    no such predicate — the coordination client's existence is the
+    equivalent signal there."""
+    fn = getattr(jax.distributed, "is_initialized", None)
+    if fn is not None:
+        return fn()
+    try:
+        from jax._src import distributed as _dist
+        return getattr(_dist.global_state, "client", None) is not None
+    except ImportError:
+        return False
+
+
 def init_distributed():
     """Connect this process to the training job's coordination service.
 
@@ -57,7 +71,7 @@ def init_distributed():
     n = int(os.environ.get("DMLC_NUM_WORKER", "1"))
     if n <= 1:
         return
-    if jax.distributed.is_initialized():
+    if _dist_initialized():
         return                               # already connected
     rank = int(os.environ.get("DMLC_WORKER_ID", "0"))
     uri = os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1")
@@ -79,11 +93,21 @@ def init_distributed():
     # kvstore_dist.h GetDeadNodes) and survivors keep running so they can
     # checkpoint/re-form; without the flag the fatal propagation would
     # make get_num_dead_node unobservable.
-    if os.environ.get("MXNET_KVSTORE_RECOVERABLE", "0") == "1":
+    if os.environ.get("MXNET_KVSTORE_RECOVERABLE", "0") == "1" and \
+            hasattr(jax.config, "jax_enable_recoverability"):
         jax.config.update("jax_enable_recoverability", True)
-    jax.distributed.initialize(coordinator_address=f"{uri}:{port}",
-                               num_processes=n, process_id=rank,
-                               heartbeat_timeout_seconds=heartbeat)
+    # older jax doesn't expose the heartbeat knob — pass it only where
+    # the installed initialize() accepts it
+    import inspect
+    kwargs = {"coordinator_address": f"{uri}:{port}",
+              "num_processes": n, "process_id": rank}
+    try:
+        if "heartbeat_timeout_seconds" in \
+                inspect.signature(jax.distributed.initialize).parameters:
+            kwargs["heartbeat_timeout_seconds"] = heartbeat
+    except (TypeError, ValueError):
+        pass
+    jax.distributed.initialize(**kwargs)
     if jax.process_count() != n:
         raise MXNetError(
             f"distributed init came up with {jax.process_count()} "
@@ -99,15 +123,18 @@ def _coordination_client():
     private touchpoint (everything else uses the public
     ``jax.distributed`` API). Guarded so a JAX upgrade that moves the
     internals degrades to a loud error rather than a silent wrong answer.
+    Liveness itself has two spellings: newer jax clients expose
+    ``get_live_nodes`` directly; older ones get ps-lite-style heartbeats
+    over the coordination KV store (see KVStoreDistSync._start_heartbeats).
     """
-    if not jax.distributed.is_initialized():
+    if not _dist_initialized():
         return None
     try:
         from jax._src import distributed as _dist
         client = getattr(_dist.global_state, "client", None)
     except ImportError:
         client = None
-    if client is None or not hasattr(client, "get_live_nodes"):
+    if client is None:
         raise MXNetError(
             "jax.distributed is initialized but the coordination-service "
             "client is not reachable at jax._src.distributed.global_state."
@@ -172,21 +199,26 @@ class KVStore:
                 keys=len(keys), bytes=nbytes)
         else:
             push_span = _telemetry.null_span
-        with push_span:
-            for k, vlist in zip(keys, vals):
-                if k not in self._store:
-                    raise MXNetError(f"key {k!r} not initialized")
-                if len(vlist) == 1:
-                    merged = vlist[0].copy()
-                else:
-                    acc = vlist[0].asjax()
-                    for v in vlist[1:]:
-                        acc = acc + v.asjax()
-                    merged = NDArray(acc, ctx=vlist[0].context)
-                if self._updater is not None:
-                    self._updater(k, merged, self._store[k])
-                else:
-                    self._store[k]._set(merged.asjax())
+            _telemetry.flightrec.note("kvstore.push", keys=len(keys))
+        try:
+            with push_span:
+                for k, vlist in zip(keys, vals):
+                    if k not in self._store:
+                        raise MXNetError(f"key {k!r} not initialized")
+                    if len(vlist) == 1:
+                        merged = vlist[0].copy()
+                    else:
+                        acc = vlist[0].asjax()
+                        for v in vlist[1:]:
+                            acc = acc + v.asjax()
+                        merged = NDArray(acc, ctx=vlist[0].context)
+                    if self._updater is not None:
+                        self._updater(k, merged, self._store[k])
+                    else:
+                        self._store[k]._set(merged.asjax())
+        except Exception as exc:
+            _telemetry.flightrec.on_crash(exc, where="kvstore.push")
+            raise
 
     def pull(self, key, out=None, priority=0):
         """Broadcast stored value into out arrays."""
@@ -200,15 +232,21 @@ class KVStore:
                 keys=len(keys), bytes=nbytes)
         else:
             pull_span = _telemetry.null_span
-        with pull_span:
-            for k, olist in zip(keys, outs):
-                if k not in self._store:
-                    raise MXNetError(f"key {k!r} not initialized")
-                src = self._store[k]
-                for o in olist:
-                    # land the value in the destination's existing
-                    # placement (keeps mesh-sharded arrays sharded)
-                    o._set(jax.device_put(src.asjax(), o.asjax().sharding))
+            _telemetry.flightrec.note("kvstore.pull", keys=len(keys))
+        try:
+            with pull_span:
+                for k, olist in zip(keys, outs):
+                    if k not in self._store:
+                        raise MXNetError(f"key {k!r} not initialized")
+                    src = self._store[k]
+                    for o in olist:
+                        # land the value in the destination's existing
+                        # placement (keeps mesh-sharded arrays sharded)
+                        o._set(jax.device_put(src.asjax(),
+                                              o.asjax().sharding))
+        except Exception as exc:
+            _telemetry.flightrec.on_crash(exc, where="kvstore.pull")
+            raise
 
     # ------------------------------------------------------------ optimizer
     def set_optimizer(self, optimizer):
@@ -262,12 +300,52 @@ class KVStoreDistSync(KVStore):
     buffers (comm.h InitMergeBuffer).
     """
 
+    _HB_PREFIX = "mxnet_kvstore_heartbeat/"
+
     def __init__(self, kind):
         super().__init__(kind)
         init_distributed()
         self._nproc = jax.process_count()
         self._mesh = None
         self._sum_jit = None
+        self._hb_stop = None
+        if self._nproc > 1:
+            client = _coordination_client()
+            if client is not None and not hasattr(client,
+                                                  "get_live_nodes"):
+                self._start_heartbeats(client)
+
+    def _start_heartbeats(self, client):
+        """ps-lite-style heartbeats for jax builds whose coordination
+        client has no ``get_live_nodes``: each rank periodically writes
+        its wall clock under a well-known key in the coordination KV
+        store (reference: ps-lite van.cc Heartbeat), and
+        ``get_num_dead_node`` counts ranks whose last beat went stale.
+        The first beat lands synchronously so a freshly constructed
+        store is immediately visible to its peers."""
+        import threading
+        import time as _time
+        horizon = int(os.environ.get("PS_HEARTBEAT_TIMEOUT", "100"))
+        period = max(1.0, horizon / 3.0)
+        key = f"{self._HB_PREFIX}{self.rank}"
+
+        def beat():
+            try:
+                client.key_value_set(key, repr(_time.time()),
+                                     allow_overwrite=True)
+            except Exception:
+                pass        # a dying coordinator must not kill training
+
+        beat()
+        stop = threading.Event()
+
+        def loop():
+            while not stop.wait(period):
+                beat()
+
+        threading.Thread(target=loop, daemon=True,
+                         name="mxnet-kvstore-heartbeat").start()
+        self._hb_stop = stop
 
     @property
     def rank(self):
@@ -385,38 +463,45 @@ class KVStoreDistSync(KVStore):
                 keys=len(keys), bytes=nbytes, dist=True)
         else:
             push_span = _telemetry.null_span
+            _telemetry.flightrec.note("kvstore.push", keys=len(keys),
+                                      dist=True)
         return self._push_reduced(keys, vals, push_span)
 
     def _push_reduced(self, keys, vals, push_span):
-        with push_span:
-            merged = []
-            for k, vlist in zip(keys, vals):
-                if k not in self._store:
-                    raise MXNetError(f"key {k!r} not initialized")
-                acc = vlist[0].asjax()
-                for v in vlist[1:]:
-                    acc = acc + v.asjax()
-                merged.append((k, vlist[0].context, acc))
-            if self._nproc > 1:
-                reduced = self._allreduce([a for _, _, a in merged])
-            else:
-                reduced = [a for _, _, a in merged]
-            for (k, ctx, _), red in zip(merged, reduced):
-                # The bucketed all-reduce hands back each value sharded
-                # over the local `dev` mesh axis (bandwidth layout). The
-                # store replica and its optimizer state live wherever the
-                # user placed the weight — re-place the reduced gradient
-                # there so the updater's inputs are colocated (the analog
-                # of the reference copying the merged buffer back to each
-                # GPU, comm.h Broadcast).
-                store_sharding = self._store[k].asjax().sharding
-                if red.sharding != store_sharding:
-                    red = jax.device_put(red, store_sharding)
-                nd_val = NDArray(red, ctx=ctx)
-                if self._updater is not None:
-                    self._updater(k, nd_val, self._store[k])
+        try:
+            with push_span:
+                merged = []
+                for k, vlist in zip(keys, vals):
+                    if k not in self._store:
+                        raise MXNetError(f"key {k!r} not initialized")
+                    acc = vlist[0].asjax()
+                    for v in vlist[1:]:
+                        acc = acc + v.asjax()
+                    merged.append((k, vlist[0].context, acc))
+                if self._nproc > 1:
+                    reduced = self._allreduce([a for _, _, a in merged])
                 else:
-                    self._store[k]._set(nd_val.asjax())
+                    reduced = [a for _, _, a in merged]
+                for (k, ctx, _), red in zip(merged, reduced):
+                    # The bucketed all-reduce hands back each value
+                    # sharded over the local `dev` mesh axis (bandwidth
+                    # layout). The store replica and its optimizer state
+                    # live wherever the user placed the weight — re-place
+                    # the reduced gradient there so the updater's inputs
+                    # are colocated (the analog of the reference copying
+                    # the merged buffer back to each GPU, comm.h
+                    # Broadcast).
+                    store_sharding = self._store[k].asjax().sharding
+                    if red.sharding != store_sharding:
+                        red = jax.device_put(red, store_sharding)
+                    nd_val = NDArray(red, ctx=ctx)
+                    if self._updater is not None:
+                        self._updater(k, nd_val, self._store[k])
+                    else:
+                        self._store[k]._set(nd_val.asjax())
+        except Exception as exc:
+            _telemetry.flightrec.on_crash(exc, where="kvstore.push")
+            raise
 
     def _barrier(self):
         if self._nproc > 1:
@@ -427,17 +512,36 @@ class KVStoreDistSync(KVStore):
     def get_num_dead_node(self, node_id=0, timeout_ms=2000):
         """Count dead workers (reference: kvstore_dist.h:159-168
         GetDeadNodes over ps-lite heartbeats). One-sided: queries the
-        coordination service's own liveness tracking — any single rank can
-        call this at any time, no peer cooperation needed. ``timeout_ms``
-        is accepted for reference API parity; the coordination service
-        applies its own heartbeat timeout."""
+        coordination service's liveness tracking (``get_live_nodes``
+        where the client has it, else this store's own heartbeat keys) —
+        any single rank can call this at any time, no peer cooperation
+        needed. ``timeout_ms`` bounds the per-rank key wait in the
+        heartbeat fallback; the native path applies the service's own
+        heartbeat timeout."""
         if self._nproc <= 1:
             return 0
         client = _coordination_client()
         if client is None:
             return 0
-        live = client.get_live_nodes(list(range(self._nproc)))
-        return self._nproc - len(live)
+        if hasattr(client, "get_live_nodes"):
+            live = client.get_live_nodes(list(range(self._nproc)))
+            return self._nproc - len(live)
+        # heartbeat fallback: a rank whose beat is missing or older than
+        # PS_HEARTBEAT_TIMEOUT counts as dead (its last value stays in
+        # the KV store, so a crashed peer reads back instantly as stale)
+        import time as _time
+        horizon = float(os.environ.get("PS_HEARTBEAT_TIMEOUT", "100"))
+        wait_ms = max(100, int(timeout_ms) // self._nproc)
+        dead = 0
+        for r in range(self._nproc):
+            try:
+                ts = float(client.blocking_key_value_get(
+                    f"{self._HB_PREFIX}{r}", wait_ms))
+                if _time.time() - ts > horizon:
+                    dead += 1
+            except Exception:
+                dead += 1           # never wrote a beat: not alive yet
+        return dead
 
 
 def create(name="local"):

@@ -93,8 +93,13 @@ class EvalMetric:
 
     @sum_metric.setter
     def sum_metric(self, value):
-        self._flush()             # queued device batches must not leak
-        self._sum_metric = value  # into a freshly poked value later
+        # manual pokes DISCARD queued device batches: flushing here would
+        # fold the queued counts into both accumulators and then
+        # overwrite only this one — a half-applied state (ADVICE r5).
+        # Reference-style code that zeroes both attributes gets a clean
+        # slate either way.
+        self._pending = []
+        self._sum_metric = value
 
     @property
     def num_inst(self):
@@ -103,7 +108,7 @@ class EvalMetric:
 
     @num_inst.setter
     def num_inst(self, value):
-        self._flush()
+        self._pending = []        # same discard semantics as sum_metric
         self._num_inst = value
 
     def _accumulate(self, total, count, index=None):
@@ -224,7 +229,16 @@ class Accuracy(EvalMetric):
 
 @_register("top_k_accuracy", "top_k_acc")
 class TopKAccuracy(EvalMetric):
-    """Label within the k highest-scoring classes."""
+    """Label within the k highest-scoring classes.
+
+    Tie-breaking caveat: both paths select exactly k entries, but on
+    inputs with *tied* scores the device path (``jax.lax.top_k``) and
+    the host path (``np.argpartition``) may pick different tied members,
+    so device/host parity is only guaranteed for tie-free scores
+    (softmax probabilities from continuous inputs never tie in
+    practice). An all-equal row, e.g. uniform zeros, can therefore count
+    as a hit on one path and a miss on the other.
+    """
 
     def __init__(self, top_k=1):
         if top_k <= 1:

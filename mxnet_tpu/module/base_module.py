@@ -86,6 +86,7 @@ class BaseModule:
             batch_span = _telemetry.span(
                 "module.fit.batch", _hist="module.fit.batch.seconds",
                 epoch=epoch, nbatch=nbatch)
+            t0 = time.perf_counter_ns()
             with batch_span:
                 self.forward_backward(batch)
                 self.update()
@@ -94,6 +95,14 @@ class BaseModule:
                 _telemetry.record_event(
                     "batch_end", epoch=epoch, nbatch=nbatch,
                     duration_us=batch_span.dur,
+                    batch_size=getattr(train_data, "batch_size", 0))
+            else:
+                # the span tracer is off (the production default) — the
+                # always-on flight ring still gets a batch timeline so a
+                # crash report can show what the run was doing
+                _telemetry.flightrec.note(
+                    "module.fit.batch", epoch=epoch, nbatch=nbatch,
+                    dur_us=(time.perf_counter_ns() - t0) // 1000,
                     batch_size=getattr(train_data, "batch_size", 0))
             self.update_metric(eval_metric, batch.label)
             if monitor is not None:
@@ -124,6 +133,23 @@ class BaseModule:
         eval_metric = metric_mod.create(eval_metric)
         validation_metric = validation_metric or eval_metric
 
+        try:
+            self._fit_epochs(train_data, eval_data, eval_metric,
+                             validation_metric, epoch_end_callback,
+                             batch_end_callback, eval_end_callback,
+                             eval_batch_end_callback, begin_epoch,
+                             num_epoch, monitor)
+        except Exception as exc:
+            # leave a post-mortem on disk: ring timeline + metrics +
+            # memory watermarks (telemetry.flightrec crash report)
+            _telemetry.flightrec.on_crash(exc, where="module.fit")
+            raise
+
+    def _fit_epochs(self, train_data, eval_data, eval_metric,
+                    validation_metric, epoch_end_callback,
+                    batch_end_callback, eval_end_callback,
+                    eval_batch_end_callback, begin_epoch, num_epoch,
+                    monitor):
         for epoch in range(begin_epoch, num_epoch):
             start = time.time()
             eval_metric.reset()

@@ -223,6 +223,18 @@ class DataParallelExecutorGroup:
         # never reads them, so they're off unless requested; the staged
         # (non-fused) path always populates grad_dict.
         keep_grads = os.environ.get("MXNET_FUSED_KEEP_GRADS", "0") == "1"
+        if not keep_grads:
+            # the fused program will never write these buffers — poison
+            # them once so a stale read returns NaN loudly instead of
+            # plausible pre-step values (set MXNET_FUSED_KEEP_GRADS=1 for
+            # live gradients, or install a monitor for the staged path)
+            gd = exe.grad_dict
+            for nm in watched:
+                dst = gd.get(nm)
+                if dst is not None and \
+                        np.issubdtype(dst.dtype, np.floating):
+                    dst._set(jnp.full(dst.shape, jnp.nan,
+                                      dst.asjax().dtype))
 
         # lr/wd arrive as TWO stacked f32 arrays, not 2x161 python
         # scalars: scalar jit args each become their own host->device
@@ -363,6 +375,10 @@ class DataParallelExecutorGroup:
                 xd[nm]._set(val)
         exe._outputs = [NDArray(o, ctx=self.contexts[0]) for o in outs]
         exe._pending = None
+        if exe._sentinel is not None:
+            # grads are fresh only under KEEP_GRADS (otherwise the bound
+            # buffers hold the arming-time NaN poison, not real values)
+            exe._sentinel.check_executor(exe, grads_fresh=grads is not None)
 
     # -------------------------------------------------------------- params
     def set_params(self, arg_params, aux_params):
@@ -486,6 +502,9 @@ class DataParallelExecutorGroup:
 
     def install_monitor(self, mon):
         mon.install_exe(self.executor)
+
+    def install_sentinel(self, sentinel, per_op=False):
+        sentinel.install(self.executor, per_op=per_op)
 
     def bind_exec(self, data_shapes, label_shapes, shared_group=None,
                   reshape=False):

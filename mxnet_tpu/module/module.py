@@ -509,3 +509,13 @@ class Module(BaseModule):
             # per-op taps need the staged path; carry the optimizer
             # state over so momentum/moments don't reset
             self._defuse()
+
+    def install_sentinel(self, sentinel, per_op=False):
+        """Attach a NaN/Inf sentinel (telemetry.NanSentinel) to the bound
+        executor. The default executor-level mode works on the fused
+        train step; ``per_op=True`` claims the Monitor tap for exact
+        op attribution, which forces the staged (eager) path."""
+        assert self.binded
+        self._exec_group.install_sentinel(sentinel, per_op=per_op)
+        if per_op and self._fused_armed:
+            self._defuse()

@@ -87,15 +87,23 @@ class Monitor:
         out = sorted(self._records, key=lambda r: (r[1], r[0])) \
             if self.sort else list(self._records)
         self._records = []
-        if _telemetry.enabled():
-            for step, name, val in out:
-                try:
-                    fval = float(val)
-                except (TypeError, ValueError):
-                    continue
+        enabled = _telemetry.enabled()
+        for step, name, val in out:
+            try:
+                fval = float(val)
+            except (TypeError, ValueError):
+                continue
+            if enabled:
                 _telemetry.gauge("monitor.stat", tensor=name).set(fval)
                 _telemetry.record_event("monitor", step=step, name=name,
                                         value=fval)
+            if fval != fval or fval in (float("inf"), float("-inf")):
+                # a non-finite statistic is the classic divergence tell —
+                # put it in the always-on flight ring so a later crash
+                # report carries the first sighting even without the
+                # tracer or a sentinel installed
+                _telemetry.flightrec.note("anomaly", what="monitor_stat",
+                                          array=name, step=step)
         return [(step, name, str(val)) for step, name, val in out]
 
     def flush(self):

@@ -29,6 +29,7 @@ from .base import MXNetError
 from .context import Context, current_context
 from .ops.registry import OP_REGISTRY, get_op
 from . import random as _random
+from .telemetry import memory as _memory
 
 __all__ = ["NDArray", "array", "zeros", "ones", "full", "empty", "arange",
            "concatenate", "load", "save", "waitall", "imperative_invoke",
@@ -46,7 +47,7 @@ _py_slice, _py_abs, _py_sum, _py_max, _py_min = slice, abs, sum, max, min
 class NDArray:
     """Mutable handle over an immutable jax.Array."""
 
-    __slots__ = ("_data", "_ctx", "writable")
+    __slots__ = ("_data", "_ctx", "writable", "_acct")
 
     def __init__(self, data, ctx=None, writable=True):
         if isinstance(data, NDArray):
@@ -68,6 +69,7 @@ class NDArray:
         self._data = data
         self._ctx = ctx if ctx is not None else _infer_ctx(data)
         self.writable = writable
+        _memory.on_alloc(self)   # per-context live/peak byte accounting
 
     # ------------------------------------------------------------------ core
     def asjax(self):
@@ -79,6 +81,13 @@ class NDArray:
         if not self.writable:
             raise MXNetError("trying to write to a read-only NDArray")
         self._data = new_data
+        _memory.on_swap(self)    # re-account only when the size changed
+
+    def __del__(self):
+        try:
+            _memory.on_free(self._acct)
+        except Exception:
+            pass                 # interpreter shutdown / half-built handle
 
     @property
     def shape(self):

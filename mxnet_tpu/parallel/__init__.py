@@ -10,4 +10,4 @@ over ICI/DCN (psum/all_gather/reduce_scatter/ppermute).
 from .mesh import (MeshConfig, build_mesh, current_mesh, mesh_scope,
                    data_sharding, replicated, shard, DEFAULT_AXES)
 from .collectives import (all_reduce, all_gather, reduce_scatter, ppermute,
-                          barrier)
+                          barrier, shard_map)

@@ -24,6 +24,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .collectives import axis_size as _axis_size
+
 __all__ = ["attention", "ring_attention", "ring_attention_sharded"]
 
 
@@ -113,7 +115,7 @@ def _ring_attention_flash(q, k, v, axis_name, causal, scale):
     """
     from ..rtc import flash_attention_partial
 
-    n_dev = lax.axis_size(axis_name)
+    n_dev = _axis_size(axis_name)
     my_idx = lax.axis_index(axis_name)
     B, H, T, D = q.shape
     if scale is None:
@@ -172,7 +174,7 @@ def _ring_attention_flash(q, k, v, axis_name, causal, scale):
 
 def _ring_attention_xla(q, k, v, axis_name="seq", causal=False, scale=None):
     """The pure-XLA ring (also the backward recompute path)."""
-    n_dev = lax.axis_size(axis_name)
+    n_dev = _axis_size(axis_name)
     my_idx = lax.axis_index(axis_name)
     if scale is None:
         scale = 1.0 / float(q.shape[-1]) ** 0.5
@@ -220,8 +222,9 @@ def ring_attention_sharded(q, k, v, mesh, causal=False, seq_axis="seq",
     if use_flash:
         kwargs["check_vma"] = False
 
+    from .collectives import shard_map as _shard_map
     @functools.partial(
-        jax.shard_map, mesh=mesh, in_specs=(spec, spec, spec),
+        _shard_map, mesh=mesh, in_specs=(spec, spec, spec),
         out_specs=spec, **kwargs)
     def run(q_s, k_s, v_s):
         return ring_attention(q_s, k_s, v_s, axis_name=seq_axis,

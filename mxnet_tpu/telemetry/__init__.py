@@ -27,6 +27,17 @@ Naming conventions: dotted lowercase ``layer.what[.unit]`` —
 bytes end in ``.bytes``. Off by default: the disabled fast path is one
 branch per site (gated <2% on a small fit loop by
 benchmarks/telemetry_overhead.py).
+
+On top of the tracer/registry sits the always-on diagnostics layer:
+
+* ``telemetry.flightrec`` — bounded ring of recent activity + crash
+  reports on exceptions escaping Executor/Module.fit/KVStore;
+* ``telemetry.memory`` — per-context live/peak byte accounting over
+  NDArray handles, ``assert_no_leak()`` for tests;
+* ``telemetry.sentinel`` — opt-in NaN/Inf tripwire (``NanSentinel``)
+  with warn-vs-raise policy and op/array attribution;
+* ``tools/diagnose.py`` — renders a crash report or jsonl event log
+  into a human-readable health report.
 """
 from __future__ import annotations
 
@@ -34,8 +45,12 @@ from .core import (span, event, record_event, enable, disable, enabled,
                    clear, get_spans, get_events, null_span, wrap_dispatch)
 from .metrics import (Counter, Gauge, Histogram, counter, gauge, histogram,
                       get_metric)
+from .sentinel import NanSentinel, AnomalyError
 from . import core
 from . import metrics
+from . import flightrec
+from . import memory
+from . import sentinel
 from . import chrome_trace
 from . import prometheus
 from . import jsonl
@@ -43,21 +58,27 @@ from . import jsonl
 __all__ = ["span", "event", "record_event", "enable", "disable", "enabled",
            "clear", "get_spans", "get_events", "null_span", "wrap_dispatch",
            "Counter", "Gauge", "Histogram", "counter", "gauge", "histogram",
-           "get_metric", "snapshot", "reset",
+           "get_metric", "snapshot", "reset", "NanSentinel", "AnomalyError",
+           "flightrec", "memory", "sentinel",
            "chrome_trace", "prometheus", "jsonl"]
 
 
 def snapshot():
     """The whole training step at a glance: the metrics registry plus
-    span/event buffer depths."""
+    span/event buffer depths and per-context memory watermarks."""
     snap = metrics.snapshot()
     snap["spans"] = len(core.get_spans())
     snap["events"] = len(core.get_events())
+    snap["memory"] = memory.snapshot()
     return snap
 
 
 def reset():
-    """Clear spans, events, and the metrics registry (the enabled/disabled
-    switch is left as-is)."""
+    """Clear spans, events, the metrics registry, and the flight-recorder
+    ring; drop memory peak watermarks to current live (live accounting
+    tracks real handles and is never cleared). The enabled/disabled
+    switch is left as-is."""
     core.clear()
     metrics.reset()
+    flightrec.clear()
+    memory.reset_peak()

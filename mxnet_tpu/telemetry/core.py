@@ -28,6 +28,8 @@ import os
 import threading
 import time
 
+from . import flightrec as _flightrec
+
 __all__ = ["span", "event", "record_event", "enable", "disable", "enabled",
            "clear", "get_spans", "get_events", "null_span", "wrap_dispatch"]
 
@@ -102,6 +104,7 @@ class Span:
             st.pop()
         with _lock:
             _spans.append(self)
+        _flightrec.note_span(self)   # ring keeps the tail post-mortem
         if self._hist is not None:
             from .metrics import histogram
             histogram(self._hist).observe(self.dur / 1e6)
@@ -129,6 +132,7 @@ def event(kind, **payload):
            "payload": payload}
     with _lock:
         _events.append(rec)
+    _flightrec.note_event(rec)
 
 
 # the structured-log spelling of the same record (jsonl exporter)
@@ -180,6 +184,17 @@ def wrap_dispatch(fn, kind, compiled=True):
     def dispatch(*args):
         first, state["first"] = state["first"], False
         if not _enabled:
+            if _flightrec._enabled:
+                # always-on flight-recorder timing of the XLA dispatch —
+                # the crash-report timeline's backbone when tracing is off
+                name = "executor.compile" if first else "executor.run"
+                t0 = time.perf_counter_ns()
+                try:
+                    return fn(*args)
+                finally:
+                    _flightrec.note(
+                        name, program=kind,
+                        dur_us=(time.perf_counter_ns() - t0) // 1000)
             return fn(*args)
         name = "executor.compile" if first else "executor.run"
         from .metrics import counter

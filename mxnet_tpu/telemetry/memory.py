@@ -1,0 +1,283 @@
+"""Device-memory accounting: per-context live/peak bytes over NDArray
+handles.
+
+The reference tracks device memory in its storage managers
+(src/storage/) — every GPU pool knows its live allocation. Here XLA owns
+the real allocator, and on many backends (notably the CPU test mesh)
+``device.memory_stats()`` returns nothing — so the framework keeps its
+own ledger at the NDArray layer: every handle accounts its logical bytes
+(``size * itemsize``) against its Context on creation, adjusts on
+``_set`` swaps that change size, and releases on ``__del__``. The ledger
+is therefore *handle-level*: two NDArrays aliasing one buffer count
+twice, and XLA-internal scratch is invisible — but parameters,
+gradients, aux state, bound inputs and outputs (the HBM that matters
+for "why did this run OOM") are all NDArray-held, and the ledger's
+bind/run/free deltas are deterministic, which is what
+``assert_no_leak()`` needs.
+
+Live/peak watermarks surface as registry gauges
+(``memory.live_bytes{ctx=...}`` / ``memory.peak_bytes{ctx=...}``), in
+``telemetry.snapshot()["memory"]``, and in flight-recorder crash
+reports. Accounting is on by default (a dict lookup + integer adds per
+allocation — gated with the flight recorder under 2% of a small fit
+loop); MXNET_MEMORY_ACCOUNTING=0 disables it at import time.
+
+Pure stdlib at import time; gc is touched only inside assert_no_leak.
+"""
+from __future__ import annotations
+
+import contextlib
+import gc
+import os
+import threading
+
+from . import metrics as _metrics
+
+__all__ = ["enabled", "on_alloc", "on_swap", "on_free", "live_bytes",
+           "peak_bytes", "snapshot", "reset_peak", "assert_no_leak",
+           "record_executor_bind"]
+
+_enabled = os.environ.get("MXNET_MEMORY_ACCOUNTING", "1") != "0"
+_lock = threading.Lock()
+_stats = {}        # ctx key -> _CtxStat
+
+
+class _CtxStat:
+    """One context's ledger + its registry gauge views.
+
+    The gauges are cached for hot-path updates but re-created whenever
+    the metrics registry generation changes (metrics.reset() between
+    runs/tests), so the registry view never goes stale while the ledger
+    itself survives resets — the ledger tracks real live handles.
+    """
+
+    __slots__ = ("live", "peak", "allocs", "frees", "gen",
+                 "g_live", "g_peak")
+
+    def __init__(self, key):
+        self.live = 0
+        self.peak = 0
+        self.allocs = 0
+        self.frees = 0
+        self._bind_gauges(key)
+
+    def _bind_gauges(self, key):
+        self.gen = _metrics.generation()
+        self.g_live = _metrics.gauge("memory.live_bytes", ctx=key)
+        self.g_peak = _metrics.gauge("memory.peak_bytes", ctx=key)
+        self.g_live.value = float(self.live)
+        self.g_peak.value = float(self.peak)
+
+
+def enabled():
+    return _enabled
+
+
+def _ctx_key(ctx):
+    if ctx is None:
+        return "unknown"
+    if isinstance(ctx, str):
+        return ctx
+    return f"{ctx.device_type}({ctx.device_id})"
+
+
+def _stat(key):
+    st = _stats.get(key)
+    if st is None:
+        with _lock:
+            st = _stats.get(key)
+            if st is None:
+                st = _stats[key] = _CtxStat(key)
+    elif st.gen != _metrics.generation():
+        st._bind_gauges(key)
+    return st
+
+
+def _nbytes(data):
+    return int(data.size) * data.dtype.itemsize
+
+
+# ---------------------------------------------------------- NDArray hooks
+def on_alloc(nd):
+    """Account a freshly constructed NDArray handle.
+
+    Stores ``(ctx_key, nbytes)`` on the handle (``nd._acct``) so swap
+    and free stay O(1); handles created while accounting is disabled
+    carry None and are never tracked.
+    """
+    if not _enabled:
+        nd._acct = None
+        return
+    try:
+        nbytes = _nbytes(nd._data)
+        key = _ctx_key(nd._ctx)
+    except Exception:        # tracers/odd avals: stay untracked
+        nd._acct = None
+        return
+    nd._acct = (key, nbytes)
+    st = _stat(key)
+    with _lock:
+        st.allocs += 1
+        st.live += nbytes
+        if st.live > st.peak:
+            st.peak = st.live
+            st.g_peak.value = float(st.peak)
+        st.g_live.value = float(st.live)
+
+
+def on_swap(nd):
+    """Re-account after ``_set`` swapped in a new buffer.
+
+    The overwhelmingly common swap (optimizer update, batch load) keeps
+    the shape/dtype — that case exits on one integer compare.
+    """
+    acct = nd._acct
+    if acct is None:
+        return
+    try:
+        nbytes = _nbytes(nd._data)
+    except Exception:
+        return
+    key, old = acct
+    if nbytes == old:
+        return
+    nd._acct = (key, nbytes)
+    st = _stat(key)
+    with _lock:
+        st.live += nbytes - old
+        if st.live > st.peak:
+            st.peak = st.live
+            st.g_peak.value = float(st.peak)
+        st.g_live.value = float(st.live)
+
+
+def on_free(acct):
+    """Release a handle's accounted bytes (called from NDArray.__del__)."""
+    if acct is None:
+        return
+    key, nbytes = acct
+    st = _stats.get(key)
+    if st is None:
+        return
+    with _lock:
+        st.frees += 1
+        st.live -= nbytes
+        st.g_live.value = float(st.live)
+
+
+# --------------------------------------------------------------- readouts
+def live_bytes(ctx=None):
+    """Live accounted bytes for one context (or summed over all)."""
+    if ctx is not None:
+        st = _stats.get(_ctx_key(ctx))
+        return st.live if st is not None else 0
+    with _lock:
+        return sum(st.live for st in _stats.values())
+
+
+def peak_bytes(ctx=None):
+    """Peak watermark for one context (or the max over all)."""
+    if ctx is not None:
+        st = _stats.get(_ctx_key(ctx))
+        return st.peak if st is not None else 0
+    with _lock:
+        return max((st.peak for st in _stats.values()), default=0)
+
+
+def snapshot():
+    """{ctx: {live_bytes, peak_bytes, allocs, frees}} — the memory
+    section of telemetry.snapshot() and of crash reports."""
+    with _lock:
+        return {key: {"live_bytes": st.live, "peak_bytes": st.peak,
+                      "allocs": st.allocs, "frees": st.frees}
+                for key, st in _stats.items()}
+
+
+def reset_peak():
+    """Drop peak watermarks to the current live level (run boundaries)."""
+    with _lock:
+        for st in _stats.values():
+            st.peak = st.live
+            st.g_peak.value = float(st.peak)
+
+
+@contextlib.contextmanager
+def assert_no_leak(ctx=None, tolerance_bytes=0):
+    """Context manager asserting live bytes return to their entry level.
+
+    Usable from tests around a bind/run/free cycle::
+
+        with telemetry.memory.assert_no_leak():
+            exe = sym.simple_bind(ctx=mx.cpu(), data=(8, 4))
+            exe.forward()
+            del exe
+
+    A gc pass runs on both sides so cycles don't read as leaks; growth
+    beyond ``tolerance_bytes`` in any context (or the one named by
+    ``ctx``) raises AssertionError listing the offending contexts.
+    """
+    gc.collect()
+    keys = [_ctx_key(ctx)] if ctx is not None else None
+    before = {k: v["live_bytes"] for k, v in snapshot().items()}
+    yield
+    gc.collect()
+    after = {k: v["live_bytes"] for k, v in snapshot().items()}
+    leaks = []
+    for k in sorted(set(before) | set(after)):
+        if keys is not None and k not in keys:
+            continue
+        delta = after.get(k, 0) - before.get(k, 0)
+        if delta > tolerance_bytes:
+            leaks.append(f"{k}: +{delta} bytes live")
+    if leaks:
+        raise AssertionError(
+            "device-memory leak across the guarded region: "
+            + "; ".join(leaks))
+
+
+# -------------------------------------------------------- executor binds
+def record_executor_bind(exe):
+    """Report a freshly bound executor's memory footprint.
+
+    Arg/grad/aux bytes come from the bound NDArrays; output bytes from
+    shape inference over the bound arg shapes (float32-sized estimate —
+    outputs aren't allocated until the first run). Lands as
+    ``executor.memory.*_bytes{ctx=...}`` gauges (last bind wins per
+    context) and one flight-recorder note; returns the footprint dict.
+    """
+    if not _enabled:
+        return None
+
+    def total(arrays):
+        n = 0
+        for a in arrays:
+            if a is not None:
+                n += int(a.size) * a.dtype.itemsize
+        return n
+
+    fp = {"arg_bytes": total(exe.arg_arrays),
+          "grad_bytes": total(exe.grad_arrays),
+          "aux_bytes": total(exe.aux_arrays)}
+    try:
+        shapes = {nm: tuple(a.shape)
+                  for nm, a in zip(exe.arg_names, exe.arg_arrays)
+                  if a is not None}
+        _, out_shapes, _ = exe._symbol.infer_shape(**shapes)
+        out_b = 0
+        for s in out_shapes:
+            if s is not None:
+                n = 1
+                for d in s:
+                    n *= int(d)
+                out_b += n * 4
+        fp["output_bytes"] = out_b
+    except Exception:
+        fp["output_bytes"] = None
+    key = _ctx_key(exe._ctx)
+    for name, val in fp.items():
+        if val is not None:
+            _metrics.gauge(f"executor.memory.{name}", ctx=key).set(val)
+    from . import flightrec as _flightrec
+    _flightrec.note("executor.bind", ctx=key, outputs=len(exe.output_names),
+                    **{k: v for k, v in fp.items() if v is not None})
+    return fp

@@ -28,6 +28,7 @@ __all__ = ["Counter", "Gauge", "Histogram", "counter", "gauge", "histogram",
 
 _lock = threading.Lock()
 _registry = {}     # (name, labels_tuple) -> metric object
+_gen = 0           # bumped by reset() so cached metric refs can refresh
 
 # latency-oriented default buckets (seconds), ~decade spacing with a 2/5
 # split where training-step durations actually land
@@ -175,8 +176,17 @@ def snapshot():
 
 
 def reset():
+    global _gen
     with _lock:
         _registry.clear()
+        _gen += 1
+
+
+def generation():
+    """Registry generation counter: increments on every reset(), so
+    long-lived holders of metric objects (telemetry.memory's gauge
+    cache) can detect staleness with one integer compare."""
+    return _gen
 
 
 def all_metrics():

@@ -1,0 +1,166 @@
+"""NaN/Inf sentinel: opt-in divergence tripwire with op/array attribution.
+
+A diverging training run usually announces itself long before the loss
+goes NaN — one op's output or one parameter's gradient turns non-finite
+first. The sentinel catches that first occurrence and attributes it,
+instead of letting it launder through hundreds more steps of arithmetic.
+
+Two install points, both on an Executor:
+
+* **executor-level** (the default, cheap): after every forward/backward
+  completion the bound outputs (and freshly produced gradients) are
+  reduced with ``isfinite().all()`` on device and pulled in ONE host
+  transfer per checked window — ``interval=N`` checks every Nth step,
+  bounding the sync cost. Works on the fused train step too.
+* **per-op** (``per_op=True``, debug speed): reuses the Monitor's
+  install point (``set_monitor_callback``), which switches the executor
+  to eager per-node dispatch so every operator output is checked and
+  the *op* producing the first NaN is named exactly — the observability
+  analog of ``MXNET_ENGINE_TYPE=NaiveEngine`` replay debugging.
+
+Every anomaly lands in the metrics registry
+(``sentinel.anomalies{kind=...,array=...}`` counters), the flight
+recorder ring (so crash reports carry the first-anomaly timeline), and
+— when the span tracer is on — the event buffer. The policy decides
+what happens next: ``"warn"`` logs and keeps training, ``"raise"``
+throws :class:`AnomalyError` (which the crash guards then dump).
+Default policy comes from MXNET_NAN_SENTINEL_POLICY.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import re
+
+from . import core as _core
+from . import flightrec as _flightrec
+from . import metrics as _metrics
+
+__all__ = ["NanSentinel", "AnomalyError"]
+
+log = logging.getLogger(__name__)
+
+
+class AnomalyError(RuntimeError):
+    """A sentinel with policy='raise' saw a non-finite tensor."""
+
+
+def _is_float(x):
+    # numpy/jax dtype kinds: f=float, c=complex, V covers bfloat16 via
+    # its numpy view — jax reports bfloat16 with kind 'V' name 'bfloat16'
+    kind = getattr(x.dtype, "kind", "f")
+    return kind in ("f", "c") or "float" in str(x.dtype)
+
+
+class NanSentinel:
+    """Windowed NaN/Inf checks over executor outputs, grads, or op taps.
+
+    Parameters
+    ----------
+    interval : int
+        Check every Nth executor completion (window stride); per-op taps
+        check every observed tensor while a window is open.
+    policy : "warn" | "raise"
+        What to do on an anomaly (default: MXNET_NAN_SENTINEL_POLICY,
+        else "warn").
+    pattern : str
+        Regex filter on array/op-output names (like Monitor's).
+    check_outputs / check_grads : bool
+        Which executor-level surfaces to scan.
+    """
+
+    def __init__(self, interval=1, policy=None, pattern=".*",
+                 check_outputs=True, check_grads=True):
+        if interval < 1:
+            raise ValueError("interval must be >= 1")
+        policy = policy or os.environ.get("MXNET_NAN_SENTINEL_POLICY",
+                                          "warn")
+        if policy not in ("warn", "raise"):
+            raise ValueError(f"policy must be 'warn' or 'raise', "
+                             f"got {policy!r}")
+        self.interval = int(interval)
+        self.policy = policy
+        self.check_outputs = check_outputs
+        self.check_grads = check_grads
+        self._pattern = re.compile(pattern)
+        self._step = 0
+        self.anomalies = []      # [{"step", "kind", "array"}], host-side
+
+    # ------------------------------------------------------------ install
+    def install(self, exe, per_op=False):
+        """Attach to an Executor.
+
+        ``per_op=True`` additionally claims the Monitor install point
+        (``set_monitor_callback``) — per-op attribution at eager debug
+        speed; a Monitor and a per-op sentinel can't share an executor.
+        """
+        exe._sentinel = self
+        if per_op:
+            exe.set_monitor_callback(self._observe)
+        return self
+
+    # ------------------------------------------------- per-op (tap) path
+    def _observe(self, name, arr):
+        """Monitor-compatible tap: check one op output immediately."""
+        if not self._pattern.match(name):
+            return
+        data = arr.asjax()
+        if not _is_float(data):
+            return
+        import jax.numpy as jnp
+        if not bool(jnp.isfinite(data).all()):
+            self._emit([("op_output", name)], self._step)
+
+    # ------------------------------------------- executor-level hook
+    def check_executor(self, exe, grads_fresh=True):
+        """Scan a completed executor step (outputs + fresh grads).
+
+        Called by Executor._finish and the fused train step. Windowed:
+        only every ``interval``-th call does device math; the reduction
+        stays on device and all window flags come back in one transfer.
+        """
+        step, self._step = self._step, self._step + 1
+        if step % self.interval:
+            return
+        import jax
+        import jax.numpy as jnp
+        todo = []
+        if self.check_outputs and exe._outputs:
+            for nm, arr in zip(exe.output_names, exe._outputs):
+                if arr is None or not self._pattern.match(nm):
+                    continue
+                data = arr.asjax()
+                if _is_float(data):
+                    todo.append(("output", nm, jnp.isfinite(data).all()))
+        if self.check_grads and grads_fresh:
+            for nm, g in zip(exe.arg_names, exe.grad_arrays):
+                if g is None or not self._pattern.match(nm):
+                    continue
+                data = g.asjax()
+                if _is_float(data):
+                    todo.append(("gradient", nm, jnp.isfinite(data).all()))
+        if not todo:
+            return
+        flags = jax.device_get([flag for _, _, flag in todo])
+        bad = [(kind, nm) for (kind, nm, _), ok in zip(todo, flags)
+               if not ok]
+        if bad:
+            self._emit(bad, step)
+
+    # ---------------------------------------------------------- emission
+    def _emit(self, bad, step):
+        """Record anomalies everywhere, then apply the policy once."""
+        for kind, name in bad:
+            self.anomalies.append({"step": step, "kind": kind,
+                                   "array": name})
+            _metrics.counter("sentinel.anomalies", kind=kind,
+                             array=name).inc()
+            _flightrec.note("anomaly", what=kind, array=name, step=step)
+            if _core.enabled():
+                _core.event("anomaly", what=kind, array=name, step=step)
+        desc = ", ".join(f"{kind} {name!r}" for kind, name in bad)
+        msg = (f"non-finite values detected at step {step}: {desc} "
+               f"(sentinel policy={self.policy})")
+        if self.policy == "raise":
+            raise AnomalyError(msg)
+        log.warning(msg)

@@ -5,6 +5,14 @@ tests exercise real SPMD partitioning over 8 XLA CPU devices (SURVEY.md §4:
 "distributed tests = N local processes" -> here N virtual devices).
 """
 import os
+import tempfile
+
+# Tests that deliberately crash executors/fit would otherwise drop
+# flight-recorder crash reports into the working tree; tests asserting
+# on dumps point the recorder at their own tmp_path via configure().
+os.environ.setdefault(
+    "MXNET_CRASH_DIR",
+    os.path.join(tempfile.gettempdir(), f"mxnet_crash_{os.getpid()}"))
 
 # Force, don't setdefault: the outer environment may carry JAX_PLATFORMS=tpu
 # (or another accelerator), and the suite's numerics are written for f32 CPU

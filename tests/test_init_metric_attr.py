@@ -149,6 +149,29 @@ def test_np_metric_wrapper():
     assert m.get()[1] == 1.0
 
 
+def test_metric_setter_discards_pending_device_batches():
+    """ADVICE r5: poking sum_metric/num_inst must DISCARD queued
+    device-side accumulations, not flush them into both accumulators
+    before overwriting only one (the old half-applied state)."""
+    m = mx.metric.Accuracy()
+    pred = mx.nd.array([[0.1, 0.9], [0.8, 0.2]])
+    label = mx.nd.array([1, 0])
+    m.update([label], [pred])            # device path: queues pending
+    assert m._pending, "expected a queued device batch"
+    m.sum_metric = 0
+    # the queued batch is gone entirely: num_inst did NOT absorb it
+    assert m.num_inst == 0
+    assert m.sum_metric == 0
+    # same discard through the num_inst setter
+    m.update([label], [pred])
+    assert m._pending
+    m.num_inst = 0
+    assert m.sum_metric == 0 and m.num_inst == 0
+    # metric remains fully usable afterwards
+    m.update([label], [pred])
+    assert m.get()[1] == 1.0
+
+
 # ------------------------------------------------------------ attr scoping
 def test_attr_scope():
     with mx.AttrScope(group="4", data="great"):

@@ -320,8 +320,13 @@ def test_fused_keep_grads_env(monkeypatch):
                       for k in after)
         return changed, after
 
-    changed_off, _ = grads_after_step(False)
+    changed_off, grads_off = grads_after_step(False)
     assert not changed_off, "default fused step must not write grad_dict"
+    # ADVICE r5: with KEEP_GRADS unset the fused path never emits grads —
+    # the buffers are NaN-poisoned at arm time so a stale read fails
+    # loudly instead of returning plausible pre-step values
+    for k, v in grads_off.items():
+        assert np.isnan(v).all(), f"{k} not poisoned"
     changed_on, grads_fused = grads_after_step(True)
     assert changed_on, "KEEP_GRADS=1 must populate grad_dict"
     # and the emitted gradients match the staged path's

@@ -9,7 +9,8 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 import mxnet_tpu as mx
 from mxnet_tpu.parallel import (build_mesh, data_sharding, replicated,
-                                all_reduce, all_gather, reduce_scatter)
+                                all_reduce, all_gather, reduce_scatter,
+                                shard_map)
 from mxnet_tpu.parallel.ring_attention import (attention, ring_attention,
                                                ring_attention_sharded)
 
@@ -32,7 +33,7 @@ def test_build_mesh_axes():
 def test_sharded_psum():
     mesh = build_mesh(data=8, devices=_cpu_devices())
 
-    @jax.shard_map(mesh=mesh, in_specs=P("data"), out_specs=P())
+    @shard_map(mesh=mesh, in_specs=P("data"), out_specs=P())
     def total(x):
         return all_reduce(jnp.sum(x), "data")
 
@@ -44,7 +45,7 @@ def test_sharded_psum():
 def test_all_gather_reduce_scatter():
     mesh = build_mesh(data=4, devices=_cpu_devices())
 
-    @jax.shard_map(mesh=mesh, in_specs=P("data"), out_specs=P("data"))
+    @shard_map(mesh=mesh, in_specs=P("data"), out_specs=P("data"))
     def ag_rs(x):
         full = all_gather(x, "data")            # (16,)
         return reduce_scatter(full, "data")     # each gets sum-of-shards
@@ -99,7 +100,7 @@ def test_ring_attention_grads():
 
     @jax.jit
     def loss_ring(q, k, v):
-        @jax.shard_map(mesh=mesh, in_specs=(spec,) * 3, out_specs=spec)
+        @shard_map(mesh=mesh, in_specs=(spec,) * 3, out_specs=spec)
         def att(qs, ks, vs):
             return ring_attention(qs, ks, vs, axis_name="seq")
         return jnp.sum(att(q, k, v) ** 2)

@@ -105,6 +105,114 @@ def test_parse_log_telemetry_jsonl(tmp_path):
     assert "0.890000" in rows[2] and "140.0" in rows[2]
 
 
+SYNTHETIC_CRASH = {
+    "type": "crash_report",
+    "version": 1,
+    "time_unix": 1754000000.0,
+    "time": "2026-08-01T00:00:00+0000",
+    "where": "module.fit",
+    "pid": 4242,
+    "argv": ["train.py"],
+    "exception": {
+        "type": "XlaRuntimeError",
+        "message": "RESOURCE_EXHAUSTED: out of memory allocating 2.1GiB",
+        "traceback": ["Traceback (most recent call last):\n",
+                      "XlaRuntimeError: RESOURCE_EXHAUSTED\n"],
+    },
+    "ring": [
+        {"kind": "executor.bind", "ts_us": 1000, "ctx": "tpu(0)",
+         "arg_bytes": 1 << 30, "output_bytes": 1 << 20},
+        {"kind": "span", "name": "op.Convolution", "ts_us": 2000,
+         "dur_us": 90000},
+        {"kind": "module.fit.batch", "ts_us": 200000, "epoch": 0,
+         "nbatch": 0, "dur_us": 150000, "batch_size": 256},
+        {"kind": "anomaly", "ts_us": 250000, "what": "gradient",
+         "array": "fc1_weight", "step": 1},
+        {"kind": "module.fit.batch", "ts_us": 400000, "epoch": 0,
+         "nbatch": 1, "dur_us": 160000, "batch_size": 256},
+    ],
+    "metrics": {
+        "counters": {"executor.jit_cache.hit": 18,
+                     "executor.jit_cache.miss": 2},
+        "gauges": {}, "histograms": {},
+    },
+    "memory": {"tpu(0)": {"live_bytes": 2147483648,
+                          "peak_bytes": 3221225472,
+                          "allocs": 900, "frees": 120}},
+    "backend": "tpu",
+    "devices": [{"id": 0, "platform": "tpu", "device_kind": "TPU v5e",
+                 "process_index": 0}],
+    "env": {"MXNET_FLIGHT_RECORDER": "1", "JAX_PLATFORMS": "tpu"},
+}
+
+
+def test_diagnose_crash_dump(tmp_path):
+    """tools/diagnose.py renders a synthetic crash dump: exception,
+    jit-cache rate, memory watermarks, first-anomaly, timeline."""
+    dump = tmp_path / "mxnet_crash_4242_1.json"
+    dump.write_text(json.dumps(SYNTHETIC_CRASH))
+    cli = os.path.join(TOOLS, "diagnose.py")
+    r = subprocess.run([sys.executable, cli, str(dump)],
+                       capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    out = r.stdout
+    assert "CRASH REPORT" in out
+    assert "XlaRuntimeError" in out and "RESOURCE_EXHAUSTED" in out
+    assert "module.fit" in out
+    assert "90.0% hit rate" in out
+    assert "tpu(0)" in out and "2.0 GiB" in out and "3.0 GiB" in out
+    assert "FIRST: gradient 'fc1_weight' at step 1" in out
+    assert "op.Convolution" in out                  # slowest span
+    assert "module.fit.batch" in out                # recent timeline
+    # missing file -> exit 2
+    r2 = subprocess.run([sys.executable, cli, str(tmp_path / "nope.json")],
+                        capture_output=True, text=True)
+    assert r2.returncode == 2
+
+
+DIAGNOSE_JSONL = "\n".join(
+    [json.dumps({"type": "event", "kind": "batch_end", "epoch": 0,
+                 "nbatch": i, "duration_us": 100000 + i * 20000,
+                 "batch_size": 32}) for i in range(6)]
+    + [json.dumps({"type": "event", "kind": "anomaly", "ts_us": 777,
+                   "what": "output", "array": "softmax_output",
+                   "step": 4}),
+       json.dumps({"type": "span", "name": "op.FullyConnected",
+                   "ts_us": 1, "dur_us": 5000, "pid": 1, "tid": 1,
+                   "parent": None, "args": {}}),
+       json.dumps({"type": "counter", "name": "executor.jit_cache.hit",
+                   "labels": {}, "value": 6}),
+       json.dumps({"type": "counter", "name": "executor.jit_cache.miss",
+                   "labels": {}, "value": 2}),
+       json.dumps({"type": "gauge", "name": "memory.live_bytes",
+                   "labels": {"ctx": "cpu(0)"}, "value": 1048576.0}),
+       json.dumps({"type": "gauge", "name": "memory.peak_bytes",
+                   "labels": {"ctx": "cpu(0)"}, "value": 4194304.0}),
+       json.dumps({"type": "histogram",
+                   "name": "module.fit.batch.seconds", "labels": {},
+                   "count": 6, "sum": 0.9, "min": 0.1, "max": 0.2,
+                   "mean": 0.15})]) + "\n"
+
+
+def test_diagnose_jsonl_health_report(tmp_path):
+    """The jsonl path reports throughput trend (degrading here: batch
+    durations grow), slowest ops, cache rate, memory, first anomaly."""
+    log = tmp_path / "events.jsonl"
+    log.write_text(DIAGNOSE_JSONL)
+    cli = os.path.join(TOOLS, "diagnose.py")
+    r = subprocess.run([sys.executable, cli, str(log)],
+                       capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    out = r.stdout
+    assert "TELEMETRY HEALTH REPORT" in out
+    assert "DEGRADING" in out                  # durations trend up
+    assert "75.0% hit rate" in out
+    assert "cpu(0): live 1.0 MiB, peak 4.0 MiB" in out
+    assert "FIRST: output 'softmax_output' at step 4" in out
+    assert "op.FullyConnected" in out
+    assert "batch time: mean 150.0 ms" in out
+
+
 def test_bandwidth_tool_local():
     r = subprocess.run(
         [sys.executable, os.path.join(TOOLS, "bandwidth.py"),

@@ -1,0 +1,296 @@
+#!/usr/bin/env python
+"""Render a crash report or telemetry jsonl log as a health report.
+
+The operator-facing half of the diagnostics layer: the flight recorder
+(mxnet_tpu.telemetry.flightrec) leaves ``mxnet_crash_*.json`` dumps when
+a run dies, and ``mx.telemetry.jsonl.dump()`` writes the structured
+event log of a live run — this tool turns either into the summary a
+human reads first:
+
+* what killed the run (exception + where + recent-activity timeline),
+* throughput trend across the run (is it slowing down?),
+* slowest ops / dispatch latencies,
+* jit-cache hit rate (recompilation storms),
+* per-context memory watermarks (how close to OOM),
+* the first-anomaly timeline from the NaN/Inf sentinel.
+
+Usage:
+    python tools/diagnose.py mxnet_crash_12345_1.json
+    python tools/diagnose.py train_events.jsonl [--top 10]
+
+Input format is auto-detected: a single JSON object with
+``"type": "crash_report"`` takes the crash path, anything else is
+treated as a JSON-lines event log.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _fmt_bytes(n):
+    if n is None:
+        return "?"
+    n = float(n)
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024 or unit == "TiB":
+            return f"{n:.1f} {unit}" if unit != "B" else f"{int(n)} B"
+        n /= 1024
+
+
+def _fmt_us(us):
+    us = float(us)
+    if us < 1000:
+        return f"{us:.0f} us"
+    if us < 1e6:
+        return f"{us / 1e3:.1f} ms"
+    return f"{us / 1e6:.2f} s"
+
+
+def _strip_labels(series):
+    """'name{k="v"}' -> (name, 'k="v"')."""
+    if "{" in series:
+        name, _, rest = series.partition("{")
+        return name, rest.rstrip("}")
+    return series, ""
+
+
+# ------------------------------------------------------------ shared bits
+def _jit_cache_section(counters):
+    hits = sum(v for k, v in counters.items()
+               if _strip_labels(k)[0] == "executor.jit_cache.hit")
+    misses = sum(v for k, v in counters.items()
+                 if _strip_labels(k)[0] == "executor.jit_cache.miss")
+    total = hits + misses
+    if not total:
+        return ["jit cache: no activity recorded"]
+    rate = 100.0 * hits / total
+    lines = [f"jit cache: {hits}/{total} hits ({rate:.1f}% hit rate, "
+             f"{misses} compiles)"]
+    if misses > hits and total > 4:
+        lines.append("  WARNING: more compiles than cache hits — "
+                     "recompilation storm (varying shapes/dtypes?)")
+    return lines
+
+
+def _memory_section(mem):
+    lines = []
+    for ctx in sorted(mem or {}):
+        rec = mem[ctx]
+        live, peak = rec.get("live_bytes"), rec.get("peak_bytes")
+        extra = ""
+        if rec.get("allocs") is not None:
+            extra = (f"  ({rec.get('allocs', 0)} allocs, "
+                     f"{rec.get('frees', 0)} frees)")
+        lines.append(f"  {ctx}: live {_fmt_bytes(live)}, "
+                     f"peak {_fmt_bytes(peak)}{extra}")
+    return ["memory watermarks:"] + (lines or ["  (no accounting data)"])
+
+
+def _anomaly_section(anoms):
+    if not anoms:
+        return ["anomalies: none recorded"]
+    anoms = sorted(anoms, key=lambda a: a.get("ts_us", 0))
+    first = anoms[0]
+    lines = [f"anomalies: {len(anoms)} non-finite detections "
+             f"(NaN/Inf sentinel)"]
+    lines.append(f"  FIRST: {first.get('what', first.get('kind', '?'))} "
+                 f"{first.get('array', '?')!r} at step "
+                 f"{first.get('step', '?')}")
+    for a in anoms[1:6]:
+        lines.append(f"  then:  {a.get('what', a.get('kind', '?'))} "
+                     f"{a.get('array', '?')!r} at step {a.get('step', '?')}")
+    if len(anoms) > 6:
+        lines.append(f"  ... and {len(anoms) - 6} more")
+    return lines
+
+
+def _slowest_spans(spans, top):
+    """Top spans by duration, one line each (op.* and dispatch spans)."""
+    interesting = [s for s in spans
+                   if s.get("name", "").startswith(("op.", "executor.",
+                                                    "kvstore.", "io."))]
+    interesting.sort(key=lambda s: s.get("dur_us", 0), reverse=True)
+    lines = []
+    for s in interesting[:top]:
+        lines.append(f"  {_fmt_us(s.get('dur_us', 0)):>10}  "
+                     f"{s.get('name', '?')}")
+    return ["slowest recorded spans:"] + (lines or ["  (no spans)"])
+
+
+# ------------------------------------------------------------ crash path
+def render_crash(report, top=10):
+    """Crash-report dict -> human-readable text."""
+    out = ["=" * 64, "CRASH REPORT", "=" * 64]
+    out.append(f"time:  {report.get('time', '?')}   "
+               f"pid {report.get('pid', '?')}")
+    out.append(f"where: {report.get('where') or 'unknown'}")
+    exc = report.get("exception")
+    if exc:
+        out.append(f"error: {exc.get('type', '?')}: "
+                   f"{exc.get('message', '')}")
+    backend = report.get("backend")
+    devs = report.get("devices") or []
+    if backend or devs:
+        kinds = sorted({d.get("device_kind", "?") for d in devs})
+        out.append(f"backend: {backend or '?'} — {len(devs)} device(s) "
+                   f"({', '.join(kinds)})")
+    out.append("")
+
+    metrics = report.get("metrics") or {}
+    out += _jit_cache_section(metrics.get("counters") or {})
+    out += _memory_section(report.get("memory"))
+
+    ring = report.get("ring") or []
+    anoms = [r for r in ring if r.get("kind") == "anomaly"]
+    out += _anomaly_section(anoms)
+
+    # throughput from ring batch records
+    batches = [r for r in ring if r.get("kind") == "module.fit.batch"
+               or (r.get("kind") == "batch_end")]
+    if batches:
+        durs = [r.get("dur_us") or r.get("duration_us") for r in batches]
+        durs = [d for d in durs if d]
+        if durs:
+            mean_us = sum(durs) / len(durs)
+            out.append(f"recent batches: {len(batches)} in ring, mean "
+                       f"{_fmt_us(mean_us)}/batch, last "
+                       f"{_fmt_us(durs[-1])}")
+    spans = [r for r in ring if r.get("kind") == "span"]
+    out += _slowest_spans(spans, top)
+
+    out.append("")
+    out.append(f"recent activity (newest last, {len(ring)} ring entries):")
+    t_end = ring[-1].get("ts_us", 0) if ring else 0
+    for r in ring[-top:]:
+        dt = (r.get("ts_us", t_end) - t_end) / 1e6
+        desc = {k: v for k, v in r.items() if k not in ("kind", "ts_us")}
+        out.append(f"  {dt:+9.3f}s  {r.get('kind', '?'):<20} {desc}")
+    env = report.get("env") or {}
+    knobs = {k: v for k, v in env.items() if k.startswith("MXNET_")}
+    if knobs:
+        out.append("")
+        out.append("MXNET_* env: " + ", ".join(
+            f"{k}={v}" for k, v in sorted(knobs.items())))
+    return "\n".join(out)
+
+
+# ------------------------------------------------------------ jsonl path
+def render_jsonl(lines, top=10):
+    """Telemetry jsonl lines -> health-report text."""
+    events, spans, counters, gauges, hists = [], [], {}, {}, {}
+    for line in lines:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        t = rec.get("type")
+        if t == "event":
+            events.append(rec)
+        elif t == "span":
+            spans.append(rec)
+        elif t == "counter":
+            labels = rec.get("labels") or {}
+            key = rec.get("name", "?")
+            if labels:
+                inner = ",".join(f'{k}="{v}"'
+                                 for k, v in sorted(labels.items()))
+                key = f"{key}{{{inner}}}"
+            counters[key] = counters.get(key, 0) + rec.get("value", 0)
+        elif t == "gauge":
+            gauges[(rec.get("name", "?"),
+                    tuple(sorted((rec.get("labels") or {}).items())))] = \
+                rec.get("value")
+        elif t == "histogram":
+            hists[rec.get("name", "?")] = rec
+
+    out = ["=" * 64, "TELEMETRY HEALTH REPORT", "=" * 64]
+
+    # throughput trend: batch_end durations (or speed events), first vs
+    # last third of the run
+    speeds = []
+    for e in events:
+        if e.get("kind") == "speed" and e.get("samples_per_sec"):
+            speeds.append(float(e["samples_per_sec"]))
+        elif e.get("kind") == "batch_end":
+            dur, bs = e.get("duration_us") or 0, e.get("batch_size") or 0
+            if dur > 0 and bs > 0:
+                speeds.append(bs / (dur / 1e6))
+    if speeds:
+        third = max(1, len(speeds) // 3)
+        head = sum(speeds[:third]) / third
+        tail = sum(speeds[-third:]) / len(speeds[-third:])
+        trend = (tail / head - 1.0) * 100.0 if head else 0.0
+        arrow = "stable" if abs(trend) < 5 else \
+            ("IMPROVING" if trend > 0 else "DEGRADING")
+        out.append(f"throughput: {sum(speeds) / len(speeds):.1f} "
+                   f"samples/s mean over {len(speeds)} batches; trend "
+                   f"{trend:+.1f}% (first vs last third) — {arrow}")
+    else:
+        out.append("throughput: no batch_end/speed events in log")
+
+    out += _jit_cache_section(counters)
+
+    # memory gauges from the registry section
+    mem = {}
+    for (name, labels), val in gauges.items():
+        if name in ("memory.live_bytes", "memory.peak_bytes"):
+            ctx = dict(labels).get("ctx", "?")
+            slot = "live_bytes" if name.endswith("live_bytes") \
+                else "peak_bytes"
+            mem.setdefault(ctx, {})[slot] = val
+    out += _memory_section(mem)
+
+    anoms = [{"what": e.get("what"), "array": e.get("array"),
+              "step": e.get("step"), "ts_us": e.get("ts_us", 0)}
+             for e in events if e.get("kind") == "anomaly"]
+    out += _anomaly_section(anoms)
+    out += _slowest_spans(spans, top)
+
+    h = hists.get("module.fit.batch.seconds")
+    if h:
+        out.append(f"batch time: mean {h.get('mean', 0) * 1e3:.1f} ms, "
+                   f"min {h.get('min', 0) * 1e3:.1f} / max "
+                   f"{h.get('max', 0) * 1e3:.1f} ms over "
+                   f"{h.get('count', 0)} batches")
+    return "\n".join(out)
+
+
+# ------------------------------------------------------------------ entry
+def render_file(path, top=10):
+    """Auto-detect and render; returns the report text."""
+    with open(path) as f:
+        text = f.read()
+    stripped = text.lstrip()
+    if stripped.startswith("{"):
+        try:
+            doc = json.loads(text)
+            if isinstance(doc, dict) and doc.get("type") == "crash_report":
+                return render_crash(doc, top=top)
+        except json.JSONDecodeError:
+            pass                   # multi-object jsonl: fall through
+    return render_jsonl(text.splitlines(), top=top)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        description="Render a flight-recorder crash dump or telemetry "
+                    "jsonl log as a human-readable health report.")
+    p.add_argument("path", help="mxnet_crash_*.json or *.jsonl file")
+    p.add_argument("--top", type=int, default=10,
+                   help="how many slowest spans / timeline rows to show")
+    args = p.parse_args(argv)
+    try:
+        print(render_file(args.path, top=args.top))
+    except FileNotFoundError:
+        print(f"no such file: {args.path}", file=sys.stderr)
+        return 2
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
