@@ -236,6 +236,117 @@ def setup_flax(imgs, labels):
     return one_step, flops, counter
 
 
+def run_cpu_fallback():
+    """Reduced ours-only measurement on the CPU backend.
+
+    Runs when the accelerator tunnel is down: the paired A/B ResNet-50
+    protocol is meaningless on CPU (and takes hours), so this measures
+    the product hot loop — the fused/scan train program through
+    Module.fit — on a CIFAR-scale ResNet-20 and reports it under a
+    ``*_cpu_fallback`` metric with ``vs_baseline: null``, so BENCH_r*
+    records a real number instead of only nulls (BENCH_r05).
+    """
+    import jax
+    import jax.numpy as jnp
+    import mxnet_tpu as mx
+    from mxnet_tpu.models import resnet
+
+    batch, n_batches, classes = 32, 8, 10
+    rng = np.random.RandomState(0)
+    imgs = rng.rand(n_batches * batch, 3, 32, 32).astype(np.float32)
+    labels = (rng.rand(n_batches * batch) * classes).astype(np.float32)
+
+    sym = resnet.get_symbol(num_classes=classes, num_layers=20,
+                            image_shape="3,32,32")
+    it = mx.io.NDArrayIter(imgs, labels, batch_size=batch)
+    mod = mx.mod.Module(sym, context=mx.cpu())
+    opt_params = {"learning_rate": LR, "momentum": MOMENTUM}
+
+    _log("cpu fallback: bind+compile+warm epoch")
+    mod.fit(it, num_epoch=1, initializer=mx.initializer.Xavier(),
+            optimizer_params=opt_params)
+
+    _log("cpu fallback: timed epochs")
+    laps = []
+    lap = [time.perf_counter()]
+
+    def cb(param):
+        # force completion symmetrically with the main protocol: fetch
+        # the metric's pending device scalar
+        m = param.eval_metric
+        if getattr(m, "_pending", None):
+            float(jax.device_get(m._pending[-1][0]))
+        laps.append(time.perf_counter() - lap[0])
+        lap[0] = time.perf_counter()
+
+    for _ in range(2):
+        it.reset()
+        lap[0] = time.perf_counter()
+        mod.fit(it, num_epoch=1, optimizer_params=opt_params,
+                batch_end_callback=cb)
+    import statistics
+    img_s = batch / statistics.median(laps)
+    print(json.dumps({
+        "metric": "resnet20_cifar_bf16off_b32_train_img_per_sec"
+                  "_cpu_fallback",
+        "value": round(img_s, 2),
+        "unit": "img/s",
+        "vs_baseline": None,
+        "device": "cpu",
+        "n_laps": len(laps),
+        "note": "accelerator backend unavailable; ours-only fused-step "
+                "throughput on the XLA CPU backend at a CIFAR-scale "
+                "operating point — NOT comparable to the flax-paired "
+                "TPU metric, recorded so the benchmark series carries "
+                "a signal instead of nulls",
+    }))
+
+
+def _cpu_fallback_subprocess(reason):
+    """Re-exec this script on the CPU backend in a fresh process.
+
+    The wedged accelerator discovery holds jax's backend-init lock in
+    THIS process, so the fallback must run in a subprocess with
+    JAX_PLATFORMS=cpu pinned from the start. Prints the child's JSON
+    line (with the outer failure attached) and returns its exit code.
+    """
+    import subprocess
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("JAX_PLATFORM_NAME", None)
+    _log(f"accelerator unavailable ({reason}); "
+         "re-running on the CPU backend")
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--cpu-fallback"],
+            env=env, capture_output=True, text=True, timeout=2400)
+    except subprocess.TimeoutExpired:
+        print(json.dumps({
+            "metric": "resnet20_cifar_bf16off_b32_train_img_per_sec"
+                      "_cpu_fallback",
+            "value": None, "unit": "img/s", "vs_baseline": None,
+            "error": f"cpu fallback timed out; original failure: "
+                     f"{reason}"}))
+        return 1
+    sys.stderr.write(proc.stderr[-2000:])
+    line = None
+    for cand in reversed(proc.stdout.strip().splitlines()):
+        if cand.startswith("{"):
+            line = cand
+            break
+    if proc.returncode == 0 and line:
+        payload = json.loads(line)
+        payload["fallback_reason"] = reason
+        print(json.dumps(payload))
+        return 0
+    print(json.dumps({
+        "metric": "resnet20_cifar_bf16off_b32_train_img_per_sec"
+                  "_cpu_fallback",
+        "value": None, "unit": "img/s", "vs_baseline": None,
+        "error": f"cpu fallback failed (rc={proc.returncode}); "
+                 f"original failure: {reason}"}))
+    return 1
+
+
 class _PairedRound:
     """Batch-granularity A/B pairing inside one fit epoch.
 
@@ -293,13 +404,12 @@ def main():
 
     threading.Thread(target=_init, daemon=True).start()
     if not ready.wait(900) or err:
-        print(json.dumps({
-            "metric": "resnet50_bf16_b256_train_img_per_sec_vs_flax_1chip",
-            "value": None, "unit": "img/s", "vs_baseline": None,
-            "error": err[0] if err else
-                     "TPU backend unavailable: jax.devices() did not "
-                     "return within 900s (tunnel down?)"}))
-        sys.exit(1)
+        reason = err[0] if err else (
+            "TPU backend unavailable: jax.devices() did not return "
+            "within 900s (tunnel down?)")
+        # don't exit 1 with only nulls: measure the CPU backend instead
+        # (fresh subprocess — this process's backend init is wedged)
+        sys.exit(_cpu_fallback_subprocess(reason))
     dev = box[0][0]
     peak = PEAK_BF16.get(dev.device_kind)
     rng = np.random.RandomState(0)
@@ -441,4 +551,7 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    if "--cpu-fallback" in sys.argv[1:]:
+        run_cpu_fallback()
+    else:
+        main()

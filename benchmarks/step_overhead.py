@@ -1,0 +1,96 @@
+"""Per-step dispatch overhead: K-step scan fit vs the per-batch loop.
+
+Measures the dispatch/compile amortization layer on the XLA CPU backend
+(deterministic, runs anywhere): a small conv net trained through
+``Module.fit`` at ``steps_per_dispatch`` K in {1, 4, 8}, recording
+
+  * dispatches per batch — the ``executor.dispatch`` telemetry counter
+    (every ``telemetry.wrap_dispatch`` submission) divided by batches;
+    K=1 pays one dispatch per batch, K=8 pays 1/8;
+  * steady-state img/s over the epoch (first epoch compiles, second is
+    timed);
+
+and writes ``benchmarks/results/step_overhead.json``. The companion
+non-slow gate lives in tests/test_scan_fit.py (K=8 must issue <= 2
+dispatches per 8 batches).
+
+Run: JAX_PLATFORMS=cpu python benchmarks/step_overhead.py
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+BATCH = 32
+N_BATCHES = 32
+CLASSES = 10
+KS = (1, 4, 8)
+
+
+def _net():
+    import mxnet_tpu as mx
+    data = mx.sym.var("data")
+    net = mx.sym.Convolution(data, num_filter=8, kernel=(3, 3), name="c1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.Pooling(net, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    net = mx.sym.Flatten(net)
+    net = mx.sym.FullyConnected(net, num_hidden=CLASSES, name="fc")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def measure(K):
+    import mxnet_tpu as mx
+    rng = np.random.RandomState(0)
+    imgs = rng.rand(N_BATCHES * BATCH, 1, 16, 16).astype(np.float32)
+    labels = (rng.rand(N_BATCHES * BATCH) * CLASSES).astype(np.float32)
+    it = mx.io.NDArrayIter(imgs, labels, batch_size=BATCH)
+
+    mod = mx.mod.Module(_net(), context=mx.cpu())
+    opt = (("learning_rate", 0.05), ("momentum", 0.9))
+    mod.fit(it, num_epoch=1, steps_per_dispatch=K,
+            initializer=mx.initializer.Xavier(), optimizer_params=opt)
+
+    mx.telemetry.reset()
+    mx.telemetry.enable()
+    it.reset()
+    t0 = time.perf_counter()
+    mod.fit(it, num_epoch=1, steps_per_dispatch=K, optimizer_params=opt)
+    elapsed = time.perf_counter() - t0
+    mx.telemetry.disable()
+    snap = mx.telemetry.snapshot()
+    dispatches = snap["counters"].get("executor.dispatch", 0)
+    return {
+        "steps_per_dispatch": K,
+        "batches": N_BATCHES,
+        "dispatches": dispatches,
+        "dispatches_per_batch": round(dispatches / N_BATCHES, 4),
+        "img_per_sec": round(N_BATCHES * BATCH / elapsed, 1),
+        "epoch_seconds": round(elapsed, 4),
+    }
+
+
+def main():
+    import mxnet_tpu as mx  # noqa: F401 — fail early if the env is broken
+    results = {"batch_size": BATCH, "n_batches": N_BATCHES,
+               "backend": "cpu", "by_k": [measure(K) for K in KS]}
+    k1 = next(r for r in results["by_k"] if r["steps_per_dispatch"] == 1)
+    for r in results["by_k"]:
+        r["speedup_vs_k1"] = round(r["img_per_sec"] / k1["img_per_sec"], 3)
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "results", "step_overhead.json")
+    os.makedirs(os.path.dirname(out), exist_ok=True)
+    with open(out, "w") as f:
+        json.dump(results, f, indent=1)
+    print(json.dumps(results))
+
+
+if __name__ == "__main__":
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    main()

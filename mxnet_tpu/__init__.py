@@ -23,6 +23,7 @@ from . import random
 from .attribute import AttrScope
 from .name import NameManager, Prefix
 from .executor import Executor
+from . import program_cache
 from . import io
 from . import recordio
 from . import initializer

@@ -158,18 +158,16 @@ def main():
     rec = open(cfg["rec_path"], "rb")
 
     out = sys.stdout
-    for line in sys.stdin:
-        req = json.loads(line)
-        if req.get("cmd") == "quit":
-            break
-        slot = int(req["slot"])
+
+    def process(order):
+        slot = int(order["slot"])
         base = slot * slot_floats
         imgs = buf[base:base + slot_imgs * img_floats].reshape(
             slot_imgs, c, ih, iw)
         labs = buf[base + slot_imgs * img_floats:
                    base + slot_floats].reshape(slot_imgs, label_width)
         try:
-            for k, off in enumerate(req["items"]):
+            for k, off in enumerate(order["items"]):
                 label, body = _unpack(_read_record(rec, off))
                 img = cv2.imdecode(np.frombuffer(body, np.uint8),
                                    cv2.IMREAD_COLOR)
@@ -184,9 +182,19 @@ def main():
                 labs[k, :] = 0.0
                 labs[k, :min(label_width, lab.size)] = lab[:label_width]
             out.write(json.dumps({"slot": slot,
-                                  "n": len(req["items"])}) + "\n")
+                                  "n": len(order["items"])}) + "\n")
         except Exception as e:                        # report, don't die
             out.write(json.dumps({"slot": slot, "error": str(e)}) + "\n")
+
+    for line in sys.stdin:
+        req = json.loads(line)
+        if req.get("cmd") == "quit":
+            break
+        # chunked submission: one stdin line may carry several slot
+        # orders (parent amortizes json+pipe overhead across batches);
+        # replies stay one line per order, oldest first
+        for order in req.get("orders") or (req,):
+            process(order)
         out.flush()
     shm.close()
     rec.close()

@@ -16,11 +16,53 @@ XLA owns placement — so a Context is a value object used for:
 """
 from __future__ import annotations
 
+import logging
+import os
 import threading
 
 import jax
 
 __all__ = ["Context", "cpu", "gpu", "tpu", "current_context", "num_gpus"]
+
+_compilation_cache_wired = False
+
+
+def _init_compilation_cache():
+    """Wire the persistent XLA compilation cache at context init.
+
+    ``MXNET_COMPILATION_CACHE_DIR`` names an on-disk cache of compiled
+    XLA executables (jax's ``jax_compilation_cache_dir``): a warm
+    restart of the same training program skips its XLA compiles
+    entirely — the third leg of the dispatch/compile amortization layer
+    next to the process-wide program cache (program_cache.py) and the
+    K-step scan dispatch. ``MXNET_COMPILATION_CACHE_MIN_COMPILE_SECS``
+    optionally lowers jax's minimum-compile-time persistence threshold
+    (set 0 to persist even sub-second programs). Runs once; a user who
+    already configured jax's cache (e.g. bench.py's repo-local default
+    via ``JAX_COMPILATION_CACHE_DIR``) is left untouched.
+    """
+    global _compilation_cache_wired
+    if _compilation_cache_wired:
+        return
+    _compilation_cache_wired = True
+    path = os.environ.get("MXNET_COMPILATION_CACHE_DIR")
+    if not path:
+        return
+    try:
+        if jax.config.jax_compilation_cache_dir:
+            return          # already configured (env/bench/user code)
+    except AttributeError:
+        pass
+    try:
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        min_secs = os.environ.get("MXNET_COMPILATION_CACHE_MIN_COMPILE_SECS")
+        if min_secs is not None:
+            jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                              float(min_secs))
+    except Exception as exc:   # cache is an optimization, never fatal
+        logging.warning("persistent compilation cache unavailable "
+                        "(%s): %s", path, exc)
 
 
 class Context:
@@ -66,6 +108,7 @@ class Context:
         Multi-process: a Context names a device of THIS process —
         ``jax.devices()`` would enumerate the whole job's devices and
         hand other processes' (non-addressable) ones to low ids."""
+        _init_compilation_cache()
         if self.device_type in ("cpu", "cpu_pinned"):
             devs = _local_cpu_devices()
         else:

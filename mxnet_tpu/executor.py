@@ -38,6 +38,7 @@ from .base import MXNetError
 from .context import current_context
 from .ndarray import NDArray, zeros as nd_zeros
 from .ops.registry import get_op
+from . import program_cache as _progcache
 from . import random as _random
 from . import telemetry as _telemetry
 
@@ -433,8 +434,31 @@ class Executor:
                     arr._set(jax.device_put(arr.asjax(),
                                             self._mp_plan.replicated))
 
-        # compiled program cache: (kind, ) -> jitted fn
+        # compiled program cache, two levels: the per-instance dict is
+        # the fast path, and cacheable bindings (no model-parallel plan)
+        # also consult the process-wide program_cache so rebinds
+        # (train→eval, force_rebind, bucketing over a shared_group)
+        # reuse traces instead of recompiling per instance
         self._jit_cache = {}
+        self._prog_cache_base = None
+        if self._mp_plan is None:
+            from .ops import layout as _layout_mod
+            try:
+                self._prog_cache_base = (
+                    _progcache.symbol_signature(symbol),
+                    tuple((nm, tuple(a.shape), str(a.dtype))
+                          for nm, a in zip(self.arg_names, self.arg_arrays)
+                          if a is not None),
+                    tuple((nm, tuple(a.shape), str(a.dtype))
+                          for nm, a in zip(self.aux_names, self.aux_arrays)
+                          if a is not None),
+                    ctx.device_type,
+                    bool(_layout_mod.layout_opt_enabled()),
+                    str(compute_dtype) if compute_dtype is not None else None,
+                    self._remat_segments,
+                )
+            except Exception:
+                pass           # uncacheable binding: per-instance only
         self._tapped_runner = None   # eager monitored runner (per callback)
         self._naive_runner = None    # NaiveEngine serial replay runner
         self._pending = None      # recorded inputs awaiting execution
@@ -528,6 +552,16 @@ class Executor:
                 compute_dtype=self._compute_dtype)
         return self._naive_runner
 
+    def program_cache_key(self, kind, *extras):
+        """Process-wide cache key for one of this binding's programs, or
+        None when the binding isn't cacheable (model-parallel plan).
+        ``extras`` carries what only this program kind depends on (the
+        watched-param set for gradient programs, the optimizer token for
+        the fused/scan train steps)."""
+        if self._prog_cache_base is None:
+            return None
+        return self._prog_cache_base + (kind,) + extras
+
     def _get_program(self, kind):
         naive = naive_engine_active()
         cache_key = (kind, naive)
@@ -536,6 +570,19 @@ class Executor:
             if _telemetry.enabled():
                 _telemetry.counter("executor.jit_cache.hit").inc()
             return fn
+        gkey = None
+        if not naive:
+            extras = (tuple(self._watched()),) if kind == "fwd_bwd" else ()
+            gkey = self.program_cache_key(kind, *extras)
+            if gkey is not None:
+                fn = _progcache.get(gkey)
+                if fn is not None:
+                    # process-wide hit: another binding of the same
+                    # signature already traced this program
+                    if _telemetry.enabled():
+                        _telemetry.counter("executor.jit_cache.hit").inc()
+                    self._jit_cache[cache_key] = fn
+                    return fn
         if _telemetry.enabled():
             _telemetry.counter("executor.jit_cache.miss").inc()
         runner = self._naive_runner_fn() if naive else self._runner
@@ -568,6 +615,8 @@ class Executor:
                 if naive else _telemetry.wrap_dispatch(jax.jit(prog), kind)
         else:
             raise ValueError(kind)
+        if gkey is not None:
+            _progcache.put(gkey, fn)
         self._jit_cache[cache_key] = fn
         return fn
 

@@ -9,6 +9,7 @@ in PrefetchingIter for the background-producer behavior).
 """
 from __future__ import annotations
 
+import logging
 import os
 import random as pyrandom
 from concurrent.futures import ThreadPoolExecutor
@@ -432,6 +433,28 @@ class ImageIter(DataIter):
         return DataBatch([array(data)], [array(labels)], pad=pad)
 
 
+# Process-wide decode-pipeline choice from the one-shot throughput
+# probe: None = not probed yet, "mp" / "threads" afterwards. The probe
+# runs once because the answer is a property of the host (cores, IPC
+# cost), not of any one iterator.
+_AUTO_PIPELINE = {"choice": None}
+
+
+def _probe_img_per_sec(it, n_batches, batch_size):
+    """Measured decode throughput over a few batches (img/s)."""
+    import time
+    n = 0
+    t0 = time.perf_counter()
+    try:
+        for _ in range(n_batches):
+            it.next()
+            n += batch_size
+    except StopIteration:
+        pass
+    dt = time.perf_counter() - t0
+    return n / dt if dt > 0 else 0.0
+
+
 def ImageRecordIter(path_imgrec, data_shape, batch_size, path_imgidx=None,
                     shuffle=False, rand_crop=False, rand_mirror=False,
                     mean_r=0, mean_g=0, mean_b=0, std_r=1, std_g=1, std_b=1,
@@ -446,7 +469,14 @@ def ImageRecordIter(path_imgrec, data_shape, batch_size, path_imgidx=None,
     reference's OMP-parallel C++ parser); anything it can't express
     falls back to the in-process thread-pool ImageIter. Set
     ``num_workers=0`` (or MXNET_DECODE_WORKERS=0) to force the
-    fallback."""
+    fallback.
+
+    When neither ``num_workers`` nor ``MXNET_DECODE_WORKERS`` picks a
+    pipeline, the choice is *measured*: single-core hosts go straight to
+    the thread pool (the mp pipeline only adds IPC there — IO_BENCH_r05
+    measured 286 img/s mp vs 379 threaded on 1 core), and multi-core
+    hosts run a one-shot throughput probe of both pipelines, keeping the
+    faster (``MXNET_IO_AUTOTUNE=0`` skips the probe and trusts mp)."""
     mean = None
     std = None
     if mean_r or mean_g or mean_b:
@@ -460,10 +490,21 @@ def ImageRecordIter(path_imgrec, data_shape, batch_size, path_imgidx=None,
     mp_ok = (num_workers != 0
              and set(kwargs) <= {"label_width"}
              and path_imgrec is not None)
-    if mp_ok:
+
+    def _threaded():
+        aug_list = CreateAugmenter(data_shape, resize=resize,
+                                   rand_crop=rand_crop,
+                                   rand_mirror=rand_mirror,
+                                   mean=mean, std=std)
+        return ImageIter(batch_size, data_shape, path_imgrec=path_imgrec,
+                         path_imgidx=path_imgidx, shuffle=shuffle,
+                         part_index=part_index, num_parts=num_parts,
+                         aug_list=aug_list, data_name=data_name,
+                         label_name=label_name, **kwargs)
+
+    def _mp():
         from .mp_decode import MPImageRecordIter
-        from .io import PrefetchingIter
-        it = MPImageRecordIter(
+        return MPImageRecordIter(
             path_imgrec, data_shape, batch_size, path_imgidx=path_imgidx,
             label_width=kwargs.get("label_width", 1), shuffle=shuffle,
             part_index=part_index, num_parts=num_parts,
@@ -473,20 +514,33 @@ def ImageRecordIter(path_imgrec, data_shape, batch_size, path_imgidx=None,
                         "std": None if std is None else std.tolist()},
             num_workers=num_workers, seed=seed,
             data_name=data_name, label_name=label_name)
-        return PrefetchingIter(it) if prefetch else it
 
-    aug_list = CreateAugmenter(data_shape, resize=resize,
-                               rand_crop=rand_crop, rand_mirror=rand_mirror,
-                               mean=mean, std=std)
-    it = ImageIter(batch_size, data_shape, path_imgrec=path_imgrec,
-                   path_imgidx=path_imgidx, shuffle=shuffle,
-                   part_index=part_index, num_parts=num_parts,
-                   aug_list=aug_list, data_name=data_name,
-                   label_name=label_name, **kwargs)
-    if prefetch:
-        from .io import PrefetchingIter
-        return PrefetchingIter(it)
-    return it
+    # auto selection: nobody pinned a pipeline, so measure instead of
+    # assuming the mp path wins (it loses on low-core hosts)
+    if mp_ok and num_workers is None:
+        if (os.cpu_count() or 1) <= 1:
+            mp_ok = False
+        elif os.environ.get("MXNET_IO_AUTOTUNE", "1") != "0":
+            if _AUTO_PIPELINE["choice"] is None:
+                probe_n = max(2, 128 // batch_size)
+                mp_it = _mp()
+                try:
+                    mp_rate = _probe_img_per_sec(mp_it, probe_n, batch_size)
+                finally:
+                    mp_it.close()
+                th_rate = _probe_img_per_sec(_threaded(), probe_n,
+                                             batch_size)
+                _AUTO_PIPELINE["choice"] = \
+                    "mp" if mp_rate >= th_rate else "threads"
+                logging.info(
+                    "ImageRecordIter autotune: mp %.0f img/s vs threads "
+                    "%.0f img/s -> %s", mp_rate, th_rate,
+                    _AUTO_PIPELINE["choice"])
+            mp_ok = _AUTO_PIPELINE["choice"] == "mp"
+
+    from .io import PrefetchingIter
+    it = _mp() if mp_ok else _threaded()
+    return PrefetchingIter(it) if prefetch else it
 
 
 # ---------------------------------------------------------------------------

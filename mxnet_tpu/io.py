@@ -23,8 +23,9 @@ from .base import MXNetError
 from .ndarray import NDArray, array
 from . import telemetry as _telemetry
 
-__all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "ResizeIter",
-           "PrefetchingIter", "MNISTIter", "CSVIter"]
+__all__ = ["DataDesc", "DataBatch", "StackedDataBatch", "DataIter",
+           "NDArrayIter", "ResizeIter", "PrefetchingIter", "MNISTIter",
+           "CSVIter"]
 
 
 def _instrumented_next(next_fn):
@@ -81,6 +82,34 @@ class DataBatch:
         self.bucket_key = bucket_key
         self.provide_data = provide_data
         self.provide_label = provide_label
+
+
+class StackedDataBatch(DataBatch):
+    """K consecutive batches stacked on a new leading axis — one
+    scan-dispatch window for ``Module.fit(steps_per_dispatch=K)``.
+
+    ``data``/``label`` hold arrays of shape ``(steps, batch, ...)``;
+    ``pads`` keeps the per-step pad values. ``split()`` recovers
+    per-step ``DataBatch`` views (the single-step fallback path for
+    partial tail windows).
+    """
+
+    def __init__(self, data, label=None, pads=None, index=None):
+        steps = int(data[0].shape[0])
+        pads = list(pads) if pads is not None else [0] * steps
+        super().__init__(data, label, pad=pads[-1] if pads else 0,
+                         index=index)
+        self.steps = steps
+        self.pads = pads
+
+    def split(self):
+        out = []
+        for k in range(self.steps):
+            out.append(DataBatch(
+                [NDArray(d.asjax()[k]) for d in self.data],
+                [NDArray(l.asjax()[k]) for l in (self.label or [])],
+                pad=self.pads[k] if k < len(self.pads) else 0))
+        return out
 
 
 class DataIter:
@@ -194,6 +223,7 @@ class PrefetchingIter(DataIter):
         # thread lands each batch in HBM while the consumer computes on
         # the previous one, so the train step never waits on the copy
         self._device = device
+        self._stack_k = 1      # >1: producer stacks K-batch scan windows
         self.batch_size = self.provide_data[0].shape[0]
         self._queue = _queue.Queue(maxsize=2)
         self._stop = threading.Event()
@@ -229,19 +259,87 @@ class PrefetchingIter(DataIter):
             self._device = device
         return self
 
+    def stack_windows(self, k, device=None):
+        """Producer-side K-batch stacking for scan-fused training.
+
+        With ``k > 1`` the background thread groups every ``k``
+        consecutive batches into one :class:`StackedDataBatch` (leading
+        axis = step) and — when a device is set — lands the stacked
+        buffers in device memory off-thread, so ``Module.fit``'s K-step
+        scan dispatch consumes HBM-resident windows without a per-batch
+        host round trip. A short tail yields a partial window
+        (``steps < k``). ``k=1`` restores per-batch mode. Returns self.
+        """
+        if device is not None:
+            self._device = device
+        k = max(1, int(k))
+        if k != self._stack_k:
+            self._stack_k = k
+            self.reset()       # restart the producer in the new mode
+        return self
+
+    def _merge(self, batches):
+        """Merge one batch from each inner iter (multi-iter fan-in)."""
+        return DataBatch(
+            data=sum([b.data for b in batches], []),
+            label=sum([(b.label or []) for b in batches], []),
+            pad=batches[0].pad, index=batches[0].index)
+
+    def _stack(self, window):
+        """Stack K merged batches into one StackedDataBatch, staged onto
+        the configured device (the off-thread H2D copy)."""
+        import jax
+        import jax.numpy as jnp
+        dev = None
+        if self._device is not None:
+            dev = self._device.jax_device() if hasattr(
+                self._device, "jax_device") else self._device
+
+        def put(slot_arrays):
+            arr = jnp.stack([a.asjax() if isinstance(a, NDArray)
+                             else jnp.asarray(np.asarray(a))
+                             for a in slot_arrays])
+            if dev is not None:
+                arr = jax.device_put(arr, dev)
+            return NDArray(arr)
+
+        data = [put([b.data[i] for b in window])
+                for i in range(len(window[0].data))]
+        label = [put([b.label[i] for b in window])
+                 for i in range(len(window[0].label or []))]
+        return StackedDataBatch(data, label,
+                                pads=[b.pad or 0 for b in window],
+                                index=window[0].index)
+
     def _producer(self):
         while not self._stop.is_set():
             try:
-                batches = [i.next() for i in self.iters]
-                if self._device is not None:
-                    batches = [self._to_device(b) for b in batches]
+                k = self._stack_k
+                if k <= 1:
+                    batches = [i.next() for i in self.iters]
+                    if self._device is not None:
+                        batches = [self._to_device(b) for b in batches]
+                    self._queue.put(batches)
+                    continue
+                window, exhausted = [], False
+                for _ in range(k):
+                    try:
+                        window.append(
+                            self._merge([i.next() for i in self.iters]))
+                    except StopIteration:
+                        exhausted = True
+                        break
+                if window:
+                    self._queue.put(self._stack(window))
+                if exhausted:
+                    self._queue.put(None)
+                    return
             except StopIteration:
                 self._queue.put(None)
                 return
             except BaseException as exc:  # surface in the consumer, don't
                 self._queue.put(("__error__", exc))  # die into a hang
                 return
-            self._queue.put(batches)
 
     def _to_device(self, batch):
         import jax
@@ -284,10 +382,9 @@ class PrefetchingIter(DataIter):
         if isinstance(batches, tuple) and batches and \
                 batches[0] == "__error__":
             raise batches[1]
-        data = sum([b.data for b in batches], [])
-        label = sum([(b.label or []) for b in batches], [])
-        return DataBatch(data=data, label=label, pad=batches[0].pad,
-                         index=batches[0].index)
+        if isinstance(batches, StackedDataBatch):   # stack_windows mode
+            return batches
+        return self._merge(batches)
 
 
 def _init_data(data, allow_empty, default_name):

@@ -10,6 +10,7 @@ abstract surface at the bottom.
 from __future__ import annotations
 
 import logging
+import os
 import time
 
 from .. import metric as metric_mod
@@ -78,8 +79,18 @@ class BaseModule:
         self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
                             optimizer_params=optimizer_params)
 
+    def _scan_window_size(self):
+        """Batches advanced per device dispatch by the fit loop; 1 means
+        the plain per-batch loop. Module overrides this with the K-step
+        scan-fused arrangement (module.fit steps_per_dispatch)."""
+        return 1
+
     def _fit_epoch(self, epoch, train_data, eval_metric, batch_end_callback,
                    monitor):
+        K = self._scan_window_size()
+        if K > 1 and monitor is None:
+            return self._fit_epoch_scan(epoch, train_data, eval_metric,
+                                        batch_end_callback, K)
         for nbatch, batch in enumerate(train_data):
             if monitor is not None:
                 monitor.tic()
@@ -113,6 +124,86 @@ class BaseModule:
                                     eval_metric=eval_metric,
                                     locals=locals()))
 
+    def _fit_epoch_scan(self, epoch, train_data, eval_metric,
+                        batch_end_callback, K):
+        """Windowed epoch: K batches per device dispatch via the scan-
+        fused program. Metrics, telemetry and callbacks still advance
+        per logical batch — the per-step counts/outputs come back
+        stacked from the one dispatch. Partial tail windows (and any
+        window the scan can't take) fall back to single fused steps."""
+        from ..io import StackedDataBatch
+        nbatch = 0
+        batch_size = getattr(train_data, "batch_size", 0)
+
+        def run_single(batch):
+            nonlocal nbatch
+            t0 = time.perf_counter_ns()
+            batch_span = _telemetry.span(
+                "module.fit.batch", _hist="module.fit.batch.seconds",
+                epoch=epoch, nbatch=nbatch)
+            with batch_span:
+                self.forward_backward(batch)
+                self.update()
+            self._note_batch(epoch, nbatch, batch_span.dur or
+                             (time.perf_counter_ns() - t0) // 1000,
+                             batch_size)
+            self.update_metric(eval_metric, batch.label)
+            if batch_end_callback is not None:
+                _fire(batch_end_callback,
+                      BatchEndParam(epoch=epoch, nbatch=nbatch,
+                                    eval_metric=eval_metric,
+                                    locals=locals()))
+            nbatch += 1
+
+        def run_window(window, steps):
+            nonlocal nbatch
+            t0 = time.perf_counter_ns()
+            win_span = _telemetry.span(
+                "module.fit.window", _hist="module.fit.window.seconds",
+                epoch=epoch, nbatch=nbatch, steps=steps)
+            with win_span:
+                self._run_scan_window(window)
+            dur_us = win_span.dur or (time.perf_counter_ns() - t0) // 1000
+            for _ in range(steps):
+                labels = self._advance_scan_batch()
+                self._note_batch(epoch, nbatch, dur_us // steps,
+                                 batch_size)
+                self.update_metric(eval_metric, labels)
+                if batch_end_callback is not None:
+                    _fire(batch_end_callback,
+                          BatchEndParam(epoch=epoch, nbatch=nbatch,
+                                        eval_metric=eval_metric,
+                                        locals=locals()))
+                nbatch += 1
+
+        pending = []
+        for batch in train_data:
+            if isinstance(batch, StackedDataBatch):
+                if batch.steps == K:
+                    run_window(batch, K)
+                else:                       # partial tail window
+                    for b in batch.split():
+                        run_single(b)
+            else:
+                pending.append(batch)
+                if len(pending) == K:
+                    run_window(pending, K)
+                    pending = []
+        for b in pending:                   # partial tail window
+            run_single(b)
+
+    def _note_batch(self, epoch, nbatch, dur_us, batch_size):
+        """Per-logical-batch telemetry shared by both fit loops."""
+        if _telemetry.enabled():
+            _telemetry.counter("module.fit.batches").inc()
+            _telemetry.record_event(
+                "batch_end", epoch=epoch, nbatch=nbatch,
+                duration_us=dur_us, batch_size=batch_size)
+        else:
+            _telemetry.flightrec.note(
+                "module.fit.batch", epoch=epoch, nbatch=nbatch,
+                dur_us=dur_us, batch_size=batch_size)
+
     def fit(self, train_data, eval_data=None, eval_metric="acc",
             epoch_end_callback=None, batch_end_callback=None, kvstore="local",
             optimizer="sgd", optimizer_params=(("learning_rate", 0.01),),
@@ -120,11 +211,22 @@ class BaseModule:
             initializer=None, arg_params=None, aux_params=None,
             allow_missing=False, force_rebind=False, force_init=False,
             begin_epoch=0, num_epoch=None, validation_metric=None,
-            monitor=None):
-        """The training loop (reference base_module.py:368-507 contract)."""
+            monitor=None, steps_per_dispatch=None):
+        """The training loop (reference base_module.py:368-507 contract).
+
+        ``steps_per_dispatch`` (default ``MXNET_STEPS_PER_DISPATCH``,
+        else 1) batches K training steps into ONE device dispatch via a
+        jitted ``lax.scan`` over the fused step — the Python loop, batch
+        load and dict-shuffle then cost 1/K per batch (docs/
+        performance.md). Metrics/callbacks still fire per batch.
+        """
         from ..initializer import Uniform
         if num_epoch is None:
             raise ValueError("fit() needs num_epoch")
+        if steps_per_dispatch is None:
+            steps_per_dispatch = int(
+                os.environ.get("MXNET_STEPS_PER_DISPATCH", "1") or 1)
+        self._steps_per_dispatch = max(1, int(steps_per_dispatch))
         self._prepare_fit(train_data, initializer or Uniform(0.01),
                           arg_params, aux_params, allow_missing,
                           force_rebind, force_init, kvstore, optimizer,
@@ -132,6 +234,18 @@ class BaseModule:
 
         eval_metric = metric_mod.create(eval_metric)
         validation_metric = validation_metric or eval_metric
+
+        # scan-capable fit over a prefetching iterator: have the
+        # producer thread stack K batches per window (and land them in
+        # device memory off-thread on a single-device binding)
+        K = self._scan_window_size()
+        if hasattr(train_data, "stack_windows"):
+            if K > 1:
+                ctxs = getattr(self, "_context", None)
+                dev = ctxs[0] if ctxs and len(ctxs) == 1 else None
+                train_data.stack_windows(K, device=dev)
+            elif getattr(train_data, "_stack_k", 1) > 1:
+                train_data.stack_windows(1)     # scan unavailable: unstack
 
         try:
             self._fit_epochs(train_data, eval_data, eval_metric,

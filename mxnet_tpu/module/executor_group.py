@@ -18,6 +18,7 @@ and the KVStore update paths work unchanged: with one logical executor,
 """
 from __future__ import annotations
 
+import collections
 import logging
 import os
 
@@ -29,6 +30,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..base import MXNetError
 from ..ndarray import NDArray, zeros as nd_zeros
 from ..io import DataDesc
+from .. import program_cache as _progcache
 from .. import telemetry as _telemetry
 
 __all__ = ["DataParallelExecutorGroup"]
@@ -96,10 +98,14 @@ class DataParallelExecutorGroup:
             self._mesh = Mesh(np.array(devices), ("data",))
             self._data_sharding = NamedSharding(self._mesh, P("data"))
             self._repl_sharding = NamedSharding(self._mesh, P())
+            # K-stacked batches: axis 0 is the scan step, batch is axis 1
+            self._stacked_sharding = NamedSharding(self._mesh,
+                                                   P(None, "data"))
         else:
             self._mesh = None
             self._data_sharding = None
             self._repl_sharding = None
+            self._stacked_sharding = None
 
         self.batch_size = data_shapes[0].shape[
             DataDesc.get_batch_axis(data_shapes[0].layout)]
@@ -297,10 +303,29 @@ class DataParallelExecutorGroup:
         # out from under it (measured: "Array has been deleted" in eval
         # paths sharing those arrays). Aux (BN stats) stays undonated for
         # the same reason: eval paths read the same cells mid-epoch.
-        if _telemetry.enabled():
-            _telemetry.counter("executor.jit_cache.miss").inc()
-        self._fused_prog = _telemetry.wrap_dispatch(
-            jax.jit(step, donate_argnums=(0, 4)), "fused_step")
+        self._step_core = step      # pure; the scan program re-uses it
+        self._fused_keep_grads = keep_grads
+        self._fused_cache_key = exe.program_cache_key(
+            "fused_step", tuple(watched), tuple(metric_pairs), keep_grads,
+            optimizer.fused_plan_token())
+        self._fused_prog = None
+        if self._fused_cache_key is not None:
+            self._fused_prog = _progcache.get(self._fused_cache_key)
+        if self._fused_prog is not None:
+            if _telemetry.enabled():
+                _telemetry.counter("executor.jit_cache.hit").inc()
+        else:
+            if _telemetry.enabled():
+                _telemetry.counter("executor.jit_cache.miss").inc()
+            self._fused_prog = _telemetry.wrap_dispatch(
+                jax.jit(step, donate_argnums=(0, 4)), "fused_step")
+            if self._fused_cache_key is not None:
+                _progcache.put(self._fused_cache_key, self._fused_prog)
+        self._scan_prog = None      # K-step lax.scan program (lazy)
+        self._scan_K = 0
+        self._scan_failed = False
+        self._scan_results = collections.deque()
+        self._scan_lrwd = (None, None, None)
         self._fused_watched = watched
         from .. import random as _random
         self._fused_key = _random.next_key()   # device-chained thereafter
@@ -380,6 +405,187 @@ class DataParallelExecutorGroup:
             # buffers hold the arming-time NaN poison, not real values)
             exe._sentinel.check_executor(exe, grads_fresh=grads is not None)
 
+    # ------------------------------------------------- K-step scan dispatch
+    def scan_ready(self, K):
+        """Arm (or confirm) the K-step scan program; False -> the caller
+        stays on the single-step path. Structural refusals: no fused
+        step, MXNET_FUSED_KEEP_GRADS=1 (stacking K gradient sets would
+        multiply the step's memory), or a previous arming failure."""
+        if K <= 1 or getattr(self, "_step_core", None) is None:
+            return False
+        if self._fused_keep_grads or self._scan_failed:
+            return False
+        if self._scan_K == K and self._scan_prog is not None:
+            return True
+        try:
+            self._arm_scan(K)
+            return True
+        except Exception as exc:
+            self.logger.warning(
+                "K-step scan arming failed (%s); staying single-step", exc)
+            self._scan_failed = True
+            return False
+
+    def _arm_scan(self, K):
+        """Build (or fetch from the program cache) the jitted program
+        running K fused steps inside one ``lax.scan`` — ONE host→device
+        dispatch per K batches. Params / optimizer states / rng key ride
+        the carry (donated); per-step outputs and metric counts come
+        back stacked as ys so metrics and callbacks still see per-batch
+        numbers."""
+        step_core = self._step_core
+
+        def scan_fn(w, states, key, aux_vals, rest_static, xs):
+            def body(carry, x):
+                w, states, key, aux = carry
+                rest = dict(rest_static)
+                rest.update(x["in"])
+                (outs, new_aux, new_w, new_states, _grads, key,
+                 mets) = step_core(w, rest, aux, key, states,
+                                   x["lr"], x["wd"])
+                if new_aux:
+                    aux = {**aux, **new_aux}
+                return (new_w, new_states, key, aux), (outs, mets)
+
+            (w, states, key, aux), (outs_s, mets_s) = jax.lax.scan(
+                body, (w, states, key, aux_vals), xs)
+            return w, states, key, aux, outs_s, mets_s
+
+        gkey = None
+        if self._fused_cache_key is not None:
+            gkey = self._fused_cache_key + ("scan", K)
+            fn = _progcache.get(gkey)
+            if fn is not None:
+                if _telemetry.enabled():
+                    _telemetry.counter("executor.jit_cache.hit").inc()
+                self._scan_prog, self._scan_K = fn, K
+                return
+        if _telemetry.enabled():
+            _telemetry.counter("executor.jit_cache.miss").inc()
+        fn = _telemetry.wrap_dispatch(
+            jax.jit(scan_fn, donate_argnums=(0, 1, 2)), "scan_step")
+        if gkey is not None:
+            _progcache.put(gkey, fn)
+        self._scan_prog, self._scan_K = fn, K
+
+    def _place_stacked(self, arr):
+        """Device-place a (K, batch, ...) stacked array: the scan axis
+        stays unsharded, the batch axis shards over the mesh."""
+        if self._mesh is None:
+            return jax.device_put(arr, self.contexts[0].jax_device())
+        return jax.device_put(arr, self._stacked_sharding)
+
+    def _stack_window(self, window, K):
+        """Per-step input dict {name: (K, batch, ...)} + per-step label
+        NDArray lists, from either a StackedDataBatch (iterator already
+        stacked, possibly in device memory) or a list of K DataBatches."""
+        exe = self.executor
+        xs_in = {}
+        if hasattr(window, "steps"):            # StackedDataBatch
+            slots = list(zip(self.data_names, window.data)) + \
+                list(zip(self.label_names, window.label or []))
+            labels_per_step = [
+                [NDArray(l.asjax()[k]) for l in (window.label or [])]
+                for k in range(K)]
+        else:                                   # list of K DataBatches
+            slots = []
+            for i, name in enumerate(self.data_names):
+                slots.append((name, [b.data[i] for b in window]))
+            n_lab = min(len(b.label or []) for b in window)
+            for i, name in enumerate(self.label_names[:n_lab]):
+                slots.append((name, [b.label[i] for b in window]))
+            labels_per_step = [list(b.label or []) for b in window]
+        for name, val in slots:
+            dst = exe.arg_dict.get(name)
+            if dst is None:
+                continue
+            if isinstance(val, list):
+                val = jnp.stack([v.asjax() if isinstance(v, NDArray)
+                                 else jnp.asarray(np.asarray(v))
+                                 for v in val])
+            else:
+                val = val.asjax() if isinstance(val, NDArray) \
+                    else jnp.asarray(np.asarray(val))
+            xs_in[name] = self._place_stacked(val.astype(dst.dtype))
+        return xs_in, labels_per_step
+
+    def scan_step(self, window, lrs_list, wds_list):
+        """Run K fused train steps in ONE dispatch; swap the advanced
+        params/states/aux/rng in, and queue per-step outputs + metric
+        counts for ``advance_scan_step`` so the fit loop can still do
+        per-batch bookkeeping."""
+        from .. import random as _random
+        exe = self.executor
+        K = len(lrs_list)
+        if not self.scan_ready(K):
+            raise MXNetError("scan_step called without an armed scan "
+                             "program (call scan_ready(K) first)")
+        if self._fused_rng_gen != _random.generation():
+            # mx.random.seed() since the last dispatch: re-draw the
+            # device chain at the window boundary (same rule as
+            # fused_step, at window granularity)
+            self._fused_key = _random.next_key()
+            self._fused_rng_gen = _random.generation()
+        xs_in, labels_per_step = self._stack_window(window, K)
+
+        # lr/wd as ONE stacked (K, n_watched) device array per side,
+        # cached by value — zero transfers per window on fixed schedules
+        lrwd_key = (tuple(tuple(l[nm] for nm in self._fused_watched)
+                          for l in lrs_list),
+                    tuple(tuple(w[nm] for nm in self._fused_watched)
+                          for w in wds_list))
+        if self._scan_lrwd[0] != lrwd_key:
+            self._scan_lrwd = (
+                lrwd_key, jnp.asarray(lrwd_key[0], jnp.float32),
+                jnp.asarray(lrwd_key[1], jnp.float32))
+        _, lr_arr, wd_arr = self._scan_lrwd
+
+        arg_vals = exe._arg_vals()
+        w = {nm: arg_vals.pop(nm) for nm in self._fused_watched}
+        rest_static = {nm: v for nm, v in arg_vals.items()
+                       if nm not in xs_in}
+        (new_w, new_states, self._fused_key, new_aux, outs_s,
+         mets_s) = self._scan_prog(
+            w, self._fused_states, self._fused_key, exe._aux_vals(),
+            rest_static, {"in": xs_in, "lr": lr_arr, "wd": wd_arr})
+        self._fused_states = new_states
+        ad = exe.arg_dict
+        for nm in self._fused_watched:
+            ad[nm]._set(new_w[nm])
+        xd = exe.aux_dict
+        for nm, val in new_aux.items():
+            if nm in xd:
+                xd[nm]._set(val)
+        exe._pending = None
+        self._fused_metric_scalars = None
+
+        sizes = [int(np.prod(xs_in[nm].shape[1:])) if nm in xs_in
+                 else int(np.prod(exe.arg_dict[nm].shape))
+                 for (_, nm) in self._fused_metric_pairs]
+        self._scan_results = collections.deque(
+            (k, outs_s,
+             [(mets_s[j][k], sizes[j]) for j in range(len(mets_s))],
+             labels_per_step[k])
+            for k in range(K))
+        if exe._sentinel is not None:
+            # window-granularity tripwire on the final step's outputs
+            # (params already advanced K steps; per-op attribution needs
+            # the staged path, as with the single fused step)
+            exe._outputs = [NDArray(o[K - 1], ctx=self.contexts[0])
+                            for o in outs_s]
+            exe._sentinel.check_executor(exe, grads_fresh=False)
+
+    def advance_scan_step(self):
+        """Expose the next scanned step's outputs/metric counts as if a
+        single fused step had just run; returns that step's labels."""
+        k, outs_s, scalars, labels = self._scan_results.popleft()
+        exe = self.executor
+        exe._outputs = [NDArray(o[k], ctx=self.contexts[0])
+                        for o in outs_s]
+        self._fused_metric_scalars = scalars
+        self._fused_metric_labels = labels
+        return labels
+
     # -------------------------------------------------------------- params
     def set_params(self, arg_params, aux_params):
         """reference: executor_group.py set_params -> copy into the bound
@@ -426,8 +632,11 @@ class DataParallelExecutorGroup:
             is_train = self.for_training
         # any staged execution invalidates fused-step metric scalars so a
         # later update_metric (e.g. an eval pass) can never consume
-        # counts from a previous train batch
+        # counts from a previous train batch; pending scanned steps are
+        # dropped for the same reason
         self._fused_metric_scalars = None
+        if getattr(self, "_scan_results", None):
+            self._scan_results.clear()
         self._load_batch(data_batch)
         self.executor.forward(is_train=is_train)
 

@@ -68,6 +68,7 @@ class Module(BaseModule):
         self._preload_opt_states = None
         self._fused_armed = False
         self._fused_done = False
+        self._steps_per_dispatch = 1
 
     # ------------------------------------------------------------ checkpoint
     @staticmethod
@@ -375,6 +376,37 @@ class Module(BaseModule):
                 state = NDArray(leaves[0])
             self._updater.states[i] = state
         self._fused_armed = False
+
+    # --------------------------------------------------- K-step scan window
+    def _scan_window_size(self):
+        """Batches per dispatch for the scan-fused fit loop (1 = the
+        plain per-batch loop). >1 only when the fused step is armed, no
+        monitor claims per-op taps, and the scan program arms."""
+        K = getattr(self, "_steps_per_dispatch", 1)
+        if K <= 1 or not self._fused_armed or not self.optimizer_initialized:
+            return 1
+        if self._exec_group.executor._monitor_callback is not None:
+            return 1
+        if not self._exec_group.scan_ready(K):
+            return 1
+        return K
+
+    def _run_scan_window(self, window):
+        """Advance K batches in one scan dispatch. lr/wd/update-counts
+        are read per step host-side first (identical scheduler semantics
+        to K single fused steps), then the whole window executes as one
+        XLA program."""
+        K = window.steps if hasattr(window, "steps") else len(window)
+        lrs_list, wds_list = [], []
+        for _ in range(K):
+            lrs, wds = self._fused_lr_wd()
+            lrs_list.append(lrs)
+            wds_list.append(wds)
+        self._exec_group.scan_step(window, lrs_list, wds_list)
+        self._params_dirty = True
+
+    def _advance_scan_batch(self):
+        return self._exec_group.advance_scan_step()
 
     def forward(self, data_batch, is_train=None):
         assert self.binded and self.params_initialized

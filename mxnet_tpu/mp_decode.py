@@ -158,8 +158,8 @@ class MPImageRecordIter(DataIter):
         self.reset()
 
     # ------------------------------------------------------------- protocol
-    def _dispatch_batch(self, seq):
-        """Send one batch's offset shards to the workers."""
+    def _queue_batch(self, outbox):
+        """Stage one batch's offset shards as per-worker orders."""
         start = self._cursor
         idxs = self._order[start:start + self.batch_size]
         if len(idxs) == 0:
@@ -168,27 +168,45 @@ class MPImageRecordIter(DataIter):
         pad = self.batch_size - len(idxs)
         offs = self._offsets[idxs]
         shards = []
-        base_slot = (seq % self._Q)
+        base_slot = (self._seq % self._Q)
+        self._seq += 1
         per = self._slot_imgs
         for wi in range(self._W):
             shard = offs[wi * per:(wi + 1) * per]
             if len(shard) == 0:
                 break
             slot = wi * self._Q + base_slot
+            outbox[wi].append({"slot": slot,
+                               "items": [int(o) for o in shard]})
+            self._pending[wi].append(slot)
+            shards.append((wi, slot, len(shard)))
+        self._inflight.append((pad, shards))
+        return True
+
+    def _dispatch_batches(self, n):
+        """Dispatch up to n batches' decode work, chunked into at most
+        ONE stdin write per worker — the json-encode + pipe-syscall cost
+        is paid per chunk, not per batch (the priming path covers all Q
+        double-buffer slots in a single message per worker)."""
+        outbox = [[] for _ in range(self._W)]
+        count = 0
+        for _ in range(n):
+            if not self._queue_batch(outbox):
+                break
+            count += 1
+        for wi, orders in enumerate(outbox):
+            if not orders:
+                continue
+            msg = orders[0] if len(orders) == 1 else {"orders": orders}
             try:
-                self._procs[wi].stdin.write(json.dumps(
-                    {"slot": slot,
-                     "items": [int(o) for o in shard]}) + "\n")
+                self._procs[wi].stdin.write(json.dumps(msg) + "\n")
                 self._procs[wi].stdin.flush()
             except (BrokenPipeError, OSError):
                 raise MXNetError(
                     f"decode worker {wi} died "
                     f"(rc={self._procs[wi].poll()}): "
                     f"{self._worker_stderr(wi)}")
-            self._pending[wi].append(slot)
-            shards.append((wi, slot, len(shard)))
-        self._inflight.append((pad, shards))
-        return True
+        return count
 
     def _collect_batch(self):
         if not self._inflight:
@@ -270,14 +288,12 @@ class MPImageRecordIter(DataIter):
         self._epoch += 1
         self._cursor = 0
         self._seq = 0
-        for _ in range(self._Q):          # prime the pipeline
-            if self._dispatch_batch(self._seq):
-                self._seq += 1
+        self._dispatch_batches(self._Q)   # prime: one chunked message
+                                          # per worker covers all slots
 
     def next(self):
         data, labels, pad = self._collect_batch()
-        if self._dispatch_batch(self._seq):
-            self._seq += 1
+        self._dispatch_batches(1)
         lab = labels[:, 0] if self.label_width == 1 else labels
         return DataBatch([array(data)], [array(lab)], pad=pad)
 

@@ -88,6 +88,15 @@ class Optimizer:
         """
         return None
 
+    def fused_plan_token(self):
+        """Hashable token identifying the traced structure AND baked-in
+        constants of ``fused_plan``'s closures — the program-cache key
+        component for fused/scan train programs (program_cache.py).
+        Subclasses with a fused_plan must extend this with every
+        hyperparameter their update closure captures by value."""
+        return (type(self).__name__, float(self.rescale_grad),
+                float(self.clip_gradient) if self.clip_gradient else -1.0)
+
     def _fused_grad_prep(self):
         """Shared grad preprocessing closure for fused_plan impls."""
         import jax.numpy as jnp
@@ -216,6 +225,9 @@ class SGD(Optimizer):
             return w - lr * g, ()
         return init_state, update
 
+    def fused_plan_token(self):
+        return super().fused_plan_token() + (float(self.momentum),)
+
 
 @register
 class DCASGD(Optimizer):
@@ -339,6 +351,10 @@ class Adam(Optimizer):
             new_w = w - lr * new_mean / (jnp.sqrt(new_var) + eps)
             return new_w, (new_mean, new_var)
         return init_state, update
+
+    def fused_plan_token(self):
+        return super().fused_plan_token() + (
+            float(self.beta1), float(self.beta2), float(self.epsilon))
 
     def fused_lr_scale(self, t):
         """Per-step lr multiplier (bias correction) for the fused path."""

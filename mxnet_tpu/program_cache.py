@@ -1,0 +1,105 @@
+"""Process-wide compiled-program cache.
+
+The reference amortizes graph-init cost per executor: once a
+GraphExecutor is bound, its cached engine segments persist for the
+executor's lifetime (reference: graph_executor.cc:333-446). Here the
+analogous artifact is a jitted XLA program — and a *per-instance* cache
+(the original ``Executor._jit_cache``) re-traces and re-compiles on
+every rebind: train→eval module pairs, ``force_rebind``, ``reshape``,
+and each BucketingModule bucket bound over a ``shared_group`` all paid
+a full trace+compile for programs the process had already built.
+
+This module is the process-wide home for those programs. Keys capture
+everything that determines the traced computation:
+
+  (symbol signature hash, bound arg/aux shapes+dtypes, ctx kind,
+   layout flag, compute_dtype, remat segments) + (kind, kind-extras)
+
+where ``kind`` is one of ``fwd_infer`` / ``fwd_train`` / ``fwd_bwd`` /
+``fused_step`` / ``scan`` and the extras carry what only that kind
+depends on (the watched-param set for gradient programs; the optimizer's
+``fused_plan_token()`` and the scan length K for the fused/scan train
+steps). Anything the key cannot capture — model-parallel plans, monitor
+taps, the NaiveEngine debug mode — is simply not cached here and keeps
+its per-executor lifecycle.
+
+The cache is a bounded LRU (``MXNET_PROGRAM_CACHE_SIZE``, default 64
+programs); eviction drops the jitted callable and with it XLA's
+compiled executable. The ``executor.jit_cache.hit``/``.miss`` telemetry
+counters account lookups (per-instance and process-wide hits count the
+same — both mean "no new compile") and the
+``executor.jit_cache.programs_live`` gauge tracks residency.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import threading
+from collections import OrderedDict
+
+from .telemetry import metrics as _metrics
+
+__all__ = ["symbol_signature", "get", "put", "clear", "size"]
+
+_lock = threading.Lock()
+_cache = OrderedDict()        # key tuple -> program callable
+
+
+def _capacity():
+    try:
+        return max(1, int(os.environ.get("MXNET_PROGRAM_CACHE_SIZE", "64")))
+    except ValueError:
+        return 64
+
+
+def _note_size_locked():
+    _metrics.gauge("executor.jit_cache.programs_live").set(len(_cache))
+
+
+def symbol_signature(symbol):
+    """Stable structural hash of a Symbol graph (sha1 of its json).
+
+    Memoized on the symbol object: the json walk is O(graph) and bind
+    paths (bucketing, rebinding) hash the same symbol repeatedly.
+    """
+    sig = getattr(symbol, "_prog_cache_sig", None)
+    if sig is None:
+        sig = hashlib.sha1(symbol.tojson().encode("utf-8")).hexdigest()
+        try:
+            symbol._prog_cache_sig = sig
+        except AttributeError:
+            pass
+    return sig
+
+
+def get(key):
+    """Cached program for ``key`` or None; refreshes LRU recency."""
+    with _lock:
+        fn = _cache.get(key)
+        if fn is not None:
+            _cache.move_to_end(key)
+        return fn
+
+
+def put(key, fn):
+    """Insert a program, evicting least-recently-used beyond capacity."""
+    cap = _capacity()
+    with _lock:
+        _cache[key] = fn
+        _cache.move_to_end(key)
+        while len(_cache) > cap:
+            _cache.popitem(last=False)
+        _note_size_locked()
+    return fn
+
+
+def clear():
+    """Drop every cached program (tests; frees compiled executables)."""
+    with _lock:
+        _cache.clear()
+        _note_size_locked()
+
+
+def size():
+    with _lock:
+        return len(_cache)

@@ -178,6 +178,10 @@ def wrap_dispatch(fn, kind, compiled=True):
     reference's graph-init segment in its profile) and every later one as
     ``executor.run``. Uncompiled programs (NaiveEngine) always report
     ``executor.run``. Disabled telemetry costs one extra frame + branch.
+
+    Every call additionally bumps the untagged ``executor.dispatch``
+    counter — the per-step host→device submission count that the K-step
+    scan dispatch amortizes (benchmarks/step_overhead.py reads it).
     """
     state = {"first": compiled}
 
@@ -198,6 +202,7 @@ def wrap_dispatch(fn, kind, compiled=True):
             return fn(*args)
         name = "executor.compile" if first else "executor.run"
         from .metrics import counter
+        counter("executor.dispatch").inc()
         counter(name + ".calls", kind=kind).inc()
         with Span(name, {"kind": kind}, hist=name + ".seconds"):
             return fn(*args)

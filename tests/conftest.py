@@ -45,3 +45,7 @@ def _seed():
     np.random.seed(0)
     import mxnet_tpu as mx
     mx.random.seed(0)
+    # process-wide program cache: cleared per test so compile/hit/miss
+    # counter assertions stay deterministic regardless of test order
+    # (tests exercising cross-bind reuse re-populate it themselves)
+    mx.program_cache.clear()

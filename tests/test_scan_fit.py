@@ -1,0 +1,182 @@
+"""K-step scan-fused training (Module.fit steps_per_dispatch).
+
+One jitted ``lax.scan`` program advances K batches per device dispatch
+(ISSUE 3 tentpole): params/optimizer-state/rng ride the donated carry,
+per-step outputs + metric counts come back stacked, partial tail
+windows fall back to single fused steps. These tests pin (a) numerical
+equivalence against K single fused steps — including a mid-run
+``mx.random.seed()`` and a partial tail — and (b) the dispatch-count
+contract counted via ``telemetry.wrap_dispatch``.
+"""
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def _dropout_mlp():
+    # dropout makes the rng chain part of the numerics, so key handling
+    # differences between the scan carry and per-step splits would show
+    data = mx.sym.var("data")
+    fc = mx.sym.FullyConnected(data=data, num_hidden=8, name="fc1")
+    act = mx.sym.Activation(fc, act_type="relu")
+    drop = mx.sym.Dropout(act, p=0.3)
+    fc2 = mx.sym.FullyConnected(drop, num_hidden=3, name="fc2")
+    return mx.sym.SoftmaxOutput(fc2, name="softmax")
+
+
+def _init_args(rs):
+    return {
+        "fc1_weight": mx.nd.array(rs.randn(8, 6).astype(np.float32) * 0.1),
+        "fc1_bias": mx.nd.array(np.zeros(8, np.float32)),
+        "fc2_weight": mx.nd.array(rs.randn(3, 8).astype(np.float32) * 0.1),
+        "fc2_bias": mx.nd.array(np.zeros(3, np.float32)),
+    }
+
+
+def _fit(K, n_batches=10, batch=4, reseed_at=3, prefetch=False):
+    """Fit one epoch at the given steps_per_dispatch; returns params,
+    fused optimizer states, and the per-batch metric trajectory."""
+    rs = np.random.RandomState(0)
+    X = rs.rand(n_batches * batch, 6).astype(np.float32)
+    y = rs.randint(0, 3, (n_batches * batch,)).astype(np.float32)
+    init = _init_args(np.random.RandomState(1))
+
+    mx.random.seed(7)
+    it = mx.io.NDArrayIter(X, y, batch_size=batch)
+    if prefetch:
+        it = mx.io.PrefetchingIter(it)
+    mod = mx.mod.Module(_dropout_mlp(), context=mx.cpu())
+    accs = []
+
+    def cb(param):
+        if param.nbatch == reseed_at:
+            # mid-run re-seed at a step boundary: both arrangements must
+            # re-draw the device rng chain at the next dispatch
+            mx.random.seed(1234)
+        accs.append(param.eval_metric.get()[1])
+
+    mod.fit(it, num_epoch=1, steps_per_dispatch=K, batch_end_callback=cb,
+            arg_params={k: v.copy() for k, v in init.items()},
+            optimizer_params=(("learning_rate", 0.1), ("momentum", 0.9)))
+    args, _ = mod.get_params()
+    states = {k: np.asarray(v)
+              for k, v in mod._exec_group._fused_states.items()}
+    return ({k: v.asnumpy() for k, v in args.items()}, states, accs)
+
+
+def test_scan_k4_matches_single_steps():
+    """K=4 over 10 batches = two scan windows + a 2-batch tail (single
+    fused steps), with a reseed after batch 3: params, optimizer state
+    and per-batch metric values must match K=1 to fp tolerance."""
+    p1, s1, a1 = _fit(1)
+    p4, s4, a4 = _fit(4)
+    for k in p1:
+        np.testing.assert_allclose(p1[k], p4[k], rtol=2e-5, atol=2e-6,
+                                   err_msg=k)
+    for k in s1:
+        np.testing.assert_allclose(s1[k], s4[k], rtol=2e-5, atol=2e-6,
+                                   err_msg=k)
+    np.testing.assert_allclose(a1, a4, rtol=1e-12)
+
+
+def test_scan_stacked_prefetch_matches_single_steps():
+    """The PrefetchingIter.stack_windows path (producer-stacked windows
+    landed via the prefetch thread) must reproduce the same numerics."""
+    p1, s1, a1 = _fit(1)
+    p4, s4, a4 = _fit(4, prefetch=True)
+    for k in p1:
+        np.testing.assert_allclose(p1[k], p4[k], rtol=2e-5, atol=2e-6,
+                                   err_msg=k)
+    np.testing.assert_allclose(a1, a4, rtol=1e-12)
+
+
+def test_scan_k8_dispatch_count():
+    """The dispatch-amortization gate: at K=8, a 32-batch fit must issue
+    <= 2 dispatches per 8 batches (it issues exactly 1: 4 total), vs 32
+    at K=1 — counted via telemetry.wrap_dispatch's executor.dispatch."""
+    rs = np.random.RandomState(0)
+    n_batches, batch = 32, 4
+    X = rs.rand(n_batches * batch, 6).astype(np.float32)
+    y = rs.randint(0, 3, (n_batches * batch,)).astype(np.float32)
+
+    def dispatches(K):
+        it = mx.io.NDArrayIter(X, y, batch_size=batch)
+        mod = mx.mod.Module(_dropout_mlp(), context=mx.cpu())
+        mod.bind(it.provide_data, it.provide_label)
+        mod.init_params(arg_params=_init_args(np.random.RandomState(1)))
+        mod.init_optimizer(
+            optimizer_params=(("learning_rate", 0.1), ("momentum", 0.9)))
+        mx.telemetry.reset()
+        mx.telemetry.enable()
+        try:
+            mod.fit(it, num_epoch=1, steps_per_dispatch=K,
+                    optimizer_params=(("learning_rate", 0.1),
+                                      ("momentum", 0.9)))
+        finally:
+            mx.telemetry.disable()
+        snap = mx.telemetry.snapshot()
+        return snap["counters"].get("executor.dispatch", 0)
+
+    d8 = dispatches(8)
+    assert d8 * 8 <= 2 * n_batches, f"{d8} dispatches for {n_batches}"
+    assert d8 <= 8, d8                     # acceptance bound
+    d1 = dispatches(1)
+    assert d1 >= n_batches, d1             # one per batch without scan
+
+
+def test_scan_env_var_default(monkeypatch):
+    """MXNET_STEPS_PER_DISPATCH drives fit's default window size."""
+    monkeypatch.setenv("MXNET_STEPS_PER_DISPATCH", "4")
+    rs = np.random.RandomState(0)
+    X = rs.rand(32, 6).astype(np.float32)
+    y = rs.randint(0, 3, (32,)).astype(np.float32)
+    it = mx.io.NDArrayIter(X, y, batch_size=4)
+    mod = mx.mod.Module(_dropout_mlp(), context=mx.cpu())
+    mod.fit(it, num_epoch=1,
+            optimizer_params=(("learning_rate", 0.1),))
+    assert mod._steps_per_dispatch == 4
+    assert mod._exec_group._scan_K == 4
+
+
+def test_stacked_databatch_split_roundtrip():
+    """split() recovers the per-step batches a window was stacked from
+    (the partial-tail fallback path)."""
+    rs = np.random.RandomState(3)
+    batches = [mx.io.DataBatch([mx.nd.array(rs.rand(4, 6))],
+                               [mx.nd.array(rs.rand(4))], pad=p)
+               for p in (0, 0, 2)]
+    import jax.numpy as jnp
+    stacked = mx.io.StackedDataBatch(
+        [mx.nd.NDArray(jnp.stack([b.data[0].asjax() for b in batches]))],
+        [mx.nd.NDArray(jnp.stack([b.label[0].asjax() for b in batches]))],
+        pads=[b.pad for b in batches])
+    assert stacked.steps == 3
+    parts = stacked.split()
+    assert [p.pad for p in parts] == [0, 0, 2]
+    for orig, part in zip(batches, parts):
+        np.testing.assert_array_equal(orig.data[0].asnumpy(),
+                                      part.data[0].asnumpy())
+        np.testing.assert_array_equal(orig.label[0].asnumpy(),
+                                      part.label[0].asnumpy())
+
+
+def test_prefetch_stack_windows_shapes():
+    """stack_windows(K) yields (K, batch, ...) windows plus a partial
+    tail window, covering the dataset exactly once."""
+    rs = np.random.RandomState(0)
+    X = rs.rand(40, 6).astype(np.float32)     # 10 batches of 4
+    y = rs.randint(0, 3, (40,)).astype(np.float32)
+    it = mx.io.PrefetchingIter(mx.io.NDArrayIter(X, y, batch_size=4))
+    it.stack_windows(4)
+    seen = []
+    for w in it:
+        assert isinstance(w, mx.io.StackedDataBatch)
+        assert w.data[0].shape[1:] == (4, 6)
+        seen.append(w.steps)
+    assert seen == [4, 4, 2]
+    it.reset()                                # epoch 2 identical
+    assert [w.steps for w in it] == [4, 4, 2]
+    it.stack_windows(1)                       # back to per-batch mode
+    batches = list(it)
+    assert len(batches) == 10
+    assert not isinstance(batches[0], mx.io.StackedDataBatch)
