@@ -31,6 +31,7 @@ from .base import MXNetError
 from .ndarray import NDArray, zeros
 from . import optimizer as opt
 from . import telemetry as _telemetry
+from .kvstore_sched import BucketScheduler
 
 __all__ = ["KVStore", "create", "init_distributed"]
 
@@ -206,12 +207,22 @@ class KVStore:
                     if k not in self._store:
                         raise MXNetError(f"key {k!r} not initialized")
                     if len(vlist) == 1:
-                        merged = vlist[0].copy()
+                        acc = vlist[0].asjax()
                     else:
                         acc = vlist[0].asjax()
                         for v in vlist[1:]:
                             acc = acc + v.asjax()
-                        merged = NDArray(acc, ctx=vlist[0].context)
+                    # colocate the merged value with the store replica:
+                    # a mesh-replicated gradient pushed into a single-
+                    # device store (multi-device Module + device store)
+                    # would otherwise hand the updater incompatible
+                    # placements
+                    store_sharding = self._store[k].asjax().sharding
+                    if acc.sharding != store_sharding:
+                        acc = jax.device_put(acc, store_sharding)
+                    elif len(vlist) == 1:
+                        acc = jnp.array(acc, copy=True)
+                    merged = NDArray(acc, ctx=vlist[0].context)
                     if self._updater is not None:
                         self._updater(k, merged, self._store[k])
                     else:
@@ -221,8 +232,15 @@ class KVStore:
             raise
 
     def pull(self, key, out=None, priority=0):
-        """Broadcast stored value into out arrays."""
+        """Broadcast stored values into out arrays.
+
+        All destinations of the call are placed through ONE batched
+        ``jax.device_put`` (a pytree of sources against a pytree of
+        shardings) instead of one transfer per key — through a
+        remote-chip tunnel each ``device_put`` is its own RPC, so a
+        100-param pull was 100 round trips."""
         assert out is not None
+        self._flush_pending()
         keys, outs = _ctype_key_value(key, out)
         if _telemetry.enabled():
             nbytes = _payload_bytes(outs)
@@ -235,6 +253,7 @@ class KVStore:
             _telemetry.flightrec.note("kvstore.pull", keys=len(keys))
         try:
             with pull_span:
+                srcs, shardings, targets = [], [], []
                 for k, olist in zip(keys, outs):
                     if k not in self._store:
                         raise MXNetError(f"key {k!r} not initialized")
@@ -242,11 +261,22 @@ class KVStore:
                     for o in olist:
                         # land the value in the destination's existing
                         # placement (keeps mesh-sharded arrays sharded)
-                        o._set(jax.device_put(src.asjax(),
-                                              o.asjax().sharding))
+                        srcs.append(src.asjax())
+                        shardings.append(o.asjax().sharding)
+                        targets.append(o)
+                if srcs:
+                    placed = jax.device_put(srcs, shardings)
+                    for o, val in zip(targets, placed):
+                        o._set(val)
         except Exception as exc:
             _telemetry.flightrec.on_crash(exc, where="kvstore.pull")
             raise
+
+    def _flush_pending(self):
+        """Apply deferred pushes (dist bucket scheduler); no-op here."""
+
+    def close(self):
+        """Release background resources (dist heartbeats); no-op here."""
 
     # ------------------------------------------------------------ optimizer
     def set_optimizer(self, optimizer):
@@ -269,6 +299,7 @@ class KVStore:
     def save_optimizer_states(self, fname):
         if self._updater is None:
             raise MXNetError("no updater/optimizer set")
+        self._flush_pending()       # states must reflect every push
         states = {k: v.asnumpy() if isinstance(v, NDArray) else v
                   for k, v in getattr(self._updater, "states", {}).items()}
         with open(fname, "wb") as fout:
@@ -298,6 +329,15 @@ class KVStoreDistSync(KVStore):
     default 64 MiB) and all-reduces each bucket as ONE jitted XLA program —
     the analog of the reference batching gradients into its pinned merge
     buffers (comm.h InitMergeBuffer).
+
+    Buckets run through a ready-order scheduler (kvstore_sched.py):
+    ``push`` only *stages* gradients — in priority order, reverse
+    execution order for Module's grads — and each bucket's collective
+    dispatches asynchronously the moment the bucket fills, pipelining
+    behind backward compute and each other. The host blocks (and the
+    updater runs) only at ``pull``/barrier/state reads. Set
+    ``MXNET_KVSTORE_OVERLAP=0`` to apply every push synchronously (the
+    pre-overlap serial behavior).
     """
 
     _HB_PREFIX = "mxnet_kvstore_heartbeat/"
@@ -308,7 +348,13 @@ class KVStoreDistSync(KVStore):
         self._nproc = jax.process_count()
         self._mesh = None
         self._sum_jit = None
+        self._sum_jit_shapes = set()     # (dtype, padded-len) size classes
         self._hb_stop = None
+        self._hb_thread = None
+        self._sched = BucketScheduler(
+            self._allreduce_flat, self._apply_reduced,
+            lambda: int(os.environ.get("MXNET_KVSTORE_BUCKET_BYTES",
+                                       64 << 20)))
         if self._nproc > 1:
             client = _coordination_client()
             if client is not None and not hasattr(client,
@@ -343,9 +389,30 @@ class KVStoreDistSync(KVStore):
             while not stop.wait(period):
                 beat()
 
-        threading.Thread(target=loop, daemon=True,
-                         name="mxnet-kvstore-heartbeat").start()
+        thread = threading.Thread(target=loop, daemon=True,
+                                  name="mxnet-kvstore-heartbeat")
+        thread.start()
         self._hb_stop = stop
+        self._hb_thread = thread
+
+    def close(self):
+        """Flush pending pushes and stop/join the heartbeat thread so a
+        discarded store can't leak threads across a test suite (or keep
+        beating for a rank that logically left the job)."""
+        self._flush_pending()
+        if self._hb_stop is not None:
+            self._hb_stop.set()
+            if self._hb_thread is not None:
+                self._hb_thread.join(timeout=5)
+            self._hb_stop = None
+            self._hb_thread = None
+
+    def __del__(self):
+        try:
+            if self._hb_stop is not None:
+                self._hb_stop.set()
+        except Exception:
+            pass        # interpreter teardown
 
     @property
     def rank(self):
@@ -384,20 +451,31 @@ class KVStoreDistSync(KVStore):
             out_shardings=NamedSharding(self._mesh,
                                         PartitionSpec("dev")))
 
+    def _size_class(self, n):
+        """Padded length for a flat buffer: the local device count L
+        times the next power of two of ceil(n/L). Tiny/odd gradient
+        lengths then share O(log max-size) padded shapes instead of
+        minting a fresh ``_sum_jit`` trace per unique length."""
+        chunk = max(1, -(-n // self._local))
+        chunk = 1 << (chunk - 1).bit_length()
+        return chunk * self._local
+
     def _allreduce_flat(self, flat):
         """All-reduce one 1-D buffer across all devices of all processes.
 
-        Layout: pad to a multiple of the local device count L, view as
-        (1, L, chunk) sharded (proc, dev), sum over proc with the result
-        sharded over dev; every process then reassembles the full
-        reduced buffer from its own local shards (replicated-across-proc
-        output).
+        Layout: pad to the power-of-two size class (multiple of the
+        local device count L), view as (1, L, chunk) sharded
+        (proc, dev), sum over proc with the result sharded over dev;
+        every process then reassembles the full reduced buffer from its
+        own local shards (replicated-across-proc output). Single-process
+        stores run the same program over the (1, L) mesh — the
+        local-device reduction path is identical, only the proc axis is
+        trivial.
         """
         from jax.experimental import multihost_utils
         self._ensure_mesh()
         if _telemetry.enabled():
             nbytes = int(flat.size) * flat.dtype.itemsize
-            _telemetry.counter("kvstore.allreduce.bytes").inc(nbytes)
             ar_span = _telemetry.span(
                 "kvstore.allreduce", _hist="kvstore.allreduce.seconds",
                 bytes=nbytes)
@@ -405,10 +483,32 @@ class KVStoreDistSync(KVStore):
             ar_span = _telemetry.null_span
         with ar_span:
             n = flat.shape[0]
-            pad = (-n) % self._local
-            if pad:
+            padded = self._size_class(n)
+            if padded != n:
                 flat = jnp.concatenate(
-                    [flat, jnp.zeros((pad,), flat.dtype)])
+                    [flat, jnp.zeros((padded - n,), flat.dtype)])
+            self._sum_jit_shapes.add((str(flat.dtype), padded))
+            _telemetry.gauge("kvstore.allreduce.size_classes").set(
+                len(self._sum_jit_shapes))
+            from jax.sharding import NamedSharding
+            if self._nproc == 1:
+                # single process owns every mesh device: plain resharding
+                # device_puts replace the multihost host-local<->global
+                # conversions, keeping the whole reduction async (no host
+                # sync at dispatch — the overlap window of the bucket
+                # scheduler)
+                x = flat.reshape(1, self._local, -1)
+                glob = jax.device_put(
+                    x, NamedSharding(self._mesh,
+                                     self._pspec("proc", "dev")))
+                return jnp.ravel(self._sum_jit(glob))[:n]
+            # a gradient pushed from a multi-device (mesh-replicated)
+            # executor arrives with >1 local shard; the host-local
+            # conversion below needs ONE process-local array
+            if getattr(flat, "sharding", None) is not None and \
+                    len(flat.sharding.device_set) > 1:
+                flat = jax.device_put(
+                    flat, flat.addressable_shards[0].device)
             x = flat.reshape(1, self._local, -1)
             glob = multihost_utils.host_local_array_to_global_array(
                 x, self._mesh, self._pspec("proc", "dev"))
@@ -416,45 +516,31 @@ class KVStoreDistSync(KVStore):
             loc = multihost_utils.global_array_to_host_local_array(
                 red, self._mesh, self._pspec("dev"))
             out = jnp.ravel(loc)
-            return out[:n] if pad else out
+            return out[:n] if padded != n else out
 
     def _allreduce(self, arrs):
-        """Batched all-reduce: bucket same-dtype arrays into flat buffers
-        up to MXNET_KVSTORE_BUCKET_BYTES, one collective per bucket."""
-        # read at use time like the reference's dmlc::GetEnv tuning knobs
-        bucket_bytes = int(os.environ.get(
-            "MXNET_KVSTORE_BUCKET_BYTES", 64 << 20))
-        out = [None] * len(arrs)
-        by_dtype = {}
-        for i, a in enumerate(arrs):
-            by_dtype.setdefault(jnp.asarray(a).dtype, []).append(i)
-        for dt, idxs in by_dtype.items():
-            bucket, nbytes = [], 0
-            buckets = []
-            for i in idxs:
-                sz = arrs[i].size * dt.itemsize
-                if bucket and nbytes + sz > bucket_bytes:
-                    buckets.append(bucket)
-                    bucket, nbytes = [], 0
-                bucket.append(i)
-                nbytes += sz
-            if bucket:
-                buckets.append(bucket)
-            for bucket in buckets:
-                flat = jnp.concatenate(
-                    [jnp.ravel(arrs[i]) for i in bucket]) if len(bucket) > 1 \
-                    else jnp.ravel(arrs[bucket[0]])
-                red = self._allreduce_flat(flat)
-                off = 0
-                for i in bucket:
-                    n = arrs[i].size
-                    out[i] = red[off:off + n].reshape(arrs[i].shape)
-                    off += n
-        return out
+        """Unbucketed reference path: one collective per array. The hot
+        path is the bucket scheduler (push/_sched); this remains as the
+        equivalence oracle the bucketed path is tested against."""
+        return [self._allreduce_flat(jnp.ravel(jnp.asarray(a.asjax()
+                if isinstance(a, NDArray) else a))).reshape(a.shape)
+                for a in arrs]
 
     # ----------------------------------------------------------------- push
     def push(self, key, value, priority=0):
+        """Stage gradients into the ready-order bucket scheduler.
+
+        ``priority`` may be a scalar (the reference API) or one value
+        per key; higher priorities dispatch earlier. Collectives for
+        full buckets go on the wire inside this call — asynchronously —
+        and the updater runs at the next ``pull``/barrier/state read
+        (immediately under ``MXNET_KVSTORE_OVERLAP=0``)."""
         keys, vals = _ctype_key_value(key, value)
+        prios = list(priority) if isinstance(priority, (list, tuple)) \
+            else [priority] * len(keys)
+        if len(prios) != len(keys):
+            raise MXNetError(
+                f"got {len(prios)} priorities for {len(keys)} keys")
         if _telemetry.enabled():
             nbytes = _payload_bytes(vals)
             _telemetry.counter("kvstore.push.bytes").inc(nbytes)
@@ -465,45 +551,47 @@ class KVStoreDistSync(KVStore):
             push_span = _telemetry.null_span
             _telemetry.flightrec.note("kvstore.push", keys=len(keys),
                                       dist=True)
-        return self._push_reduced(keys, vals, push_span)
-
-    def _push_reduced(self, keys, vals, push_span):
         try:
             with push_span:
-                merged = []
-                for k, vlist in zip(keys, vals):
+                for k, vlist, prio in zip(keys, vals, prios):
                     if k not in self._store:
                         raise MXNetError(f"key {k!r} not initialized")
                     acc = vlist[0].asjax()
                     for v in vlist[1:]:
                         acc = acc + v.asjax()
-                    merged.append((k, vlist[0].context, acc))
-                if self._nproc > 1:
-                    reduced = self._allreduce([a for _, _, a in merged])
-                else:
-                    reduced = [a for _, _, a in merged]
-                for (k, ctx, _), red in zip(merged, reduced):
-                    # The bucketed all-reduce hands back each value
-                    # sharded over the local `dev` mesh axis (bandwidth
-                    # layout). The store replica and its optimizer state
-                    # live wherever the user placed the weight — re-place
-                    # the reduced gradient there so the updater's inputs
-                    # are colocated (the analog of the reference copying
-                    # the merged buffer back to each GPU, comm.h
-                    # Broadcast).
-                    store_sharding = self._store[k].asjax().sharding
-                    if red.sharding != store_sharding:
-                        red = jax.device_put(red, store_sharding)
-                    nd_val = NDArray(red, ctx=ctx)
-                    if self._updater is not None:
-                        self._updater(k, nd_val, self._store[k])
-                    else:
-                        self._store[k]._set(nd_val.asjax())
+                    self._sched.stage(k, vlist[0].context, acc, prio)
+                if os.environ.get("MXNET_KVSTORE_OVERLAP", "1") == "0":
+                    self._sched.flush()
+        except Exception as exc:
+            _telemetry.flightrec.on_crash(exc, where="kvstore.push")
+            raise
+
+    def _apply_reduced(self, k, ctx, red):
+        """Scheduler callback: one key's bucket segment, reduced."""
+        # The bucketed all-reduce hands back each value sharded over the
+        # local `dev` mesh axis (bandwidth layout). The store replica and
+        # its optimizer state live wherever the user placed the weight —
+        # re-place the reduced gradient there so the updater's inputs are
+        # colocated (the analog of the reference copying the merged
+        # buffer back to each GPU, comm.h Broadcast).
+        store_sharding = self._store[k].asjax().sharding
+        if red.sharding != store_sharding:
+            red = jax.device_put(red, store_sharding)
+        nd_val = NDArray(red, ctx=ctx)
+        if self._updater is not None:
+            self._updater(k, nd_val, self._store[k])
+        else:
+            self._store[k]._set(nd_val.asjax())
+
+    def _flush_pending(self):
+        try:
+            self._sched.flush()
         except Exception as exc:
             _telemetry.flightrec.on_crash(exc, where="kvstore.push")
             raise
 
     def _barrier(self):
+        self._flush_pending()
         if self._nproc > 1:
             from jax.experimental import multihost_utils
             multihost_utils.sync_global_devices("kvstore_barrier")

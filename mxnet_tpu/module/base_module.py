@@ -211,7 +211,7 @@ class BaseModule:
             initializer=None, arg_params=None, aux_params=None,
             allow_missing=False, force_rebind=False, force_init=False,
             begin_epoch=0, num_epoch=None, validation_metric=None,
-            monitor=None, steps_per_dispatch=None):
+            monitor=None, steps_per_dispatch=None, zero_stage=None):
         """The training loop (reference base_module.py:368-507 contract).
 
         ``steps_per_dispatch`` (default ``MXNET_STEPS_PER_DISPATCH``,
@@ -219,6 +219,13 @@ class BaseModule:
         jitted ``lax.scan`` over the fused step — the Python loop, batch
         load and dict-shuffle then cost 1/K per batch (docs/
         performance.md). Metrics/callbacks still fire per batch.
+
+        ``zero_stage`` (default ``MXNET_ZERO_STAGE``, else 0): 1 selects
+        ZeRO stage-1 sharded optimizer updates on a multi-device
+        binding — gradients reduce-scatter inside the fused program,
+        each device updates its 1/N parameter shard with 1/N of the
+        optimizer state, updated params all-gather back
+        (docs/performance.md). Numerically identical to stage 0.
         """
         from ..initializer import Uniform
         if num_epoch is None:
@@ -227,6 +234,8 @@ class BaseModule:
             steps_per_dispatch = int(
                 os.environ.get("MXNET_STEPS_PER_DISPATCH", "1") or 1)
         self._steps_per_dispatch = max(1, int(steps_per_dispatch))
+        if zero_stage is not None:
+            self._zero_stage = int(zero_stage)
         self._prepare_fit(train_data, initializer or Uniform(0.01),
                           arg_params, aux_params, allow_missing,
                           force_rebind, force_init, kvstore, optimizer,

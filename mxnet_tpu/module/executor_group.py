@@ -51,6 +51,7 @@ class DataParallelExecutorGroup:
         self.fixed_param_names = fixed_param_names or []
         self.state_names = state_names or []
         self.param_names = param_names
+        self._zero_plan = None          # set by setup_fused_step
 
         self.arg_names = symbol.list_arguments()
         self.aux_names = symbol.list_auxiliary_states()
@@ -187,16 +188,23 @@ class DataParallelExecutorGroup:
                              if name in self.executor.arg_dict]
 
     # ------------------------------------------------------- fused training
-    def setup_fused_step(self, optimizer):
+    def setup_fused_step(self, optimizer, zero_stage=0):
         """Compile forward+backward+optimizer-update into ONE jitted XLA
         program (the TPU-native analog of the reference's bulk train
         segment, graph_executor.cc:678-756, plus its fused update ops).
+
+        ``zero_stage=1`` selects the in-program reduce-scatter comm plan
+        (parallel/zero.py) on a multi-device mesh: gradients arrive
+        shard-wise, the update runs on 1/N flat shards with sharded
+        optimizer state, and the new params all-gather back — otherwise
+        the replicated (all-reduce) plan runs unchanged.
 
         Per-batch work then becomes: slice batch -> async device_put ->
         one XLA dispatch -> buffer swaps. Returns False when the
         optimizer or binding can't express it (imperative path remains).
         """
         from ..executor import naive_engine_active
+        self._zero_plan = None
         plan = optimizer.fused_plan()
         if plan is None or not self.for_training or self.inputs_need_grad:
             return False
@@ -213,6 +221,21 @@ class DataParallelExecutorGroup:
                    if self.grad_req.get(nm) == "write"]
         if not watched:
             return False
+
+        # comm plan: in-program reduce-scatter + sharded update (ZeRO-1)
+        # needs a data mesh and an elementwise update; anything else
+        # keeps the replicated all-reduce plan
+        if (zero_stage and self._mesh is not None
+                and getattr(optimizer, "fused_update_elementwise", False)):
+            from ..parallel.zero import ZeroPlan
+            self._zero_plan = ZeroPlan(self._mesh, "data")
+        elif zero_stage:
+            self.logger.info(
+                "zero_stage=%s requested but unavailable (mesh=%s, "
+                "elementwise=%s); using the replicated update plan",
+                zero_stage, self._mesh is not None,
+                getattr(optimizer, "fused_update_elementwise", False))
+        zero_plan = self._zero_plan
 
         runner = exe._runner
         loss_mask = exe._loss_mask
@@ -263,9 +286,14 @@ class DataParallelExecutorGroup:
             (grads,) = vjp_fn(heads)
             new_w, new_states = {}, {}
             for i, nm in enumerate(watched):
-                nw, ns = update(w[nm],
-                                grads[nm].astype(w[nm].dtype),
-                                states[nm], lr_arr[i], wd_arr[i])
+                g = grads[nm].astype(w[nm].dtype)
+                if zero_plan is None:
+                    nw, ns = update(w[nm], g, states[nm],
+                                    lr_arr[i], wd_arr[i])
+                else:
+                    nw, ns = zero_plan.apply(update, w[nm], g,
+                                             states[nm],
+                                             lr_arr[i], wd_arr[i])
                 new_w[nm] = nw
                 new_states[nm] = ns
             # top-1 correct counts per (label, output) pair, computed
@@ -305,9 +333,13 @@ class DataParallelExecutorGroup:
         # the same reason: eval paths read the same cells mid-epoch.
         self._step_core = step      # pure; the scan program re-uses it
         self._fused_keep_grads = keep_grads
+        # the comm-plan token keys the traced collective structure:
+        # replicated all-reduce vs reduce-scatter/shard-update/all-gather
+        # trace differently even for identical symbols and optimizers
         self._fused_cache_key = exe.program_cache_key(
             "fused_step", tuple(watched), tuple(metric_pairs), keep_grads,
-            optimizer.fused_plan_token())
+            optimizer.fused_plan_token(),
+            ("comm", "rs" if zero_plan is not None else "ar"))
         self._fused_prog = None
         if self._fused_cache_key is not None:
             self._fused_prog = _progcache.get(self._fused_cache_key)
@@ -342,10 +374,66 @@ class DataParallelExecutorGroup:
         self._fused_states = {}
         for nm in watched:
             w = exe.arg_dict[nm].asjax()
-            self._fused_states[nm] = jax.tree.map(
-                lambda x, _w=w: jax.device_put(x, _w.sharding),
-                init_state(w))
+            if zero_plan is None:
+                self._fused_states[nm] = jax.tree.map(
+                    lambda x, _w=w: jax.device_put(x, _w.sharding),
+                    init_state(w))
+            else:
+                # created directly in the (n, chunk) sharded layout:
+                # each device holds only its 1/N state slice
+                self._fused_states[nm] = zero_plan.init_state(
+                    init_state, w)
         return True
+
+    # ----------------------------------------------- fused-state transport
+    def export_fused_states(self):
+        """Host-format (param-shaped numpy) fused optimizer states — the
+        checkpoint representation, identical for the replicated and the
+        ZeRO-sharded plans so checkpoints move between arrangements."""
+        if self._zero_plan is None:
+            return jax.tree.map(np.asarray, self._fused_states)
+        return {nm: self._zero_plan.export_state(
+                    st, self.executor.arg_dict[nm].shape)
+                for nm, st in self._fused_states.items()}
+
+    def import_fused_states(self, states_host):
+        """Load host-format states back into the armed plan's layout."""
+        if self._zero_plan is None:
+            self._fused_states = jax.tree.map(
+                lambda old, new: jax.device_put(np.asarray(new),
+                                                old.sharding),
+                self._fused_states, states_host)
+            return
+        self._fused_states = {
+            nm: (self._zero_plan.import_state(states_host[nm])
+                 if nm in states_host else st)
+            for nm, st in self._fused_states.items()}
+
+    def import_staged_state(self, nm, staged):
+        """Project one param's staged (param-shaped, possibly nested)
+        optimizer state onto the fused device layout."""
+        zero_plan = self._zero_plan
+
+        def walk(old, new):
+            if isinstance(old, (tuple, list)):
+                return type(old)(walk(o, n) for o, n in zip(old, new))
+            arr = new.asnumpy() if isinstance(new, NDArray) \
+                else np.asarray(new)
+            if zero_plan is not None:
+                return jax.device_put(zero_plan._flat(jnp.asarray(arr)),
+                                      zero_plan.sharded)
+            return jax.device_put(arr, old.sharding)
+
+        self._fused_states[nm] = walk(self._fused_states[nm], staged)
+
+    def defused_states(self):
+        """Device-side fused states in param shape, for migrating into
+        the staged updater (Module._defuse)."""
+        if self._zero_plan is None:
+            return dict(self._fused_states)
+        return {nm: self._zero_plan.device_state_to_param_shape(
+                    st, self.executor.arg_dict[nm].shape)
+                for nm, st in self._fused_states.items()}
 
     def fused_step(self, data_batch, lrs, wds):
         """Run one fused train step; swap new params/state/outputs in

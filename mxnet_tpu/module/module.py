@@ -15,6 +15,7 @@ import numpy as np
 
 from .. import ndarray as nd
 from .. import optimizer as opt
+from ..base import MXNetError
 from ..context import current_context
 from ..initializer import Uniform
 from ..model import (_create_kvstore, _initialize_kvstore, load_checkpoint)
@@ -69,6 +70,7 @@ class Module(BaseModule):
         self._fused_armed = False
         self._fused_done = False
         self._steps_per_dispatch = 1
+        self._zero_stage = None         # None -> MXNET_ZERO_STAGE, else 0
 
     # ------------------------------------------------------------ checkpoint
     @staticmethod
@@ -278,13 +280,20 @@ class Module(BaseModule):
         # (reference bulk-exec segments + fused optimizer_op.cc). Armed
         # only when the update is single-process local — a dist kvstore
         # or server-side updater owns the math in those arrangements.
+        # zero_stage=1 (fit kwarg or MXNET_ZERO_STAGE) selects the
+        # in-program reduce-scatter + sharded-state update plan.
+        import os
+        zero_stage = self._zero_stage
+        if zero_stage is None:
+            zero_stage = int(os.environ.get("MXNET_ZERO_STAGE", "0") or 0)
         self._fused_armed = False
         self._fused_done = False
         if (not update_on_kvstore
                 and (kvstore is None or "dist" not in kvstore.type)
                 and self._exec_group.executor._monitor_callback is None):
             self._fused_armed = bool(
-                self._exec_group.setup_fused_step(optimizer))
+                self._exec_group.setup_fused_step(optimizer,
+                                                  zero_stage=zero_stage))
 
         if kvstore:
             _initialize_kvstore(kvstore=kvstore,
@@ -361,9 +370,10 @@ class Module(BaseModule):
 
     def _defuse(self):
         """Disarm the fused path, migrating its device optimizer state
-        into the staged updater so training numerics continue exactly."""
+        into the staged updater so training numerics continue exactly
+        (ZeRO-sharded states unflatten back to param shape first)."""
         import jax
-        fs = self._exec_group._fused_states
+        fs = self._exec_group.defused_states()
         for i, nm in enumerate(self._param_names):
             if nm not in fs:
                 continue
@@ -436,23 +446,35 @@ class Module(BaseModule):
             # BucketingModule) — migrate to the staged arrangement so
             # optimizer state lives in exactly one place
             self._defuse()
-        triples = zip(range(len(self._param_names)),
-                      self._exec_group.param_arrays,
-                      self._exec_group.grad_arrays)
+        weights = self._exec_group.param_arrays
+        grads = self._exec_group.grad_arrays
+        idxs = [i for i, g in enumerate(grads) if g is not None]
+        if not idxs:
+            return
+        if self._kvstore:
+            # ONE multi-key push in reverse execution order — the order
+            # backward produces gradients — with matching priorities, so
+            # the dist store's bucket scheduler dispatches each bucket's
+            # collective as soon as its grads exist (overlapping with
+            # the still-draining backward program) instead of one
+            # serial reduce per key. Pulls then run forward-order
+            # (priority=-i): early layers land first for the next
+            # forward, the reference's pull-priority contract.
+            rev = idxs[::-1]
+            self._kvstore.push(rev, [grads[i] for i in rev],
+                               priority=rev)
+            if self._update_on_kvstore:
+                self._kvstore.pull(idxs, [weights[i] for i in idxs],
+                                   priority=[-i for i in idxs])
+                return
+            self._kvstore.pull(idxs, [grads[i] for i in idxs],
+                               priority=[-i for i in idxs])
         if self._update_on_kvstore:
-            for i, weight, grad in triples:
-                if grad is None:
-                    continue
-                self._kvstore.push(i, grad, priority=-i)
-                self._kvstore.pull(i, weight, priority=-i)
-        else:
-            for i, weight, grad in triples:
-                if grad is None:
-                    continue
-                if self._kvstore:
-                    self._kvstore.push(i, grad, priority=-i)
-                    self._kvstore.pull(i, grad, priority=-i)
-                self._updater(i, grad, weight)
+            # update_on_kvstore without a store cannot happen
+            # (_create_kvstore forces it False when kv is None)
+            raise MXNetError("update_on_kvstore set without a kvstore")
+        for i in idxs:
+            self._updater(i, grads[i], weights[i])
 
     def get_outputs(self, merge_multi_context=True):
         assert self.binded and self.params_initialized
@@ -479,9 +501,9 @@ class Module(BaseModule):
                 return [host(x) for x in v]
             return v
         if self._fused_armed:
-            import jax
-            states = {"__fused__": jax.tree.map(np.asarray,
-                                                self._exec_group._fused_states)}
+            # export always writes param-shaped host arrays: replicated
+            # and ZeRO-sharded arrangements produce the same checkpoint
+            states = {"__fused__": self._exec_group.export_fused_states()}
         else:
             states = {k: host(v) for k, v in self._updater.states.items()}
         with open(fname, "wb") as fout:
@@ -496,10 +518,7 @@ class Module(BaseModule):
             states = pickle.load(fin)
         import jax
         if "__fused__" in states and self._fused_armed:
-            cur = self._exec_group._fused_states
-            self._exec_group._fused_states = jax.tree.map(
-                lambda old, new: jax.device_put(new, old.sharding),
-                cur, states["__fused__"])
+            self._exec_group.import_fused_states(states["__fused__"])
         elif "__fused__" in states:
             # fused-format checkpoint into a staged module: unwrap to the
             # updater's per-index states
@@ -517,20 +536,13 @@ class Module(BaseModule):
                 self._updater.states[i] = st
         elif self._fused_armed:
             # staged-format checkpoint into a fused module: project each
-            # per-index state onto the fused per-name device state
-            # (recursive walk — pickled staged tuples come back as lists)
-            def project(old, new):
-                if isinstance(old, (tuple, list)):
-                    return type(old)(project(o, n)
-                                     for o, n in zip(old, new))
-                arr = new.asnumpy() if isinstance(new, NDArray) \
-                    else np.asarray(new)
-                return jax.device_put(arr, old.sharding)
-
+            # per-index state onto the fused per-name device layout
+            # (replicated or ZeRO-sharded; pickled staged tuples come
+            # back as lists — import_staged_state walks the structure)
             fs = self._exec_group._fused_states
             for i, nm in enumerate(self._param_names):
                 if nm in fs and i in states and jax.tree.leaves(fs[nm]):
-                    fs[nm] = project(fs[nm], states[i])
+                    self._exec_group.import_staged_state(nm, states[i])
         else:
             self._updater.states.update(states)
 

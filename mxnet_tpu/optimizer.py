@@ -73,6 +73,14 @@ class Optimizer:
     def update(self, index, weight, grad, state):
         raise NotImplementedError
 
+    #: True when ``fused_plan``'s update is elementwise over
+    #: (weight, grad, state) — each output element depends only on the
+    #: same-index input elements. That makes the update exact on any
+    #: flat reshape/shard of the buffers, which is what the ZeRO-1
+    #: sharded-update plan (parallel/zero.py) requires; non-elementwise
+    #: optimizers keep the replicated update.
+    fused_update_elementwise = False
+
     def fused_plan(self):
         """Optional fused-train-step support.
 
@@ -187,6 +195,8 @@ def _clip(arr, bound):
 class SGD(Optimizer):
     """SGD with momentum via the fused ops. reference: optimizer.py:279."""
 
+    fused_update_elementwise = True     # w/g/mom math is per-element
+
     def __init__(self, momentum=0.0, **kwargs):
         super().__init__(**kwargs)
         self.momentum = momentum
@@ -280,6 +290,24 @@ class NAG(SGD):
         else:
             weight += -lr * (grad + wd * weight)
 
+    def fused_plan(self):
+        # own plan: inheriting SGD's would fuse plain-momentum math
+        # while the staged path runs Nesterov (same update() as above)
+        import jax.numpy as jnp
+        prep = self._fused_grad_prep()
+        momentum = self.momentum
+
+        def init_state(w):
+            return jnp.zeros_like(w) if momentum else ()
+
+        def update(w, g, s, lr, wd):
+            g = prep(g, w, wd)
+            if momentum:
+                new_s = momentum * s + g
+                return w - lr * (g + momentum * new_s), new_s
+            return w - lr * g, ()
+        return init_state, update
+
 
 @register
 class SGLD(Optimizer):
@@ -305,6 +333,8 @@ class ccSGD(SGD):
 @register
 class Adam(Optimizer):
     """reference: optimizer.py:451 (Kingma & Ba, with bias correction)."""
+
+    fused_update_elementwise = True     # w/g/mean/var math is per-element
 
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, **kwargs):

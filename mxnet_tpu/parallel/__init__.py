@@ -11,3 +11,4 @@ from .mesh import (MeshConfig, build_mesh, current_mesh, mesh_scope,
                    data_sharding, replicated, shard, DEFAULT_AXES)
 from .collectives import (all_reduce, all_gather, reduce_scatter, ppermute,
                           barrier, shard_map)
+from .zero import ZeroPlan

@@ -1,0 +1,126 @@
+"""ZeRO stage 1: sharded optimizer updates inside the jitted train step.
+
+The data-parallel baseline all-reduces every gradient and then runs the
+identical optimizer update on every device — N redundant copies of the
+update FLOPs and, worse, N redundant copies of the optimizer state
+(Adam doubles parameter memory *per device*). ZeRO stage 1
+(Rajbhandari et al., SC 2020) replaces that with reduce-scatter +
+shard-update + all-gather: each device owns 1/N of every parameter's
+flat buffer, receives only its shard of the summed gradient, updates
+only its shard of the parameters and optimizer state, and the updated
+parameter shards are all-gathered back to replicated. Optimizer-state
+memory drops N-fold; total collective bytes match the all-reduce
+(reduce-scatter + all-gather = one all-reduce's two phases, split
+around the update).
+
+Realization here: the fused/scan train step stays ONE jitted SPMD
+program. ``ZeroPlan.apply`` reshapes each gradient/parameter to a
+``(n_shard, chunk)`` padded flat view and pins it to the mesh's data
+axis with ``lax.with_sharding_constraint`` — the XLA SPMD partitioner
+then materializes the vjp gradient *directly as a reduce-scatter*
+(the all-reduce it would have inserted sinks into the sharded
+consumer), runs the elementwise update shard-locally, and turns the
+replicated constraint on the new weights into the all-gather. Because
+the collectives live inside the program, XLA's latency-hiding
+scheduler overlaps the gradient reduce-scatter of late layers with the
+still-running backward of early layers — the in-program form of
+comm/compute overlap (docs/performance.md).
+
+The update must be elementwise over (w, g, state) for the flat-shard
+view to be exact — true for the fused SGD/momentum/Adam plans
+(``Optimizer.fused_update_elementwise``); non-elementwise optimizers
+keep the replicated plan. Shard-local math is bit-identical to the
+replicated update (same reduced values, same scalar ops), pinned by
+tests/test_zero.py.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+__all__ = ["ZeroPlan"]
+
+
+class ZeroPlan:
+    """Flat-shard transform over one mesh axis for optimizer updates."""
+
+    def __init__(self, mesh, axis="data"):
+        self.mesh = mesh
+        self.axis = axis
+        self.n = mesh.shape[axis]
+        self.sharded = NamedSharding(mesh, P(axis))
+        self.replicated = NamedSharding(mesh, P())
+
+    # ------------------------------------------------------------ layout
+    def _chunk(self, size):
+        return -(-size // self.n)           # ceil(size / n)
+
+    def _flat(self, x):
+        """(n, chunk) zero-padded flat view (traced or concrete)."""
+        size = int(np.prod(x.shape)) if x.shape else 1
+        pad = self._chunk(size) * self.n - size
+        f = jnp.ravel(x)
+        if pad:
+            f = jnp.concatenate([f, jnp.zeros((pad,), f.dtype)])
+        return f.reshape(self.n, -1)
+
+    def _unflat(self, f, shape):
+        size = int(np.prod(shape)) if shape else 1
+        flat = jnp.ravel(f)
+        if flat.shape[0] != size:
+            flat = flat[:size]
+        return flat.reshape(shape)
+
+    # ------------------------------------------------------------- update
+    def apply(self, update, w, g, s, lr, wd):
+        """Run one elementwise optimizer update on 1/n shards.
+
+        ``w``/``g`` are full (replicated-layout) traced arrays; ``s`` is
+        the persistent state pytree already in (n, chunk) sharded form
+        (see ``init_state``). Returns (new_w in the original shape,
+        new_s still flat-sharded)."""
+        shape = w.shape
+        wf = jax.lax.with_sharding_constraint(self._flat(w), self.sharded)
+        # the constraint below is where the partitioner turns the vjp
+        # gradient's pending all-reduce into a reduce-scatter
+        gf = jax.lax.with_sharding_constraint(self._flat(g), self.sharded)
+        new_wf, new_s = update(wf, gf, s, lr, wd)
+        new_s = jax.tree.map(
+            lambda x: jax.lax.with_sharding_constraint(x, self.sharded),
+            new_s)
+        # replicated constraint on the updated shards = the all-gather
+        new_wf = jax.lax.with_sharding_constraint(new_wf, self.replicated)
+        return self._unflat(new_wf, shape), new_s
+
+    # -------------------------------------------------------------- state
+    def init_state(self, init_state, w):
+        """Optimizer state for one param, created directly in the
+        (n, chunk) sharded layout — each device materializes only its
+        1/n slice (the N-fold state-memory cut of ZeRO-1)."""
+        wf = self._flat(jnp.asarray(w))
+        state = init_state(wf)
+        return jax.tree.map(
+            lambda x: jax.device_put(x, self.sharded), state)
+
+    def export_state(self, state, shape):
+        """Host-format (param-shaped numpy) view of a sharded state
+        pytree — the checkpoint representation, identical to what the
+        replicated plan would have saved."""
+        return jax.tree.map(
+            lambda x: np.asarray(self._unflat(jnp.asarray(x), shape)),
+            state)
+
+    def import_state(self, state_host):
+        """Inverse of ``export_state``: param-shaped host arrays back to
+        the (n, chunk) sharded device layout."""
+        return jax.tree.map(
+            lambda x: jax.device_put(self._flat(jnp.asarray(np.asarray(x))),
+                                     self.sharded),
+            state_host)
+
+    def device_state_to_param_shape(self, state, shape):
+        """Device-side unflatten (for defusing into the staged updater)."""
+        return jax.tree.map(
+            lambda x: self._unflat(jnp.asarray(x), shape), state)

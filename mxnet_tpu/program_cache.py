@@ -18,8 +18,9 @@ everything that determines the traced computation:
 where ``kind`` is one of ``fwd_infer`` / ``fwd_train`` / ``fwd_bwd`` /
 ``fused_step`` / ``scan`` and the extras carry what only that kind
 depends on (the watched-param set for gradient programs; the optimizer's
-``fused_plan_token()`` and the scan length K for the fused/scan train
-steps). Anything the key cannot capture — model-parallel plans, monitor
+``fused_plan_token()``, the comm-plan token — replicated all-reduce vs
+ZeRO-1 reduce-scatter, ``("comm", "ar"|"rs")`` — and the scan length K
+for the fused/scan train steps). Anything the key cannot capture — model-parallel plans, monitor
 taps, the NaiveEngine debug mode — is simply not cached here and keeps
 its per-executor lifecycle.
 

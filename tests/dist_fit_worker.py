@@ -52,6 +52,7 @@ def main():
         digest.update(np.ascontiguousarray(
             np.round(args[nm].asnumpy().astype(np.float64), 5)).tobytes())
     acc = mod.score(it, "acc")[0][1]
+    kv.close()                  # stop/join the heartbeat thread
     print(f"DIST_FIT_OK rank={rank} nworker={nworker} "
           f"params={digest.hexdigest()[:16]} acc={acc:.3f}", flush=True)
     assert acc > 0.8, f"rank {rank} failed to learn: {acc}"

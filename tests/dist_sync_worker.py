@@ -54,6 +54,7 @@ def main():
 
     assert kv.get_num_dead_node(timeout_ms=5000) == 0
     kv._barrier()
+    kv.close()                  # stop/join the heartbeat thread
     print(f"DIST_SYNC_OK rank={rank} nworker={nworker} "
           f"expected={expected}", flush=True)
 

@@ -118,3 +118,149 @@ def test_dist_sync_single_process():
     val = mx.nd.empty(SHAPE)
     kv.pull(3, out=val)
     check_diff_to_scalar(val, 1)
+
+
+# ---------------------------------------------------------------------------
+# bucket scheduler (ready-order overlapped all-reduce, kvstore_sched.py)
+# ---------------------------------------------------------------------------
+
+def _dist_kv(keys_shapes, dtype=np.float32):
+    kv = mx.kv.create("dist_sync")
+    for k, s in keys_shapes.items():
+        kv.init(k, mx.nd.zeros(s, dtype=dtype))
+    return kv
+
+
+def test_bucket_straddle_boundary(monkeypatch):
+    """An array bigger than MXNET_KVSTORE_BUCKET_BYTES must get its own
+    bucket (and survive the size-class padding round trip) while its
+    neighbors pack separately — values must come back exact."""
+    monkeypatch.setenv("MXNET_KVSTORE_BUCKET_BYTES", str(1 << 12))  # 4 KiB
+    shapes = {0: (8, 8), 1: (40, 40), 2: (7, 3)}     # 1 straddles: 6.4 KB
+    kv = _dist_kv(shapes)
+    rs = np.random.RandomState(0)
+    vals = {k: rs.randn(*s).astype(np.float32) for k, s in shapes.items()}
+    kv.push(list(shapes), [mx.nd.array(vals[k]) for k in shapes])
+    outs = {k: mx.nd.empty(s) for k, s in shapes.items()}
+    kv.pull(list(shapes), out=[outs[k] for k in shapes])
+    for k in shapes:
+        np.testing.assert_allclose(outs[k].asnumpy(), vals[k], rtol=1e-6)
+    # the big key went alone; >= 2 buckets total for the call
+    logs = list(kv._sched.bucket_log)
+    assert len(logs) >= 2, logs
+    big = [b for b in logs if 1 in b["key_ids"]]
+    assert len(big) == 1 and big[0]["key_ids"] == [1], logs
+
+
+def test_mixed_dtype_push():
+    """fp32 + bf16 keys in ONE push call reduce through separate
+    same-dtype buckets and keep their dtypes."""
+    import jax.numpy as jnp
+    kv = mx.kv.create("dist_sync")
+    kv.init(0, mx.nd.zeros((4, 4)))
+    kv.init(1, mx.nd.NDArray(jnp.zeros((6, 2), jnp.bfloat16)))
+    v32 = mx.nd.ones((4, 4)) * 3
+    v16 = mx.nd.NDArray(jnp.full((6, 2), 2.0, jnp.bfloat16))
+    kv.push([0, 1], [v32, v16])
+    o32, o16 = mx.nd.empty((4, 4)), \
+        mx.nd.NDArray(jnp.zeros((6, 2), jnp.bfloat16))
+    kv.pull([0, 1], out=[o32, o16])
+    assert (o32.asnumpy() == 3).all()
+    assert o16.asjax().dtype == jnp.bfloat16
+    assert (np.asarray(o16.asjax(), np.float32) == 2).all()
+    # one bucket per dtype
+    logs = list(kv._sched.bucket_log)
+    assert len(logs) == 2, logs
+
+
+def test_bucketed_equals_unbucketed(monkeypatch):
+    """Reduced values through the bucket scheduler must match the
+    unbucketed per-array collective (the equivalence oracle)."""
+    monkeypatch.setenv("MXNET_KVSTORE_BUCKET_BYTES", str(1 << 10))
+    shapes = {5: (17,), 6: (31, 3), 7: (257,), 8: (2, 2, 2)}
+    kv = _dist_kv(shapes)
+    rs = np.random.RandomState(1)
+    vals = {k: rs.randn(*s).astype(np.float32) for k, s in shapes.items()}
+    kv.push(list(shapes), [mx.nd.array(vals[k]) for k in shapes])
+    outs = {k: mx.nd.empty(s) for k, s in shapes.items()}
+    kv.pull(list(shapes), out=[outs[k] for k in shapes])
+    for k, s in shapes.items():
+        direct = np.asarray(
+            kv._allreduce([mx.nd.array(vals[k])])[0]).reshape(s)
+        np.testing.assert_array_equal(outs[k].asnumpy(), direct, err_msg=k)
+
+
+def test_size_class_jit_accounting(monkeypatch):
+    """Odd/tiny flat lengths must collapse onto power-of-two size
+    classes: many distinct gradient lengths -> a handful of `_sum_jit`
+    shapes (one trace per class), not one per length."""
+    monkeypatch.setenv("MXNET_KVSTORE_BUCKET_BYTES", str(1 << 30))
+    lengths = [3, 5, 7, 9, 11, 33, 65, 127, 129, 255, 257, 511, 513]
+    shapes = {i: (n,) for i, n in enumerate(lengths)}
+    kv = _dist_kv(shapes)
+    # separate pushes -> one bucket (and one collective) per length
+    for i, n in enumerate(lengths):
+        kv.push(i, mx.nd.ones((n,)))
+        out = mx.nd.empty((n,))
+        kv.pull(i, out=out)
+        check_diff_to_scalar(out, 1)
+    # 13 distinct lengths collapse onto the log-spaced class ladder
+    # (8, 16, 64, 128, 256, 512, 1024 for L=8 local devices)
+    n_classes = len(kv._sum_jit_shapes)
+    assert n_classes <= 7, kv._sum_jit_shapes
+    # every class is (dtype, L * 2^k)
+    for _, padded in kv._sum_jit_shapes:
+        chunk = padded // kv._local
+        assert chunk * kv._local == padded
+        assert chunk & (chunk - 1) == 0, padded
+    snap = mx.telemetry.metrics.snapshot()
+    assert snap["gauges"].get("kvstore.allreduce.size_classes") == n_classes
+
+
+def test_push_priority_orders_dispatch(monkeypatch):
+    """push(priority=...) orders bucket dispatch: higher-priority keys
+    go on the wire first regardless of call order. Cap of 20 bytes fits
+    exactly one 16-byte key per bucket but never fills a bucket at
+    stage time, so the whole call stays pending and the flush cuts
+    buckets in priority order."""
+    monkeypatch.setenv("MXNET_KVSTORE_BUCKET_BYTES", "20")
+    shapes = {0: (4,), 1: (4,), 2: (4,)}
+    kv = _dist_kv(shapes)
+    kv.push([0, 1, 2],
+            [mx.nd.ones((4,)), mx.nd.ones((4,)), mx.nd.ones((4,))],
+            priority=[0, 5, 2])
+    kv._flush_pending()
+    order = [b["key_ids"][0] for b in kv._sched.bucket_log]
+    assert order == [1, 2, 0], order
+
+
+def test_overlap_disabled_is_synchronous(monkeypatch):
+    """MXNET_KVSTORE_OVERLAP=0 applies every push inside the call."""
+    monkeypatch.setenv("MXNET_KVSTORE_OVERLAP", "0")
+    kv = _dist_kv({0: (4, 4)})
+    kv.push(0, mx.nd.ones((4, 4)))
+    assert kv._sched.in_flight() == 0
+    assert len(kv._sched.bucket_log) == 1
+    val = mx.nd.empty((4, 4))
+    kv.pull(0, out=val)
+    check_diff_to_scalar(val, 1)
+
+
+def test_repush_before_pull_flushes():
+    """Two pushes of one key without an intervening pull are two
+    logical reductions (the updater runs once per push)."""
+    kv = _dist_kv({0: (4, 4)})
+
+    seen = []
+
+    def updater(key, recv, local):
+        seen.append(np.array(recv.asnumpy()))
+        local += recv
+
+    kv._set_updater(updater)
+    kv.push(0, mx.nd.ones((4, 4)))
+    kv.push(0, mx.nd.ones((4, 4)) * 2)
+    val = mx.nd.empty((4, 4))
+    kv.pull(0, out=val)
+    check_diff_to_scalar(val, 3)
+    assert len(seen) == 2
