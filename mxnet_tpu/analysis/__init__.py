@@ -1,0 +1,38 @@
+"""Graph verifier & hazard linter: bind-time static analysis.
+
+The NNVM-pass discipline of the reference (InferShape/InferType run to
+fixpoint before anything executes, graph_executor.cc:425), regrown over
+this framework's own hazard classes: shape/dtype/structure consistency
+(``graph_verifier``), use-after-donation through the fused/scan/ZeRO
+plans (``donation_checker``), cross-worker collective dispatch order
+(``collective_order``), program-cache key churn (``retrace_churn``),
+and host syncs on the fit hot path (``host_sync``).
+
+Three surfaces:
+
+* bind time — ``sym.bind(..., validate="warn"|"raise")``,
+  ``simple_bind(..., validate=...)``, or process-wide via
+  ``MXNET_GRAPH_VALIDATE``; Module re-validates after the fused/ZeRO
+  plans arm in ``init_optimizer``;
+* CLI — ``tools/mxlint.py`` lints symbol JSON files and the bundled
+  model zoo, exiting nonzero on error-severity findings;
+* telemetry — findings mirror into the ``analysis.lint.findings``
+  counter family and the flight-recorder ring, and ``tools/diagnose.py``
+  renders them in its health reports.
+
+Rule catalog: docs/analysis.md (ids are stable; suppress with
+``MXNET_LINT_DISABLE=GV107,HS501,...``).
+"""
+from __future__ import annotations
+
+from .diagnostics import Diagnostic, Report, RULES, SEVERITIES
+from .passes import (AnalysisContext, PASSES, run_passes, lint_symbol,
+                     lint_executor, lint_module, lint_json,
+                     validate_executor, validate_module, resolve_mode,
+                     attr_cache_stable)
+
+__all__ = ["Diagnostic", "Report", "RULES", "SEVERITIES",
+           "AnalysisContext", "PASSES", "run_passes", "lint_symbol",
+           "lint_executor", "lint_module", "lint_json",
+           "validate_executor", "validate_module", "resolve_mode",
+           "attr_cache_stable"]

@@ -1,0 +1,168 @@
+"""Structured diagnostics for the bind-time static-analysis passes.
+
+Every finding a pass emits is a :class:`Diagnostic` — rule id, severity,
+human message, node provenance, and a fix hint — collected into a
+:class:`Report`. The rule catalog below is the single source of truth:
+``tools/mxlint.py --rules`` prints it, docs/analysis.md documents it,
+and tests assert against the ids, so a rule exists exactly when it has
+a row here.
+
+Rule id scheme (the NNVM-pass analog of compiler warning numbers):
+
+* ``GV1xx`` — graph verifier (shapes, dtypes, structure)
+* ``DA2xx`` — donation / aliasing hazards
+* ``CO3xx`` — collective dispatch order
+* ``RC4xx`` — retrace / program-cache churn
+* ``HS5xx`` — host synchronization in the fit hot path
+* ``XX0xx`` — analysis-infrastructure notices
+
+Severities: ``error`` (the program is wrong or will crash/deadlock),
+``warning`` (probably a bug or a large avoidable cost), ``info``
+(intentional-but-costly arrangements worth surfacing). ``raise`` mode
+raises on errors only; ``mxlint`` exits nonzero on errors (``--strict``
+promotes warnings).
+"""
+from __future__ import annotations
+
+__all__ = ["Diagnostic", "Report", "RULES", "SEVERITIES"]
+
+SEVERITIES = ("info", "warning", "error")
+
+#: rule id -> (default severity, one-line title)
+RULES = {
+    # ---- graph verifier -------------------------------------------------
+    "GV101": ("error", "shape/type inference failed over the graph"),
+    "GV102": ("warning", "shape inference left argument/output shapes "
+                         "unknown"),
+    "GV103": ("error", "two distinct variables share one name"),
+    "GV104": ("warning", "two distinct op nodes share one name"),
+    "GV105": ("warning", "declared variable dtype conflicts with the "
+                         "bound array"),
+    "GV106": ("error", "dangling node input (bad index or forward "
+                       "reference) in the JSON graph"),
+    "GV107": ("warning", "inference stalled at an op registered without "
+                         "infer_shape or a shape_passthrough flag"),
+    "GV108": ("warning", "dead node unreachable from any graph head"),
+    # ---- donation / aliasing -------------------------------------------
+    "DA201": ("error", "buffer aliased into a donated fused/scan argument "
+                       "(use-after-donation)"),
+    "DA202": ("warning", "fused step donates parameter cells shared with "
+                         "another executor group"),
+    "DA203": ("error", "donated parameter name doubles as a data/label "
+                       "input"),
+    "DA204": ("warning", "one buffer staged under two kvstore keys in the "
+                         "same bucket window"),
+    # ---- collective order ----------------------------------------------
+    "CO301": ("error", "bucket all-reduce order depends on grad-ready "
+                       "arrival order (cross-worker divergence)"),
+    "CO302": ("error", "in-program reduce-scatter plan armed together "
+                       "with a dist kvstore reduction"),
+    "CO303": ("error", "in-program collective order diverges from the "
+                       "parameter declaration order"),
+    # ---- retrace / cache churn -----------------------------------------
+    "RC401": ("warning", "op attr value is not cache-key stable "
+                         "(identity repr, array, or non-finite float)"),
+    "RC402": ("warning", "binding is not program-cacheable; every rebind "
+                         "re-traces"),
+    # ---- host sync ------------------------------------------------------
+    "HS501": ("warning", "NaiveEngine serializes every op through the "
+                         "host in the fit hot path"),
+    "HS502": ("info", "monitor tap forces eager per-op execution with "
+                      "device->host transfers"),
+    "HS503": ("info", "training graph re-emits a bare input variable as "
+                      "an output every step"),
+    "HS504": ("info", "MXNET_FUSED_KEEP_GRADS materializes every "
+                      "gradient as a program output"),
+    # ---- infrastructure -------------------------------------------------
+    "XX001": ("info", "an analysis pass failed to run"),
+}
+
+
+class Diagnostic:
+    """One finding: rule id + severity + message + node provenance."""
+
+    __slots__ = ("rule", "severity", "message", "node", "op", "hint")
+
+    def __init__(self, rule, message, node=None, op=None, hint=None,
+                 severity=None):
+        if rule not in RULES:
+            raise ValueError(f"unknown lint rule id {rule!r}")
+        self.rule = rule
+        self.severity = severity or RULES[rule][0]
+        if self.severity not in SEVERITIES:
+            raise ValueError(f"unknown severity {self.severity!r}")
+        self.message = message
+        self.node = node          # node name (provenance), or None
+        self.op = op              # op name, or None
+        self.hint = hint          # how to fix / suppress
+
+    def format(self):
+        where = ""
+        if self.node:
+            where = f" at node '{self.node}'"
+            if self.op:
+                where += f" ({self.op})"
+        elif self.op:
+            where = f" at op '{self.op}'"
+        text = f"{self.rule} [{self.severity}]{where}: {self.message}"
+        if self.hint:
+            text += f"  — hint: {self.hint}"
+        return text
+
+    def as_dict(self):
+        return {"rule": self.rule, "severity": self.severity,
+                "message": self.message, "node": self.node, "op": self.op,
+                "hint": self.hint}
+
+    def __repr__(self):
+        return f"<Diagnostic {self.format()}>"
+
+
+class Report:
+    """Ordered collection of diagnostics from one analysis run."""
+
+    def __init__(self, diagnostics=None):
+        self.diagnostics = list(diagnostics or [])
+
+    def add(self, diag):
+        self.diagnostics.append(diag)
+
+    def __len__(self):
+        return len(self.diagnostics)
+
+    def __iter__(self):
+        return iter(self.diagnostics)
+
+    def by_severity(self, severity):
+        return [d for d in self.diagnostics if d.severity == severity]
+
+    @property
+    def errors(self):
+        return self.by_severity("error")
+
+    @property
+    def warnings(self):
+        return self.by_severity("warning")
+
+    @property
+    def infos(self):
+        return self.by_severity("info")
+
+    @property
+    def rules(self):
+        """Set of rule ids that fired."""
+        return {d.rule for d in self.diagnostics}
+
+    def format(self):
+        if not self.diagnostics:
+            return "no findings"
+        return "\n".join(d.format() for d in self.diagnostics)
+
+    def as_dict(self):
+        return {"findings": [d.as_dict() for d in self.diagnostics],
+                "errors": len(self.errors), "warnings": len(self.warnings),
+                "infos": len(self.infos)}
+
+    def __repr__(self):
+        return (f"<Report {len(self.errors)} errors, "
+                f"{len(self.warnings)} warnings, {len(self.infos)} infos>")

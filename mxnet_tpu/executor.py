@@ -347,7 +347,7 @@ class Executor:
 
     def __init__(self, symbol, ctx, args, args_grad=None, grad_req="write",
                  aux_states=None, group2ctx=None, shared_exec=None,
-                 compute_dtype=None, mirror=None):
+                 compute_dtype=None, mirror=None, validate=None):
         self._symbol = symbol
         self._ctx = ctx
         self._group2ctx = group2ctx or {}
@@ -466,6 +466,17 @@ class Executor:
         self._sentinel = None     # optional NaN/Inf tripwire (telemetry)
         # param/grad/aux/output footprint -> registry gauges + flight ring
         self.memory_footprint = _telemetry.memory.record_executor_bind(self)
+
+        # bind-time static analysis (the NNVM InferShape/InferType
+        # discipline, analysis/): validate="warn"|"raise" per call, or
+        # process-wide via MXNET_GRAPH_VALIDATE. The span keeps the
+        # overhead visible (gated <2% of bind by
+        # benchmarks/lint_overhead.py).
+        from . import analysis as _analysis
+        vmode = _analysis.resolve_mode(validate)
+        if vmode is not None:
+            with _telemetry.span("executor.validate"):
+                _analysis.validate_executor(self, vmode)
 
     # ------------------------------------------------------------ normalize
     def _normalize_args(self, args, names, what, allow_none=False):
@@ -832,7 +843,7 @@ class Executor:
     # ----------------------------------------------------------- simple_bind
     @staticmethod
     def _simple_bind(symbol, ctx, grad_req, type_dict, group2ctx, shapes,
-                     mirror=None):
+                     mirror=None, validate=None):
         arg_shapes, out_shapes, aux_shapes = symbol.infer_shape(**shapes)
         arg_names = symbol.list_arguments()
         aux_names = symbol.list_auxiliary_states()
@@ -849,4 +860,4 @@ class Executor:
         aux = {nm: nd_zeros(s, ctx=ctx)
                for nm, s in zip(aux_names, aux_shapes)}
         return Executor(symbol, ctx, args, grads, grad_req, aux, group2ctx,
-                        mirror=mirror)
+                        mirror=mirror, validate=validate)

@@ -553,6 +553,11 @@ class KVStoreDistSync(KVStore):
                                       dist=True)
         try:
             with push_span:
+                # one arrival epoch per caller-level push: the static
+                # collective-order checker (analysis rule CO301) treats
+                # equal-priority keys from different epochs as
+                # ready-order — i.e. nondeterministic across workers
+                self._sched.note_push_call()
                 for k, vlist, prio in zip(keys, vals, prios):
                     if k not in self._store:
                         raise MXNetError(f"key {k!r} not initialized")

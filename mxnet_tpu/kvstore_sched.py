@@ -80,8 +80,22 @@ class BucketScheduler:
         self._seq = 0
         # recent per-bucket timings for benchmarks/diagnostics
         self.bucket_log = collections.deque(maxlen=1024)
+        # order-audit trail for the static collective-order checker
+        # (analysis rules CO301/DA204): which push call staged which key
+        # at which priority, grouped by flush window. One dict append
+        # per stage — negligible against the collective it schedules.
+        self.stage_log = collections.deque(maxlen=1024)
+        self._push_seq = 0            # distinct push() calls (arrival epochs)
+        self._window = 0              # flush windows completed
 
     # ------------------------------------------------------------- staging
+    def note_push_call(self):
+        """Mark the start of one caller-level push(): entries staged
+        under different push calls arrive in grad-ready order, which the
+        collective-order analysis must treat as nondeterministic across
+        workers (entries within one call share the caller's key order)."""
+        self._push_seq += 1
+
     def stage(self, key, ctx, arr, priority=0):
         """Queue one key's merged gradient; dispatches any bucket the
         staging completes. A re-push of a still-unapplied key first
@@ -91,6 +105,9 @@ class BucketScheduler:
         self._staged.add(key)
         self._pending.append((priority, self._arrival, key, ctx, arr))
         self._arrival += 1
+        self.stage_log.append({"key": key, "prio": priority,
+                               "push": self._push_seq,
+                               "buf": id(arr), "window": self._window})
         self._cut_buckets(dispatch_partial=False)
 
     def _cut_buckets(self, dispatch_partial):
@@ -153,6 +170,7 @@ class BucketScheduler:
         """Dispatch what remains pending, then apply every in-flight
         bucket's reduced values in dispatch order."""
         self._cut_buckets(dispatch_partial=True)
+        self._window += 1       # close the audit window for stage_log
         if not self._inflight:
             self._staged.clear()
             return

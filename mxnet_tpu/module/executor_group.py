@@ -145,11 +145,16 @@ class DataParallelExecutorGroup:
 
         args = {}
         grads = {}
+        # cells reused from a shared_group: the donation/aliasing
+        # analysis pass (analysis rule DA202) flags these if a fused
+        # (donating) plan ever arms over them
+        self._shared_param_names = set()
         for name, shape in zip(self.arg_names, arg_shapes):
             kind = "data" if (name in self.data_names or
                               name in self.label_names) else "param"
             if name in shared_params and kind == "param":
                 args[name] = shared_params[name]  # shared NDArray cell
+                self._shared_param_names.add(name)
             else:
                 dtype = arg_types.get(name, np.float32)
                 args[name] = NDArray(self._place(

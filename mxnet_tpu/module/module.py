@@ -309,6 +309,15 @@ class Module(BaseModule):
             self.load_optimizer_states(self._preload_opt_states)
             self._preload_opt_states = None
 
+        # the donation/collective hazard surface only exists once the
+        # fused/ZeRO plans are armed and the kvstore is attached —
+        # re-run the static-analysis passes over the full arrangement
+        # (MXNET_GRAPH_VALIDATE=warn|raise; bind() already verified the
+        # bare graph)
+        from .. import analysis as _analysis
+        if _analysis.resolve_mode(None) is not None:
+            _analysis.validate_module(self)
+
     def borrow_optimizer(self, shared_module):
         """Share optimizer state with another Module (bucketing)."""
         assert shared_module.optimizer_initialized

@@ -397,8 +397,9 @@ def _softmax_activation(attrs, x):
     return jax.nn.softmax(x.reshape(x.shape[0], -1), axis=-1).reshape(x.shape)
 
 
-@register("softmax", inputs=("data",), attr_spec={"axis": (parse_int, -1),
-                                                  "temperature": (None, None)})
+@register("softmax", inputs=("data",), shape_passthrough=True,
+          attr_spec={"axis": (parse_int, -1),
+                     "temperature": (None, None)})
 def _softmax_op(attrs, x):
     t = attrs.get("temperature")
     if t not in (None, "None"):
@@ -406,7 +407,8 @@ def _softmax_op(attrs, x):
     return jax.nn.softmax(x, axis=attrs.get("axis", -1))
 
 
-@register("log_softmax", inputs=("data",), attr_spec={"axis": (parse_int, -1)})
+@register("log_softmax", inputs=("data",), shape_passthrough=True,
+          attr_spec={"axis": (parse_int, -1)})
 def _log_softmax_op(attrs, x):
     return jax.nn.log_softmax(x, axis=attrs.get("axis", -1))
 

@@ -54,11 +54,19 @@ class OpDef:
         verbatim (MXNet tolerates extra attrs in JSON round-trips).
     infer_shape : optional fn(attrs, in_shapes)->(in_shapes, out_shapes,
         aux_shapes) for bidirectional inference (weight shapes deduced from
-        data, reference: per-op InferShape). When absent, shapes are derived
+        data, reference: per-op InferShape); a third ``out_known`` parameter
+        is detected at registration. When absent, shapes are derived
         by abstract evaluation (jax.eval_shape) which requires complete
-        input shapes.
+        input shapes. Signatures are validated at registration time
+        (malformed arity fails fast with the op name, instead of lazily
+        at the first symbol.infer_shape walk).
     infer_type : optional fn(attrs, in_types)->(in_types, out_types,
         aux_types).
+    shape_passthrough : declares the op shape-identity on its first input
+        (all outputs take input 0's shape) without a dedicated infer fn —
+        the explicit opt-out the graph verifier (analysis rule GV107)
+        accepts in place of ``infer_shape``, so an op can never *silently*
+        fall back to abstract evaluation that stalls on partial shapes.
     need_rng : forward consumes the rng key (Dropout, samplers).
     is_loss : op is a loss head (SoftmaxOutput family) — executor seeds its
         cotangent with ones for backward() with no out_grads.
@@ -70,7 +78,8 @@ class OpDef:
     def __init__(self, name, forward, inputs=("data",), aux=(),
                  num_outputs=1, output_names=None, attr_spec=None,
                  infer_shape=None, infer_type=None, need_rng=False,
-                 is_loss=False, mutate_inputs=(), num_visible=None, doc=""):
+                 is_loss=False, mutate_inputs=(), num_visible=None,
+                 shape_passthrough=False, doc=""):
         self.name = name
         self.forward = forward
         self._inputs = inputs
@@ -84,7 +93,14 @@ class OpDef:
         self.need_rng = need_rng
         self.is_loss = is_loss
         self.mutate_inputs = tuple(mutate_inputs)
+        self.shape_passthrough = bool(shape_passthrough)
         self.doc = doc
+        # arity check up front (it used to happen lazily at the first
+        # symbol shape walk): a malformed infer fn names its op here
+        # instead of failing as a confusing TypeError mid-inference
+        self._infer_accepts_out = _validate_infer_signature(
+            name, "infer_shape", infer_shape)
+        _validate_infer_signature(name, "infer_type", infer_type)
 
     # --- variadic-aware accessors ---------------------------------------
     def input_names(self, attrs=None):
@@ -138,6 +154,47 @@ class OpDef:
 
     def __repr__(self):
         return f"OpDef({self.name})"
+
+
+def _validate_infer_signature(op_name, what, fn):
+    """Registration-time arity check for infer_shape/infer_type.
+
+    Returns whether the fn accepts the optional third ``out_known``
+    argument (bidirectional inference), the property symbol.py used to
+    probe lazily per call. Raises MXNetError naming the op when the fn
+    cannot even accept the mandatory ``(attrs, in_shapes)`` pair.
+    """
+    if fn is None:
+        return False
+    if not callable(fn):
+        raise MXNetError(
+            f"op {op_name!r}: {what} must be callable, got "
+            f"{type(fn).__name__}")
+    import inspect
+    try:
+        sig = inspect.signature(fn)
+    except (ValueError, TypeError):
+        return False          # builtins/partials: cannot introspect
+    required = 0
+    max_positional = 0
+    has_varargs = False
+    for p in sig.parameters.values():
+        if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD):
+            max_positional += 1
+            if p.default is p.empty:
+                required += 1
+        elif p.kind == p.VAR_POSITIONAL:
+            has_varargs = True
+        elif p.kind == p.KEYWORD_ONLY and p.default is p.empty:
+            raise MXNetError(
+                f"op {op_name!r}: {what} has a required keyword-only "
+                f"parameter {p.name!r}; inference calls it positionally "
+                "as (attrs, in_shapes[, out_known])")
+    if not has_varargs and (max_positional < 2 or required > 3):
+        raise MXNetError(
+            f"op {op_name!r}: {what} must accept (attrs, in_shapes"
+            f"[, out_known]), got signature {sig}")
+    return has_varargs or max_positional >= 3
 
 
 def _wrap_simple(fn):

@@ -462,7 +462,8 @@ def _tile(attrs, x):
     return jnp.tile(x, attrs["reps"])
 
 
-@register("reverse", inputs=("data",), attr_spec={"axis": (_axis_param, 0)})
+@register("reverse", inputs=("data",), shape_passthrough=True,
+          attr_spec={"axis": (_axis_param, 0)})
 def _reverse(attrs, x):
     ax = attrs.get("axis", 0)
     ax = (ax,) if isinstance(ax, int) else ax

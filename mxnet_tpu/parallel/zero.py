@@ -53,6 +53,15 @@ class ZeroPlan:
         self.sharded = NamedSharding(mesh, P(axis))
         self.replicated = NamedSharding(mesh, P())
 
+    def describe(self):
+        """Ordered in-program collective sequence one parameter update
+        traces under this plan — what the collective-order analysis
+        pass (analysis rule CO302) and diagnostics render. The order is
+        structural (baked into the traced program), hence identical on
+        every worker by construction."""
+        return (("reduce_scatter", self.axis, self.n),
+                ("all_gather", self.axis, self.n))
+
     # ------------------------------------------------------------ layout
     def _chunk(self, size):
         return -(-size // self.n)           # ceil(size / n)

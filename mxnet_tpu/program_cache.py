@@ -34,13 +34,68 @@ same — both mean "no new compile") and the
 from __future__ import annotations
 
 import hashlib
+import math
 import os
+import re
 import threading
 from collections import OrderedDict
 
+import numpy as np
+
 from .telemetry import metrics as _metrics
 
-__all__ = ["symbol_signature", "get", "put", "clear", "size"]
+__all__ = ["symbol_signature", "get", "put", "clear", "size",
+           "attr_cache_stable"]
+
+_ID_REPR = re.compile(r" at 0x[0-9a-fA-F]+")
+
+
+def attr_cache_stable(value, _depth=0):
+    """(stable?, reason) — is one op-attr value safe inside a cache key?
+
+    ``symbol_signature`` hashes the symbol's JSON, so every attr value
+    lands (stringified) in the program-cache key and the persistent XLA
+    cache key. Stable means: the string is identical across processes
+    and across re-constructions of the same logical graph, and the
+    value compares equal to itself. Three ways to lose:
+
+    * reprs embedding the object id (``<obj at 0x7f..>``) — a fresh key
+      every construction: per-step retrace/recompile churn;
+    * array attrs — numpy's repr truncates, so two *different* arrays
+      can hash to ONE key: silent wrong-program reuse, worse than churn;
+    * non-finite floats — NaN != NaN defeats every by-value cache
+      downstream (the fused lr/wd device-array cache re-uploads per
+      step).
+
+    The retrace-churn analysis pass (analysis rule RC401) flags graph
+    attrs through this predicate.
+    """
+    v = value
+    if v is None or isinstance(v, (bool, str, bytes, int, np.integer)):
+        return True, ""
+    if isinstance(v, (float, np.floating)):
+        if not math.isfinite(float(v)):
+            return False, "non-finite float never compares equal"
+        return True, ""
+    if isinstance(v, (tuple, list)):
+        if _depth > 4:
+            return False, "deeply nested attr"
+        for item in v:
+            ok, why = attr_cache_stable(item, _depth + 1)
+            if not ok:
+                return False, why
+        return True, ""
+    if isinstance(v, (np.dtype, type)):
+        return True, ""
+    if isinstance(v, np.ndarray) or hasattr(v, "__array__"):
+        return False, ("array repr truncates; distinct arrays can hash "
+                       "to one cache key")
+    rep = repr(v)
+    if _ID_REPR.search(rep):
+        return False, "repr embeds the object id"
+    if callable(v):
+        return False, "callable attrs do not serialize"
+    return True, ""
 
 _lock = threading.Lock()
 _cache = OrderedDict()        # key tuple -> program callable

@@ -322,17 +322,26 @@ class Symbol:
                 new_in, out_shapes, _aux = _infer_node_shape(
                     opdef, node, in_shapes, True,
                     out_known=list(shapes[id(node)]))
-                for (store, idx), s in zip(in_entries, new_in):
-                    merged = _merge_shape(store[idx], s)
-                    if merged != store[idx]:
-                        store[idx] = merged
-                        changed = True
-                store = shapes[id(node)]
-                for i, s in enumerate(out_shapes[:len(store)]):
-                    merged = _merge_shape(store[i], s)
-                    if merged != store[i]:
-                        store[i] = merged
-                        changed = True
+                try:
+                    for (store, idx), s in zip(in_entries, new_in):
+                        merged = _merge_shape(store[idx], s)
+                        if merged != store[idx]:
+                            store[idx] = merged
+                            changed = True
+                    store = shapes[id(node)]
+                    for i, s in enumerate(out_shapes[:len(store)]):
+                        merged = _merge_shape(store[i], s)
+                        if merged != store[i]:
+                            store[i] = merged
+                            changed = True
+                except MXNetError as e:
+                    # conflicting shapes meeting at this node: attach
+                    # the node's provenance instead of the bare
+                    # "incompatible shapes (a) vs (b)"
+                    raise MXNetError(
+                        f"infer_shape mismatch at "
+                        f"{_node_provenance(node, in_shapes)}: {e}") \
+                        from e
             if not changed:
                 break
         return shapes
@@ -385,18 +394,23 @@ class Symbol:
 
     # ----------------------------------------------------------------- binding
     def simple_bind(self, ctx=None, grad_req="write", type_dict=None,
-                    group2ctx=None, mirror=None, **kwargs):
+                    group2ctx=None, mirror=None, validate=None, **kwargs):
         from .executor import Executor
         return Executor._simple_bind(self, ctx or current_context(), grad_req,
                                      type_dict, group2ctx, kwargs,
-                                     mirror=mirror)
+                                     mirror=mirror, validate=validate)
 
     def bind(self, ctx=None, args=None, args_grad=None, grad_req="write",
-             aux_states=None, group2ctx=None, shared_exec=None, mirror=None):
+             aux_states=None, group2ctx=None, shared_exec=None, mirror=None,
+             validate=None):
+        """Bind into an Executor. ``validate="warn"|"raise"`` runs the
+        static-analysis passes (mxnet_tpu.analysis) over the bound
+        graph — warn logs findings, raise fails the bind on
+        error-severity ones; default comes from MXNET_GRAPH_VALIDATE."""
         from .executor import Executor
         return Executor(self, ctx or current_context(), args, args_grad,
                         grad_req, aux_states, group2ctx, shared_exec,
-                        mirror=mirror)
+                        mirror=mirror, validate=validate)
 
     # ------------------------------------------------------------ eval helper
     def eval(self, ctx=None, **kwargs):
@@ -406,31 +420,67 @@ class Symbol:
         return ex.forward(is_train=False, **kwargs)
 
 
+def _node_provenance(node, in_shapes=None):
+    """'op X node Y (inputs: a=(2, 3), b=?)' — the provenance prefix
+    every inference error carries (reference: InferShape errors named
+    the failing node; a bare "incompatible shapes" is undebuggable on a
+    500-node graph)."""
+    parts = []
+    for i, (inp, idx) in enumerate(node.inputs):
+        nm = inp.name if inp.is_variable else f"{inp.name}[{idx}]"
+        s = None
+        if in_shapes is not None and i < len(in_shapes):
+            s = in_shapes[i]
+        parts.append(f"{nm}={s if s is not None else '?'}")
+    inputs = f" (inputs: {', '.join(parts)})" if parts else ""
+    return f"op {node.op!r} node {node.name!r}{inputs}"
+
+
 def _infer_node_shape(opdef, node, in_shapes, partial, out_known=None):
     aux_count = len(opdef.aux_names(node.attrs))
     regular = in_shapes[:len(in_shapes) - aux_count] if aux_count else in_shapes
     if opdef.infer_shape is not None:
-        accepts_out = getattr(opdef, "_infer_accepts_out", None)
-        if accepts_out is None:
-            import inspect
-            try:
-                accepts_out = len(inspect.signature(
-                    opdef.infer_shape).parameters) >= 3
-            except (ValueError, TypeError):
-                accepts_out = False
-            opdef._infer_accepts_out = accepts_out
+        # arity is validated (and the out_known capability probed) at
+        # registration time (ops/registry.py); the getattr fallback
+        # keeps hand-built OpDef objects working
+        accepts_out = getattr(opdef, "_infer_accepts_out", False)
         try:
             if accepts_out:
                 new_in, outs, auxs = opdef.infer_shape(
                     node.attrs, regular, out_known)
             else:
                 new_in, outs, auxs = opdef.infer_shape(node.attrs, regular)
-        except (KeyError, IndexError, TypeError):
+        except (KeyError, IndexError, TypeError) as e:
+            # incomplete information inside the infer fn: unknown in a
+            # partial walk, a provenance-carrying error otherwise
             if partial:
                 n_out = opdef.num_outputs(node.attrs)
                 return in_shapes, [None] * n_out, []
-            raise
+            raise MXNetError(
+                f"infer_shape failed at "
+                f"{_node_provenance(node, in_shapes)}: {e}") from e
+        except (ValueError, MXNetError) as e:
+            # genuine inconsistency (shape conflict, bad attr): always
+            # surface, with the node's provenance attached
+            raise MXNetError(
+                f"infer_shape failed at "
+                f"{_node_provenance(node, in_shapes)}: {e}") from e
         return list(new_in) + list(auxs), outs, auxs
+    if opdef.shape_passthrough:
+        # declared shape-identity on input 0 (the explicit flag the
+        # graph verifier accepts in place of infer_shape): propagate
+        # bidirectionally between input 0 and every output
+        try:
+            merged = regular[0] if regular else None
+            for s in (out_known or []):
+                merged = _merge_shape(merged, s)
+        except MXNetError as e:
+            raise MXNetError(
+                f"infer_shape failed at "
+                f"{_node_provenance(node, in_shapes)}: {e}") from e
+        n_out = opdef.num_outputs(node.attrs)
+        new_in = [merged] + list(in_shapes[1:])
+        return new_in, [merged] * n_out, []
     # fallback: abstract evaluation requires complete input shapes
     if any(not shape_is_known(s) for s in in_shapes):
         n_out = opdef.num_outputs(node.attrs)
@@ -454,7 +504,8 @@ def _infer_node_shape(opdef, node, in_shapes, partial, out_known=None):
             n_out = opdef.num_outputs(node.attrs)
             return in_shapes, [None] * n_out, []
         raise MXNetError(
-            f"shape inference failed for op {node.op} ({node.name}): {e}")
+            f"shape inference (abstract evaluation) failed at "
+            f"{_node_provenance(node, in_shapes)}: {e}")
     aux_shapes = out_shapes[len(out_shapes):]
     return in_shapes, out_shapes, aux_shapes
 

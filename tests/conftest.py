@@ -14,6 +14,12 @@ os.environ.setdefault(
     "MXNET_CRASH_DIR",
     os.path.join(tempfile.gettempdir(), f"mxnet_crash_{os.getpid()}"))
 
+# Bind-time graph validation in warn mode across the whole suite: every
+# executor the tier-1 tests bind runs the static-analysis passes for
+# free (findings log as warnings, never raise). Tests that assert on
+# validation behavior set the env/kwargs themselves.
+os.environ.setdefault("MXNET_GRAPH_VALIDATE", "warn")
+
 # Force, don't setdefault: the outer environment may carry JAX_PLATFORMS=tpu
 # (or another accelerator), and the suite's numerics are written for f32 CPU
 # execution on the virtual 8-device mesh.

@@ -1,0 +1,539 @@
+"""Static-analysis (graph verifier & hazard linter) tests.
+
+Three seeded-hazard fixtures — a use-after-donation fused plan, a
+nondeterministic bucket order, a cache-churn attr — each tripping
+exactly one rule, plus zero-false-positive gates over the bundled
+model zoo and the ZeRO/scan/bucketed configurations, the GV/HS rule
+set, bind-time warn/raise surfaces, telemetry mirroring, suppression,
+and the registration-time infer-signature validation.
+"""
+import json
+import logging
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.analysis import (AnalysisContext, RULES, lint_json,
+                                lint_module, lint_symbol, run_passes)
+from mxnet_tpu.kvstore_sched import BucketScheduler
+from mxnet_tpu.ops.registry import OpDef
+from mxnet_tpu.program_cache import attr_cache_stable
+
+
+def _two_fc():
+    """Two same-shape FC layers: aliasing one weight cell onto the
+    other keeps every shape consistent (the donation fixture must trip
+    DA201 alone, not a shape rule)."""
+    d = mx.sym.var("data")
+    h = mx.sym.FullyConnected(d, num_hidden=16, name="fc1")
+    h = mx.sym.Activation(h, act_type="relu", name="r1")
+    h = mx.sym.FullyConnected(h, num_hidden=16, name="fc2")
+    return mx.sym.SoftmaxOutput(h, name="softmax")
+
+
+def _fused_module():
+    mod = mx.mod.Module(_two_fc(), context=mx.cpu())
+    mod.bind(data_shapes=[("data", (8, 16))],
+             label_shapes=[("softmax_label", (8,))])
+    mod.init_params()
+    mod.init_optimizer(kvstore=None)
+    assert mod._fused_armed
+    return mod
+
+
+def _mlp():
+    d = mx.sym.var("data")
+    h = mx.sym.FullyConnected(d, num_hidden=8, name="fc1")
+    h = mx.sym.Activation(h, act_type="relu", name="r1")
+    h = mx.sym.FullyConnected(h, num_hidden=4, name="fc2")
+    return mx.sym.SoftmaxOutput(h, name="softmax")
+
+
+# ------------------------------------------------------ seeded fixtures
+def test_fixture_use_after_donation():
+    """Aliasing a second arg name onto a donated param cell trips DA201
+    and nothing else."""
+    mod = _fused_module()
+    exe = mod._exec_group.executor
+    i1 = exe.arg_names.index("fc1_weight")
+    i2 = exe.arg_names.index("fc2_weight")
+    exe.arg_arrays[i2] = exe.arg_arrays[i1]
+    report = lint_module(mod)
+    assert report.rules == {"DA201"}
+    assert len(report) == 1
+    d = report.errors[0]
+    assert "fc1_weight" in d.message and "fc2_weight" in d.message
+
+
+def test_fixture_nondeterministic_bucket_order():
+    """Equal-priority keys staged from two push calls in one window
+    trip CO301 (multiworker audit) and nothing else."""
+    sched = BucketScheduler(lambda x: x, lambda k, c, v: None,
+                            lambda: 1 << 30)
+    sched.note_push_call()
+    sched.stage(3, None, np.zeros(4, np.float32), priority=0)
+    sched.note_push_call()
+    sched.stage(5, None, np.zeros(4, np.float32), priority=0)
+    report = run_passes(AnalysisContext(sched=sched,
+                                        assume_multiworker=True))
+    assert report.rules == {"CO301"}
+    assert len(report) == 1
+    # same plan is fine on a single worker (no divergence possible)
+    assert not len(run_passes(AnalysisContext(sched=sched)))
+
+
+def test_fixture_cache_churn_attr():
+    """An array-valued op attr trips RC401 and nothing else."""
+    net = _mlp()
+    node = net._outputs[0][0]
+    node.attrs["debug_buffer"] = np.arange(3)
+    report = lint_symbol(net, shapes={"data": (2, 8)})
+    assert report.rules == {"RC401"}
+    assert len(report) == 1
+    assert "debug_buffer" in report.warnings[0].message
+
+
+# -------------------------------------------------- zero-false-positive
+MODEL_SHAPES = [
+    ("mlp", lambda m: m.mlp.get_symbol(10), {"data": (8, 784)}),
+    ("lenet", lambda m: m.lenet.get_symbol(10), {"data": (8, 1, 28, 28)}),
+    ("alexnet", lambda m: m.alexnet.get_symbol(10),
+     {"data": (2, 3, 224, 224)}),
+    ("vgg16", lambda m: m.vgg.get_symbol(10, 16),
+     {"data": (1, 3, 224, 224)}),
+    ("resnet20", lambda m: m.resnet.get_symbol(10, 20, "3,32,32"),
+     {"data": (4, 3, 32, 32)}),
+    ("inception_bn", lambda m: m.inception_bn.get_symbol(10),
+     {"data": (1, 3, 224, 224)}),
+    ("inception_v3", lambda m: m.inception_v3.get_symbol(10),
+     {"data": (1, 3, 299, 299)}),
+]
+
+
+@pytest.mark.parametrize("name,build,shapes", MODEL_SHAPES,
+                         ids=[m[0] for m in MODEL_SHAPES])
+def test_bundled_models_lint_clean(name, build, shapes):
+    from mxnet_tpu import models
+    report = lint_symbol(build(models), shapes=shapes)
+    assert not len(report), f"{name}: {report.format()}"
+
+
+def test_fused_module_lint_clean():
+    """The plain fused (replicated) arrangement has zero findings."""
+    report = lint_module(_fused_module())
+    assert not len(report), report.format()
+
+
+def test_zero_scan_config_lint_clean():
+    """The ZeRO-1 + K-step-scan arrangement on the 8-device mesh —
+    the config test_zero/test_scan_fit exercise — has zero findings."""
+    X = np.random.rand(32, 8).astype(np.float32)
+    Y = np.zeros(32, np.float32)
+    it = mx.io.NDArrayIter(X, Y, batch_size=8, label_name="softmax_label")
+    mod = mx.mod.Module(_mlp(), context=[mx.cpu(i) for i in range(8)])
+    mod.fit(it, num_epoch=1, zero_stage=1, steps_per_dispatch=2,
+            kvstore=None)
+    assert mod._exec_group._zero_plan is not None
+    report = lint_module(mod)
+    assert not len(report), report.format()
+
+
+def test_kvstore_bucket_plan_lint_clean():
+    """Module.update's push contract — ONE call, distinct priorities —
+    audits clean even under the multiworker assumption."""
+    kv = mx.kv.create("dist_sync")
+    try:
+        kv.init(0, mx.nd.zeros((4,)))
+        kv.init(1, mx.nd.zeros((4,)))
+        kv.push([1, 0], [mx.nd.ones((4,)), mx.nd.ones((4,))],
+                priority=[1, 0])
+        kv.pull([0, 1], [mx.nd.zeros((4,)), mx.nd.zeros((4,))])
+        report = run_passes(AnalysisContext(kvstore=kv, sched=kv._sched,
+                                            assume_multiworker=True))
+        assert not len(report), report.format()
+    finally:
+        kv.close()
+
+
+# -------------------------------------------------------- graph verifier
+def test_gv_duplicate_variable():
+    a = mx.sym.var("x")
+    b = mx.sym.var("x")
+    report = lint_symbol(a + b)
+    assert report.rules == {"GV103"}
+
+
+def test_gv_duplicate_node_name():
+    d = mx.sym.var("data")
+    h = mx.sym.FullyConnected(d, weight=mx.sym.var("w1"),
+                              bias=mx.sym.var("b1"), num_hidden=4,
+                              name="fc")
+    h = mx.sym.FullyConnected(h, weight=mx.sym.var("w2"),
+                              bias=mx.sym.var("b2"), num_hidden=4,
+                              name="fc")
+    report = lint_symbol(h, shapes={"data": (2, 4)})
+    assert report.rules == {"GV104"}
+
+
+def test_gv_inference_conflict_is_error():
+    a = mx.sym.var("a")
+    b = mx.sym.var("b")
+    report = lint_symbol(a + b, shapes={"a": (2, 3), "b": (4, 5)})
+    assert report.rules == {"GV101"}
+    msg = report.errors[0].message
+    assert "_plus" in msg and "(2, 3)" in msg and "(4, 5)" in msg
+
+
+def test_gv_stall_without_infer_shape():
+    """An op with neither infer_shape nor shape_passthrough stalls on a
+    partial input shape -> GV107 names the op."""
+    d = mx.sym.var("data", shape=(0, 5))     # batch unknown
+    net = mx.sym.Flatten(d)
+    report = lint_symbol(net)
+    assert "GV107" in report.rules
+    assert any(f.op == "Flatten" for f in report)
+
+
+def test_gv_shape_passthrough_flag_infers_and_silences():
+    """softmax declares shape_passthrough: partial shapes flow through
+    it (forward and backward) and GV107 stays quiet."""
+    d = mx.sym.var("data", shape=(0, 7))
+    net = mx.sym.softmax(d)
+    report = lint_symbol(net)
+    assert "GV107" not in report.rules
+    # and the flag actually propagates shapes both ways
+    _, outs, _ = net.infer_shape_partial(data=(4, 7))
+    assert outs == [(4, 7)]
+
+
+def test_gv_dtype_conflict():
+    d = mx.sym.var("data", dtype="float16")
+    net = mx.sym.FullyConnected(d, num_hidden=4, name="fc")
+    exe = net.simple_bind(ctx=mx.cpu(), data=(2, 8), validate=None)
+    from mxnet_tpu.analysis import lint_executor
+    report = lint_executor(exe)
+    assert "GV105" in report.rules
+
+
+def test_json_dead_node_and_dangling_input():
+    doc = {"nodes": [
+        {"op": "null", "name": "a", "inputs": []},
+        {"op": "null", "name": "dead", "inputs": []},
+        {"op": "_copy", "name": "c", "inputs": [[0, 0, 0]]}],
+        "arg_nodes": [0, 1], "heads": [[2, 0, 0]]}
+    report = lint_json(json.dumps(doc))
+    assert "GV108" in report.rules
+    assert any(f.node == "dead" for f in report)
+
+    doc2 = {"nodes": [{"op": "_copy", "name": "c",
+                       "inputs": [[5, 0, 0]]}],
+            "arg_nodes": [], "heads": [[0, 0, 0]]}
+    report2 = lint_json(json.dumps(doc2))
+    assert "GV106" in report2.rules
+
+
+def test_saved_symbol_roundtrip_lints_clean(tmp_path):
+    net = _mlp()
+    path = tmp_path / "mlp-symbol.json"
+    net.save(str(path))
+    report = lint_json(path.read_text(), shapes={"data": (8, 8)})
+    assert not len(report), report.format()
+
+
+# ------------------------------------------------- donation / collective
+def test_da_donated_param_as_label_input():
+    mod = _fused_module()
+    g = mod._exec_group
+    g.label_names = list(g.label_names) + ["fc1_weight"]
+    report = lint_module(mod)
+    assert report.rules == {"DA203"}
+
+
+def test_da_shared_cells_with_fused_plan():
+    mod = _fused_module()
+    mod._exec_group._shared_param_names = {"fc1_weight"}
+    report = lint_module(mod)
+    assert report.rules == {"DA202"}
+
+
+def test_da_bucket_buffer_alias():
+    sched = BucketScheduler(lambda x: x, lambda k, c, v: None,
+                            lambda: 1 << 30)
+    buf = np.zeros(4, np.float32)
+    sched.note_push_call()
+    sched.stage(0, None, buf, priority=1)
+    sched.stage(1, None, buf, priority=0)
+    report = run_passes(AnalysisContext(sched=sched))
+    assert report.rules == {"DA204"}
+
+
+def test_co_watched_order_mismatch():
+    mod = _fused_module()
+    mod._exec_group._fused_watched = \
+        list(reversed(mod._exec_group._fused_watched))
+    report = lint_module(mod)
+    assert report.rules == {"CO303"}
+
+
+def test_co_zero_plan_with_dist_kvstore():
+    mod = _fused_module()
+    kv = mx.kv.create("dist_sync")
+    try:
+        from mxnet_tpu.parallel.zero import ZeroPlan
+        mod._exec_group._zero_plan = ZeroPlan.__new__(ZeroPlan)
+        mod._exec_group._zero_plan.axis = "data"
+        mod._exec_group._zero_plan.n = 8
+        mod._kvstore = kv
+        report = lint_module(mod)
+        assert "CO302" in report.rules
+    finally:
+        mod._kvstore = None
+        kv.close()
+
+
+# ------------------------------------------------------------- host sync
+def test_hs_naive_engine(monkeypatch):
+    monkeypatch.setenv("MXNET_ENGINE_TYPE", "NaiveEngine")
+    net = _mlp()
+    exe = net.simple_bind(ctx=mx.cpu(), data=(2, 8), validate=None)
+    from mxnet_tpu.analysis import lint_executor
+    report = lint_executor(exe)
+    assert report.rules == {"HS501"}
+
+
+def test_hs_monitor_tap_is_info():
+    net = _mlp()
+    exe = net.simple_bind(ctx=mx.cpu(), data=(2, 8), validate=None)
+    exe.set_monitor_callback(lambda name, arr: None)
+    from mxnet_tpu.analysis import lint_executor
+    report = lint_executor(exe)
+    assert report.rules == {"HS502"}
+    assert report.infos and not report.errors and not report.warnings
+
+
+# ------------------------------------------------------- retrace / cache
+def test_rc_uncacheable_binding():
+    net = _mlp()
+    exe = net.simple_bind(ctx=mx.cpu(), data=(2, 8), validate=None)
+    exe._prog_cache_base = None
+    from mxnet_tpu.analysis import lint_executor
+    report = lint_executor(exe)
+    assert report.rules == {"RC402"}
+
+
+def test_attr_cache_stable_predicate():
+    assert attr_cache_stable(3)[0]
+    assert attr_cache_stable("relu")[0]
+    assert attr_cache_stable((1, 2, 3))[0]
+    assert attr_cache_stable(1.5)[0]
+    assert not attr_cache_stable(float("nan"))[0]
+    assert not attr_cache_stable(np.arange(2))[0]
+    assert not attr_cache_stable(lambda x: x)[0]
+    assert not attr_cache_stable(object())[0]
+
+
+# ------------------------------------------------------ surfaces / modes
+def test_bind_validate_raise_mode():
+    a = mx.sym.var("a")
+    b = mx.sym.var("b")
+    bad = a + b
+    with pytest.raises(mx.MXNetError, match="GV101"):
+        bad.bind(mx.cpu(), args={"a": mx.nd.ones((2, 3)),
+                                 "b": mx.nd.ones((4, 5))},
+                 validate="raise")
+
+
+def test_bind_validate_warn_mode_logs(caplog):
+    a = mx.sym.var("a")
+    b = mx.sym.var("b")
+    bad = a + b
+    with caplog.at_level(logging.WARNING, logger="mxnet_tpu.analysis"):
+        exe = bad.bind(mx.cpu(), args={"a": mx.nd.ones((2, 3)),
+                                       "b": mx.nd.ones((4, 5))},
+                       validate="warn")
+    assert exe is not None          # warn mode never blocks the bind
+    assert any("GV101" in rec.message for rec in caplog.records)
+
+
+def test_env_validate_mode(monkeypatch):
+    monkeypatch.setenv("MXNET_GRAPH_VALIDATE", "raise")
+    a = mx.sym.var("a")
+    b = mx.sym.var("b")
+    with pytest.raises(mx.MXNetError, match="GV101"):
+        (a + b).bind(mx.cpu(), args={"a": mx.nd.ones((2, 3)),
+                                     "b": mx.nd.ones((4, 5))})
+    # per-call override beats the env
+    exe = (a + b).bind(mx.cpu(), args={"a": mx.nd.ones((2, 3)),
+                                       "b": mx.nd.ones((4, 5))},
+                       validate="warn")
+    assert exe is not None
+
+
+def test_lint_disable_suppression(monkeypatch):
+    net = _mlp()
+    node = net._outputs[0][0]
+    node.attrs["debug_buffer"] = np.arange(3)
+    monkeypatch.setenv("MXNET_LINT_DISABLE", "RC401")
+    assert not len(lint_symbol(net, shapes={"data": (2, 8)}))
+    monkeypatch.setenv("MXNET_LINT_DISABLE", "retrace_churn")
+    assert not len(lint_symbol(net, shapes={"data": (2, 8)}))
+    monkeypatch.setenv("MXNET_LINT_DISABLE", "all")
+    assert not len(lint_symbol(net, shapes={"data": (2, 8)}))
+    monkeypatch.delenv("MXNET_LINT_DISABLE")
+    assert len(lint_symbol(net, shapes={"data": (2, 8)})) == 1
+
+
+def test_findings_mirror_into_telemetry():
+    from mxnet_tpu.telemetry import flightrec, metrics
+    mod = _fused_module()
+    exe = mod._exec_group.executor
+    i1 = exe.arg_names.index("fc1_weight")
+    i2 = exe.arg_names.index("fc2_weight")
+    exe.arg_arrays[i2] = exe.arg_arrays[i1]
+    before = metrics.get_metric("analysis.lint.findings", rule="DA201",
+                                severity="error")
+    base = before.value if before else 0
+    flightrec.clear()
+    lint_module(mod)
+    after = metrics.get_metric("analysis.lint.findings", rule="DA201",
+                               severity="error")
+    assert after is not None and after.value == base + 1
+    recs = [r for r in flightrec.get_records()
+            if r.get("kind") == "lint.finding"]
+    assert recs and recs[-1]["rule"] == "DA201"
+
+
+def test_diagnose_renders_lint_findings(tmp_path):
+    """tools/diagnose.py shows lint findings in a crash report."""
+    import os
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "tools"))
+    try:
+        import diagnose
+    finally:
+        sys.path.pop(0)
+    report = {
+        "type": "crash_report", "time": "t", "pid": 1, "where": "bind",
+        "ring": [{"kind": "lint.finding", "ts_us": 1, "rule": "DA201",
+                  "severity": "error", "node": "fc1_weight",
+                  "message": "one buffer is bound twice"}],
+        "metrics": {"counters":
+                    {'analysis.lint.findings{rule="DA201",'
+                     'severity="error"}': 1}},
+    }
+    path = tmp_path / "crash.json"
+    path.write_text(json.dumps(report))
+    text = diagnose.render_file(str(path))
+    assert "lint findings" in text and "DA201" in text
+
+
+def test_rule_catalog_consistency():
+    """Every rule id used in this file exists; severities are valid."""
+    for rule, (sev, title) in RULES.items():
+        assert sev in ("info", "warning", "error")
+        assert title
+
+
+# ------------------------------------------------------------ mxlint CLI
+def _mxlint_main():
+    import os
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "tools"))
+    try:
+        import mxlint
+    finally:
+        sys.path.pop(0)
+    return mxlint.main
+
+
+def test_mxlint_check_gate(capsys):
+    """The CI gate: every bundled model + the two example graphs lint
+    clean (exit 0). Runs mxlint in-process so tier-1 pays no second
+    interpreter/jax start-up."""
+    main = _mxlint_main()
+    assert main(["--check"]) == 0
+    out = capsys.readouterr().out
+    assert "models/resnet20" in out and "examples/dcgan.generator" in out
+    assert "0 error(s)" in out
+
+
+def test_mxlint_json_file_exit_codes(tmp_path, capsys):
+    main = _mxlint_main()
+    good = _mlp()
+    good_path = tmp_path / "good-symbol.json"
+    good.save(str(good_path))
+    assert main([str(good_path), "--shape", "data=8,8"]) == 0
+
+    bad = {"nodes": [{"op": "_copy", "name": "c",
+                      "inputs": [[5, 0, 0]]}],
+           "arg_nodes": [], "heads": [[0, 0, 0]]}
+    bad_path = tmp_path / "bad-symbol.json"
+    bad_path.write_text(json.dumps(bad))
+    assert main([str(bad_path)]) == 1          # nonzero on errors
+    out = capsys.readouterr().out
+    assert "GV106" in out
+
+    # warnings pass by default, fail under --strict
+    warn = {"nodes": [
+        {"op": "null", "name": "a", "inputs": []},
+        {"op": "null", "name": "dead", "inputs": []},
+        {"op": "_copy", "name": "c", "inputs": [[0, 0, 0]]}],
+        "arg_nodes": [0, 1], "heads": [[2, 0, 0]]}
+    warn_path = tmp_path / "warn-symbol.json"
+    warn_path.write_text(json.dumps(warn))
+    assert main([str(warn_path)]) == 0
+    assert main([str(warn_path), "--strict"]) == 1
+    assert main([]) == 2                        # nothing to lint
+
+
+def test_mxlint_rules_listing(capsys):
+    main = _mxlint_main()
+    assert main(["--rules"]) == 0
+    out = capsys.readouterr().out
+    for rule in RULES:
+        assert rule in out
+
+
+# -------------------------------- registration-time infer validation (S2)
+def test_register_validates_infer_shape_arity():
+    with pytest.raises(mx.MXNetError, match="badop.*infer_shape"):
+        OpDef("badop", lambda *a: ([], []),
+              infer_shape=lambda attrs: None)
+
+
+def test_register_validates_infer_type_arity():
+    with pytest.raises(mx.MXNetError, match="badop2.*infer_type"):
+        OpDef("badop2", lambda *a: ([], []),
+              infer_type=lambda: None)
+
+
+def test_register_rejects_required_kwonly():
+    with pytest.raises(mx.MXNetError, match="keyword-only"):
+        OpDef("badop3", lambda *a: ([], []),
+              infer_shape=lambda attrs, shapes, *, mode: None)
+
+
+def test_register_detects_out_known_capability():
+    op2 = OpDef("okop2", lambda *a: ([], []),
+                infer_shape=lambda attrs, shapes: (shapes, [shapes[0]], []))
+    assert op2._infer_accepts_out is False
+    op3 = OpDef("okop3", lambda *a: ([], []),
+                infer_shape=lambda attrs, shapes, out_known=None:
+                (shapes, [shapes[0]], []))
+    assert op3._infer_accepts_out is True
+    assert OpDef("okop4", lambda *a: ([], [])).shape_passthrough is False
+    assert OpDef("okop5", lambda *a: ([], []),
+                 shape_passthrough=True).shape_passthrough is True
+
+
+def test_registered_ops_all_validate():
+    """Every op already in the registry satisfies the registration-time
+    signature contract (the check ran at import; re-assert explicitly)."""
+    from mxnet_tpu.ops.registry import OP_REGISTRY, \
+        _validate_infer_signature
+    for name, op in OP_REGISTRY.items():
+        _validate_infer_signature(name, "infer_shape", op.infer_shape)
+        _validate_infer_signature(name, "infer_type", op.infer_type)

@@ -70,6 +70,32 @@ def test_symbol_infer_shape_partial():
     assert args["fc2_weight"] is None or args["fc2_weight"] == (64, 100)
 
 
+def test_infer_shape_mismatch_carries_provenance():
+    """A shape conflict names the failing op, node, input names, and the
+    shapes inferred so far — not just 'incompatible shapes (a) vs (b)'."""
+    import pytest
+    data = mx.sym.var("data")
+    fc1 = mx.sym.FullyConnected(data, name="fc1", num_hidden=8)
+    out = fc1 + mx.sym.var("skip")
+    with pytest.raises(mx.MXNetError) as err:
+        out.infer_shape(data=(4, 6), skip=(4, 9))
+    msg = str(err.value)
+    assert "_plus" in msg                      # op name
+    assert "fc1" in msg and "skip" in msg      # input provenance
+    assert "(4, 8)" in msg and "(4, 9)" in msg  # inferred-so-far shapes
+
+
+def test_infer_shape_bad_weight_names_node():
+    """Explicitly mis-shaped weights fail with the node's provenance."""
+    import pytest
+    data = mx.sym.var("data")
+    fc1 = mx.sym.FullyConnected(data, name="fc1", num_hidden=8)
+    with pytest.raises(mx.MXNetError) as err:
+        fc1.infer_shape(data=(4, 6), fc1_weight=(8, 999))
+    msg = str(err.value)
+    assert "FullyConnected" in msg and "fc1" in msg
+
+
 def test_symbol_json_roundtrip():
     net = _mlp()
     js = net.tojson()

@@ -213,6 +213,30 @@ def test_diagnose_jsonl_health_report(tmp_path):
     assert "batch time: mean 150.0 ms" in out
 
 
+def test_mxlint_cli_subprocess(tmp_path):
+    """The full mxlint CLI contract through a real interpreter: --check
+    over the bundled corpus exits 0; a corrupt symbol JSON exits 1 and
+    names the rule. (The fast in-process gate lives in
+    tests/test_analysis.py; this one proves the console entry point.)"""
+    cli = os.path.join(TOOLS, "mxlint.py")
+    r = subprocess.run([sys.executable, cli, "--check"],
+                       capture_output=True, text=True)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "models/resnet20" in r.stdout
+    assert "0 error(s)" in r.stdout
+
+    bad = tmp_path / "bad-symbol.json"
+    bad.write_text(json.dumps({
+        "nodes": [{"op": "_copy", "name": "c", "inputs": [[5, 0, 0]]}],
+        "arg_nodes": [], "heads": [[0, 0, 0]]}))
+    r2 = subprocess.run([sys.executable, cli, str(bad), "--json"],
+                        capture_output=True, text=True)
+    assert r2.returncode == 1, r2.stdout + r2.stderr
+    doc = json.loads(r2.stdout[r2.stdout.index("{"):])
+    assert doc["errors"] >= 1
+    assert any(f["rule"] == "GV106" for f in doc["findings"])
+
+
 def test_bandwidth_tool_local():
     r = subprocess.run(
         [sys.executable, os.path.join(TOOLS, "bandwidth.py"),

@@ -88,6 +88,36 @@ def _memory_section(mem):
     return ["memory watermarks:"] + (lines or ["  (no accounting data)"])
 
 
+def _lint_section(counters, lint_records):
+    """Static-analysis findings: counter totals per rule plus the most
+    recent finding records mirrored into the flight-recorder ring by
+    mxnet_tpu.analysis (bind-time validation / mxlint)."""
+    per_rule = {}
+    for series, val in (counters or {}).items():
+        name, labels = _strip_labels(series)
+        if name != "analysis.lint.findings":
+            continue
+        rule = "?"
+        for part in labels.split(","):
+            if part.startswith("rule="):
+                rule = part.split("=", 1)[1].strip('"')
+        per_rule[rule] = per_rule.get(rule, 0) + val
+    if not per_rule and not lint_records:
+        return ["lint findings: none recorded"]
+    total = int(sum(per_rule.values())) or len(lint_records)
+    lines = [f"lint findings: {total} recorded "
+             f"({', '.join(f'{r} x{int(n)}' for r, n in sorted(per_rule.items()))})"
+             if per_rule else f"lint findings: {total} recorded"]
+    for r in lint_records[-5:]:
+        node = f" at '{r['node']}'" if r.get("node") else ""
+        lines.append(f"  {r.get('rule', '?')} [{r.get('severity', '?')}]"
+                     f"{node}: {r.get('message', '')}")
+    if per_rule:
+        lines.append("  (rule catalog: docs/analysis.md; "
+                     "python tools/mxlint.py --rules)")
+    return lines
+
+
 def _anomaly_section(anoms):
     if not anoms:
         return ["anomalies: none recorded"]
@@ -145,6 +175,8 @@ def render_crash(report, top=10):
     ring = report.get("ring") or []
     anoms = [r for r in ring if r.get("kind") == "anomaly"]
     out += _anomaly_section(anoms)
+    out += _lint_section(metrics.get("counters") or {},
+                         [r for r in ring if r.get("kind") == "lint.finding"])
 
     # throughput from ring batch records
     batches = [r for r in ring if r.get("kind") == "module.fit.batch"
@@ -249,6 +281,9 @@ def render_jsonl(lines, top=10):
               "step": e.get("step"), "ts_us": e.get("ts_us", 0)}
              for e in events if e.get("kind") == "anomaly"]
     out += _anomaly_section(anoms)
+    out += _lint_section(counters,
+                         [e for e in events
+                          if e.get("kind") == "lint.finding"])
     out += _slowest_spans(spans, top)
 
     h = hists.get("module.fit.batch.seconds")

@@ -1,0 +1,206 @@
+#!/usr/bin/env python
+"""mxlint: lint symbol JSON files and bundled models for graph hazards.
+
+The CLI face of ``mxnet_tpu.analysis`` — the same five static-analysis
+passes that run at ``bind(validate=...)`` time (graph verifier,
+donation/aliasing, collective order, retrace churn, host sync), pointed
+at artifacts instead of live bindings:
+
+* a saved symbol JSON (``model-symbol.json``) — structural rules
+  (dangling inputs, dead nodes) plus the full pass set over the loaded
+  graph, optionally seeded with ``--shape name=1,3,224,224``;
+* ``--check`` — the CI gate: lints every bundled ``mxnet_tpu/models/``
+  symbol and the two ``examples/dcgan.py`` graphs under their canonical
+  input shapes, expecting zero findings.
+
+Exit status: 0 = no error-severity findings (``--strict``: no findings
+at all), 1 = findings at the failing severity, 2 = usage/IO trouble.
+Suppress rules with ``MXNET_LINT_DISABLE=GV107,HS501,...``.
+
+Usage:
+    python tools/mxlint.py model-symbol.json --shape data=1,3,224,224
+    python tools/mxlint.py --check
+    python tools/mxlint.py --rules
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+
+def _parse_shape_args(pairs):
+    shapes = {}
+    for pair in pairs or []:
+        if "=" not in pair:
+            raise ValueError(f"--shape expects name=d0,d1,..., got {pair!r}")
+        name, _, dims = pair.partition("=")
+        dims = dims.strip("() ")
+        shapes[name.strip()] = tuple(
+            int(d) for d in dims.replace(" ", "").split(",") if d)
+    return shapes
+
+
+# The CI gate's corpus: every bundled model plus the two example graphs,
+# each under its canonical input shapes (kept small — lint never runs
+# the graphs, it only infers over them).
+def _check_corpus():
+    from mxnet_tpu import models as _models
+
+    corpus = [
+        ("models/mlp", lambda: _models.mlp.get_symbol(10),
+         {"data": (8, 784)}),
+        ("models/lenet", lambda: _models.lenet.get_symbol(10),
+         {"data": (8, 1, 28, 28)}),
+        ("models/alexnet", lambda: _models.alexnet.get_symbol(10),
+         {"data": (2, 3, 224, 224)}),
+        ("models/vgg16", lambda: _models.vgg.get_symbol(10, 16),
+         {"data": (1, 3, 224, 224)}),
+        ("models/resnet20", lambda: _models.resnet.get_symbol(
+            10, 20, "3,32,32"), {"data": (4, 3, 32, 32)}),
+        ("models/inception_bn", lambda: _models.inception_bn.get_symbol(10),
+         {"data": (1, 3, 224, 224)}),
+        ("models/inception_v3", lambda: _models.inception_v3.get_symbol(10),
+         {"data": (1, 3, 299, 299)}),
+    ]
+
+    def _dcgan(which):
+        examples_dir = os.path.join(_REPO_ROOT, "examples")
+        if examples_dir not in sys.path:
+            sys.path.insert(0, examples_dir)
+        import dcgan
+        if which == "generator":
+            return dcgan.make_generator()
+        return dcgan.make_discriminator()
+
+    corpus.append(("examples/dcgan.generator",
+                   lambda: _dcgan("generator"), {"rand": (2, 64, 1, 1)}))
+    corpus.append(("examples/dcgan.discriminator",
+                   lambda: _dcgan("discriminator"),
+                   {"data": (2, 3, 32, 32), "label": (2, 1)}))
+    return corpus
+
+
+def run_check(out, as_json=False):
+    """Lint the bundled corpus; returns the merged findings list."""
+    from mxnet_tpu import analysis
+
+    findings = []
+    for name, build, shapes in _check_corpus():
+        try:
+            report = analysis.lint_symbol(build(), shapes=shapes)
+        except Exception as e:  # noqa: BLE001 — a crashing build is a failure
+            findings.append({"target": name, "rule": "XX001",
+                             "severity": "error",
+                             "message": f"could not build/lint: "
+                                        f"{type(e).__name__}: {e}"})
+            continue
+        for d in report:
+            rec = d.as_dict()
+            rec["target"] = name
+            findings.append(rec)
+        if not as_json:
+            status = "ok" if not len(report) else \
+                f"{len(report)} finding(s)"
+            print(f"  {name:<32} {status}", file=out)
+    return findings
+
+
+def lint_path(path, shapes, out, as_json=False):
+    """Lint one symbol JSON file; returns the findings list."""
+    from mxnet_tpu import analysis
+
+    with open(path) as f:
+        text = f.read()
+    report = analysis.lint_json(text, shapes=shapes or None)
+    findings = []
+    for d in report:
+        rec = d.as_dict()
+        rec["target"] = path
+        findings.append(rec)
+    if not as_json:
+        status = "ok" if not len(report) else f"{len(report)} finding(s)"
+        print(f"  {path:<32} {status}", file=out)
+    return findings
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        prog="mxlint",
+        description="Static graph verifier & hazard linter "
+                    "(mxnet_tpu.analysis) over symbol JSON files and the "
+                    "bundled model zoo.")
+    p.add_argument("paths", nargs="*",
+                   help="symbol JSON files (e.g. model-symbol.json)")
+    p.add_argument("--check", action="store_true",
+                   help="lint the bundled models + example graphs "
+                        "(the CI gate)")
+    p.add_argument("--shape", action="append", metavar="NAME=D0,D1,...",
+                   help="seed an input shape for inference "
+                        "(repeatable)")
+    p.add_argument("--rules", action="store_true",
+                   help="print the rule catalog and exit")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="emit findings as one JSON document")
+    p.add_argument("--strict", action="store_true",
+                   help="exit nonzero on ANY finding (default: errors "
+                        "only)")
+    args = p.parse_args(argv)
+    out = sys.stdout
+
+    if args.rules:
+        from mxnet_tpu.analysis import RULES
+        for rule in sorted(RULES):
+            sev, title = RULES[rule]
+            print(f"{rule}  [{sev:<7}] {title}", file=out)
+        return 0
+
+    if not args.check and not args.paths:
+        p.print_usage(file=sys.stderr)
+        print("mxlint: nothing to lint (pass symbol JSON paths or "
+              "--check)", file=sys.stderr)
+        return 2
+
+    try:
+        shapes = _parse_shape_args(args.shape)
+    except ValueError as e:
+        print(f"mxlint: {e}", file=sys.stderr)
+        return 2
+
+    findings = []
+    try:
+        if args.check:
+            findings += run_check(out, as_json=args.as_json)
+        for path in args.paths:
+            findings += lint_path(path, shapes, out, as_json=args.as_json)
+    except FileNotFoundError as e:
+        print(f"mxlint: {e}", file=sys.stderr)
+        return 2
+
+    errors = [f for f in findings if f["severity"] == "error"]
+    if args.as_json:
+        json.dump({"findings": findings, "errors": len(errors)}, out,
+                  indent=2)
+        print(file=out)
+    else:
+        for f in findings:
+            where = f" at node '{f['node']}'" if f.get("node") else ""
+            print(f"{f['target']}: {f['rule']} [{f['severity']}]"
+                  f"{where}: {f['message']}", file=out)
+            if f.get("hint"):
+                print(f"    hint: {f['hint']}", file=out)
+        print(f"mxlint: {len(findings)} finding(s), {len(errors)} "
+              f"error(s)", file=out)
+
+    if errors or (args.strict and findings):
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
