@@ -14,6 +14,7 @@ Rule id scheme (the NNVM-pass analog of compiler warning numbers):
 * ``CO3xx`` — collective dispatch order
 * ``RC4xx`` — retrace / program-cache churn
 * ``HS5xx`` — host synchronization in the fit hot path
+* ``MF6xx`` — MFU/cost-metadata coverage
 * ``XX0xx`` — analysis-infrastructure notices
 
 Severities: ``error`` (the program is wrong or will crash/deadlock),
@@ -73,6 +74,9 @@ RULES = {
                       "an output every step"),
     "HS504": ("info", "MXNET_FUSED_KEEP_GRADS materializes every "
                       "gradient as a program output"),
+    # ---- MFU coverage ---------------------------------------------------
+    "MF601": ("info", "op has no flops/bytes cost metadata (invisible "
+                      "to MFU/roofline accounting)"),
     # ---- infrastructure -------------------------------------------------
     "XX001": ("info", "an analysis pass failed to run"),
 }

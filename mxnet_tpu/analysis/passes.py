@@ -564,6 +564,43 @@ def host_sync(ctx, out):
             hint="unset it unless something reads grad_dict mid-run"))
 
 
+def mfu_coverage(ctx, out):
+    """MF601: ops with nodes in this graph but no cost metadata.
+
+    The MFU/roofline accounting (telemetry/mfu.py) folds per-op
+    ``flops``/``bytes_moved`` estimators over the graph; an op without
+    them silently under-counts every step it runs. One info finding per
+    distinct op keeps the coverage gap visible (registry-wide audit:
+    ``tools/mxlint.py --mfu-audit``).
+    """
+    sym = ctx.symbol
+    if sym is None and ctx.executor is not None:
+        sym = ctx.executor._symbol
+    if sym is None:
+        return
+
+    def compute():
+        missing = {}
+        for node in sym._topo_nodes():
+            if node.is_variable:
+                continue
+            if not node.opdef().has_cost():
+                missing.setdefault(node.op, (node.name, 0))
+                nm, n = missing[node.op]
+                missing[node.op] = (nm, n + 1)
+        return missing
+
+    missing = _symbol_memo(sym, "mfu_coverage", True, compute)
+    for op, (first_node, n) in sorted(missing.items()):
+        out.append(Diagnostic(
+            "MF601", f"op {op!r} ({n} node(s)) carries no flops/bytes "
+            "cost metadata; MFU and roofline reports under-count it",
+            node=first_node, op=op,
+            hint="seed an estimator in ops/cost.py (or "
+                 "OpDef.set_cost); list all gaps with "
+                 "tools/mxlint.py --mfu-audit"))
+
+
 #: pass name -> callable(ctx, out_list); order is the report order
 PASSES = OrderedDict([
     ("graph_verifier", graph_verifier),
@@ -571,6 +608,7 @@ PASSES = OrderedDict([
     ("collective_order", collective_order),
     ("retrace_churn", retrace_churn),
     ("host_sync", host_sync),
+    ("mfu_coverage", mfu_coverage),
 ])
 
 

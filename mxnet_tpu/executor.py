@@ -38,6 +38,7 @@ from .base import MXNetError
 from .context import current_context
 from .ndarray import NDArray, zeros as nd_zeros
 from .ops.registry import get_op
+from . import kernel_tier as _kernel_tier
 from . import program_cache as _progcache
 from . import random as _random
 from . import telemetry as _telemetry
@@ -196,6 +197,15 @@ def _build_graph_runner(symbol, shape_overrides=None, tap=None, mp_plan=None,
         # real per-op wall time, the reference's per-op profile records.
         if _telemetry.enabled():
             _telemetry.counter("executor.op_dispatch", op=node.op).inc()
+            # cost attribution rides the same trace-time hook: per-op
+            # FLOPs/bytes totals for one program execution accumulate
+            # under the op label (telemetry/mfu.py reads them back)
+            op_cost = opdef.cost(attrs, [tuple(v.shape) for v in regular])
+            if op_cost is not None:
+                _telemetry.counter("executor.op_flops",
+                                   op=node.op).inc(op_cost[0])
+                _telemetry.counter("executor.op_bytes",
+                                   op=node.op).inc(op_cost[1])
             op_span = _telemetry.span("op." + node.op, node=node.name)
         else:
             op_span = _telemetry.null_span
@@ -210,8 +220,8 @@ def _build_graph_runner(symbol, shape_overrides=None, tap=None, mp_plan=None,
             if out_tags is None:
                 regular = [_layout.to_nchw(x) if t else x
                            for x, t in zip(regular, in_tags)]
-                outs, aux_out = opdef.forward(attrs, regular, aux,
-                                              is_train, krng)
+                outs, aux_out = _kernel_tier.dispatch(
+                    opdef, attrs, regular, aux, is_train, krng)
                 out_tags = [False] * len(outs)
         for j, t in enumerate(out_tags):
             entry_tags[(i, j)] = t
@@ -828,6 +838,21 @@ class Executor:
                         self.grad_req, new_aux, self._group2ctx,
                         compute_dtype=self._compute_dtype,
                         mirror=self._remat_segments or 0)
+
+    def cost_table(self, train=None):
+        """Per-op FLOPs/bytes attribution for this binding's shapes
+        (telemetry/mfu.py). ``train`` defaults to whether gradients are
+        watched. Returns None when shapes can't be inferred."""
+        from .telemetry import mfu as _mfu
+        if train is None:
+            train = bool(self._watched())
+        shapes = {nm: tuple(a.shape)
+                  for nm, a in zip(self.arg_names, self.arg_arrays)
+                  if a is not None}
+        try:
+            return _mfu.cost_table(self._symbol, shapes, train=train)
+        except Exception:
+            return None
 
     def set_monitor_callback(self, callback):
         self._monitor_callback = callback

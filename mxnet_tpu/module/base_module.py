@@ -13,6 +13,8 @@ import logging
 import os
 import time
 
+import numpy as np
+
 from .. import metric as metric_mod
 from .. import ndarray as nd
 from .. import telemetry as _telemetry
@@ -78,6 +80,37 @@ class BaseModule:
                          force_init=force_init)
         self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
                             optimizer_params=optimizer_params)
+        self._mfu_profile = self._build_mfu_profile(train_data)
+
+    def _build_mfu_profile(self, train_data):
+        """(train FLOPs/batch, peak FLOP/s or None) from the op cost
+        metadata + the optimizer update — the per-batch MFU gauge's
+        numerator and denominator (telemetry/mfu.py). Best-effort:
+        anything missing (no symbol, partial shapes) disables the gauge
+        rather than guessing."""
+        try:
+            sym = getattr(self, "_symbol", None) or self.symbol
+            if sym is None:
+                return None
+            shapes = {nm: tuple(s) for nm, s in
+                      list(train_data.provide_data) +
+                      list(train_data.provide_label or [])}
+            table = _telemetry.mfu.cost_table(sym, shapes, train=True)
+            flops = table["train_flops"]
+            if not flops:
+                return None
+            opt = getattr(self, "_optimizer", None)
+            if opt is not None:
+                from ..ops.cost import optimizer_flops
+                n_params = sum(
+                    int(np.prod(a.shape)) for a in
+                    (getattr(self, "_arg_params", None) or {}).values())
+                flops += optimizer_flops(type(opt).__name__, n_params)
+            peak, _bw = _telemetry.mfu.device_peaks()
+            _telemetry.mfu.record_gauges(table, train=True)
+            return flops, peak
+        except Exception:
+            return None
 
     def _scan_window_size(self):
         """Batches advanced per device dispatch by the fit loop; 1 means
@@ -107,6 +140,7 @@ class BaseModule:
                     "batch_end", epoch=epoch, nbatch=nbatch,
                     duration_us=batch_span.dur,
                     batch_size=getattr(train_data, "batch_size", 0))
+                self._note_mfu(batch_span.dur)
             else:
                 # the span tracer is off (the production default) — the
                 # always-on flight ring still gets a batch timeline so a
@@ -192,6 +226,20 @@ class BaseModule:
         for b in pending:                   # partial tail window
             run_single(b)
 
+    def _note_mfu(self, dur_us):
+        """Model-level MFU gauge per batch: attributed train FLOPs over
+        measured batch time, against the device peak when one is known
+        (telemetry/mfu.py). Achieved-FLOP/s records even without a peak
+        (CPU runs still get a throughput-in-FLOPs signal)."""
+        prof = getattr(self, "_mfu_profile", None)
+        if not prof or not dur_us:
+            return
+        flops, peak = prof
+        secs = dur_us / 1e6
+        _telemetry.gauge("mfu.achieved_flops_per_sec").set(flops / secs)
+        if peak:
+            _telemetry.gauge("mfu.model").set((flops / secs) / peak)
+
     def _note_batch(self, epoch, nbatch, dur_us, batch_size):
         """Per-logical-batch telemetry shared by both fit loops."""
         if _telemetry.enabled():
@@ -199,6 +247,7 @@ class BaseModule:
             _telemetry.record_event(
                 "batch_end", epoch=epoch, nbatch=nbatch,
                 duration_us=dur_us, batch_size=batch_size)
+            self._note_mfu(dur_us)
         else:
             _telemetry.flightrec.note(
                 "module.fit.batch", epoch=epoch, nbatch=nbatch,

@@ -455,7 +455,9 @@ def imperative_invoke(op_name, *inputs, out=None, **kwargs):
     regular, aux = (arrs[:len(arrs) - aux_n], arrs[len(arrs) - aux_n:]) \
         if aux_n else (arrs, [])
     rng = _random.next_key() if opdef.need_rng else None
-    outputs, new_aux = opdef.forward(attrs, regular, aux, False, rng)
+    from . import kernel_tier as _kernel_tier
+    outputs, new_aux = _kernel_tier.dispatch(opdef, attrs, regular, aux,
+                                             False, rng)
     ctx = inputs[0].context if inputs and isinstance(inputs[0], NDArray) \
         else current_context()
     # mutate-input ops (sgd_update etc.): swap new buffer into input handle

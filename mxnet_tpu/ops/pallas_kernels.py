@@ -1,0 +1,557 @@
+"""Production Pallas kernels, shipped through the kernel tier.
+
+Three fused kernels the paper's L1 story names as "Pallas where XLA
+fusion loses" (SURVEY §7), each registered as a ``variants["pallas"]``
+alternative on an op whose ``forward`` stays the exact XLA composition:
+
+* **fused softmax-cross-entropy** — a ``SoftmaxOutput`` variant: one
+  row-block kernel for the forward softmax and one for the loss-head
+  backward ``(p - onehot) * mask * scale`` (the op's custom-VJP
+  contract: the incoming head cotangent is ignored);
+* **fused conv+BN+ReLU** — a new ``FusedConvBNReLU`` op consuming the
+  existing BatchNorm aux-state contract (moving_mean/moving_var swap
+  after every training forward). The convolution itself stays on the
+  MXU through ``lax.conv`` (XLA is already optimal there); the Pallas
+  half fuses the whole BN epilogue — per-channel statistics reduction
+  plus normalize+affine+ReLU — into two HBM passes instead of XLA's
+  stat/normalize/activation chain;
+* **fused optimizer updates** — ``sgd_mom_update`` (promoted from the
+  rtc.py correctness demo) and ``adam_update`` variants: the whole
+  elementwise update in one tiled VMEM pass per parameter.
+
+Every kernel carries a custom VJP. Where a hand backward kernel exists
+(softmax-CE) it is used; elsewhere the backward recomputes through the
+XLA composition under ``jax.custom_vjp`` (the flash-attention recompute
+pattern — numerics match training through either tier by construction).
+Selection is never static: the tier autotunes per shape on TPU and
+falls back to XLA everywhere else (kernel_tier.py).
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from ..base import parse_bool, parse_float, parse_int
+from .registry import OP_REGISTRY, get_op, register
+
+__all__ = ["pallas_call", "pallas_sgd_mom_update", "pallas_adam_update",
+           "fused_softmax_ce", "fused_conv_bn_relu"]
+
+
+def _interpret():
+    """Mosaic-compile on TPU; interpret elsewhere (CPU test mesh)."""
+    return jax.default_backend() != "tpu"
+
+
+def pallas_call(kernel, out_shape, **kwargs):
+    """``pl.pallas_call`` with backend-appropriate compile/interpret."""
+    kwargs.setdefault("interpret", _interpret())
+    return pl.pallas_call(kernel, out_shape=out_shape, **kwargs)
+
+
+def _divisor_block(n, cap):
+    """Largest divisor of n that is <= cap (grid blocks must tile n)."""
+    b = min(int(cap), int(n))
+    while n % b:
+        b -= 1
+    return b
+
+
+def _xla_recompute_vjp(pallas_fn, xla_fn, n_diff):
+    """custom_vjp wrapper: Pallas forward, XLA-composition backward.
+
+    ``n_diff`` positional args are differentiable; both fns map them to
+    the same output pytree. The recompute keeps training numerics
+    identical through either tier without a hand-written backward."""
+    @jax.custom_vjp
+    def fn(*args):
+        return pallas_fn(*args)
+
+    def fwd(*args):
+        return fn(*args), args
+
+    def bwd(args, cts):
+        _, vjp_fn = jax.vjp(lambda *a: xla_fn(*a), *args[:n_diff])
+        return vjp_fn(cts) + (None,) * (len(args) - n_diff)
+
+    fn.defvjp(fwd, bwd)
+    return fn
+
+
+# ==========================================================================
+# fused softmax cross-entropy (SoftmaxOutput pallas variant)
+# ==========================================================================
+def _softmax_fwd_kernel(x_ref, o_ref):
+    x = x_ref[...].astype(jnp.float32)
+    m = jnp.max(x, axis=-1, keepdims=True)
+    e = jnp.exp(x - m)
+    o_ref[...] = (e / jnp.sum(e, axis=-1, keepdims=True)).astype(
+        o_ref.dtype)
+
+
+def _softmax_ce_bwd_kernel(scale, use_ignore, ignore_label):
+    def kernel(p_ref, l_ref, g_ref):
+        p = p_ref[...].astype(jnp.float32)
+        lab = l_ref[...].astype(jnp.int32)            # (block_n, 1)
+        classes = jax.lax.broadcasted_iota(jnp.int32, p.shape, 1)
+        onehot = (classes == lab).astype(jnp.float32)
+        g = p - onehot
+        if use_ignore:
+            keep = (l_ref[...].astype(jnp.float32) !=
+                    ignore_label).astype(jnp.float32)
+            g = g * keep                              # broadcasts (n, 1)
+        g_ref[...] = (g * scale).astype(g_ref.dtype)
+    return kernel
+
+
+def _row_blocks(n, c):
+    """Row-block size bounded by a ~2 MiB VMEM working set."""
+    cap = max(8, (2 << 20) // max(1, 4 * c))
+    return _divisor_block(n, min(256, cap))
+
+
+def _pl_softmax(data):
+    n, c = data.shape
+    bn = _row_blocks(n, c)
+    spec = pl.BlockSpec((bn, c), lambda i: (i, 0))
+    return pallas_call(
+        _softmax_fwd_kernel,
+        out_shape=jax.ShapeDtypeStruct(data.shape, data.dtype),
+        grid=(n // bn,), in_specs=[spec], out_specs=spec)(data)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _softmax_ce_fn(data, label, attrs_tuple):
+    return _pl_softmax(data)
+
+
+def _softmax_ce_fwd(data, label, attrs_tuple):
+    prob = _pl_softmax(data)
+    return prob, (prob, label)
+
+
+def _softmax_ce_bwd(attrs_tuple, res, g):
+    # loss-head contract (ops/loss.py): the incoming cotangent is
+    # ignored; the backward IS the cross-entropy gradient
+    prob, label = res
+    attrs = dict(attrs_tuple)
+    grad_scale = parse_float(attrs.get("grad_scale", 1.0))
+    use_ignore = parse_bool(attrs.get("use_ignore", False))
+    ignore_label = parse_float(attrs.get("ignore_label", -1.0))
+    normalization = attrs.get("normalization", "null")
+    n, c = prob.shape
+    scale = grad_scale / (n if normalization == "batch" else 1.0)
+    bn = _row_blocks(n, c)
+    lab2 = label.reshape(n, 1).astype(jnp.float32)
+    grad = pallas_call(
+        _softmax_ce_bwd_kernel(scale, use_ignore, ignore_label),
+        out_shape=jax.ShapeDtypeStruct(prob.shape, prob.dtype),
+        grid=(n // bn,),
+        in_specs=[pl.BlockSpec((bn, c), lambda i: (i, 0)),
+                  pl.BlockSpec((bn, 1), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((bn, c), lambda i: (i, 0)))(prob, lab2)
+    if normalization == "valid":
+        valid = jnp.sum((label != ignore_label).astype(jnp.float32)) \
+            if use_ignore else jnp.asarray(float(n), jnp.float32)
+        grad = grad / jnp.maximum(valid, 1.0).astype(grad.dtype)
+    return grad, jnp.zeros_like(label)
+
+
+_softmax_ce_fn.defvjp(_softmax_ce_fwd, _softmax_ce_bwd)
+
+
+def fused_softmax_ce(data, label, **attrs):
+    """Functional surface of the fused softmax-CE kernel (2-D data)."""
+    return _softmax_ce_fn(data, label, tuple(sorted(attrs.items())))
+
+
+def _softmax_ce_variant(attrs, inputs, aux, is_train, rng):
+    data, label = inputs
+    return [_softmax_ce_fn(data, label, tuple(sorted(attrs.items())))], []
+
+
+def _softmax_ce_eligible(attrs, in_shapes, in_dtypes):
+    if parse_bool(attrs.get("multi_output", False)):
+        return False
+    if len(in_shapes) < 2 or len(in_shapes[0]) != 2:
+        return False
+    n, c = in_shapes[0]
+    if tuple(in_shapes[1]) != (n,):
+        return False
+    return c <= 65536 and str(in_dtypes[0]) in ("float32", "bfloat16",
+                                                "float16")
+
+
+# ==========================================================================
+# fused conv + BatchNorm + ReLU
+# ==========================================================================
+def _bn_stats_kernel(x_ref, sum_ref, sq_ref):
+    n = pl.program_id(1)
+    xb = pl.program_id(2)
+
+    @pl.when((n == 0) & (xb == 0))
+    def _init():
+        sum_ref[...] = jnp.zeros(sum_ref.shape, jnp.float32)
+        sq_ref[...] = jnp.zeros(sq_ref.shape, jnp.float32)
+
+    x = x_ref[...].astype(jnp.float32)                # (block_c, block_x)
+    sum_ref[...] += jnp.sum(x, axis=-1)[None, :]
+    sq_ref[...] += jnp.sum(x * x, axis=-1)[None, :]
+
+
+def _bn_apply_relu_kernel(x_ref, scale_ref, shift_ref, o_ref):
+    x = x_ref[...].astype(jnp.float32)                # (block_c, block_x)
+    scale = scale_ref[...].reshape(-1, 1)             # (block_c, 1)
+    shift = shift_ref[...].reshape(-1, 1)
+    o_ref[...] = jnp.maximum(x * scale + shift, 0.0).astype(o_ref.dtype)
+
+
+def _channel_blocks(n, c, hw):
+    block_c = _divisor_block(c, 128)
+    cap_x = max(128, (2 << 20) // max(1, 4 * block_c))
+    block_x = _divisor_block(hw, cap_x)
+    return block_c, block_x
+
+
+def _pl_channel_stats(x4):
+    """Per-channel (sum, sum of squares) of an NCHW tensor, f32."""
+    n, c, h, w = x4.shape
+    hw = h * w
+    x3 = x4.reshape(n, c, hw)
+    block_c, block_x = _channel_blocks(n, c, hw)
+    # channel blocks outermost so the (1, block_c) output tile stays
+    # resident while the sequential grid walks batch and spatial blocks
+    grid = (c // block_c, n, hw // block_x)
+    in_spec = pl.BlockSpec((None, block_c, block_x),
+                           lambda cb, nb, xb: (nb, cb, xb))
+    out_spec = pl.BlockSpec((1, block_c), lambda cb, nb, xb: (0, cb))
+    s, sq = pallas_call(
+        _bn_stats_kernel,
+        out_shape=[jax.ShapeDtypeStruct((1, c), jnp.float32)] * 2,
+        grid=grid, in_specs=[in_spec], out_specs=[out_spec, out_spec])(x3)
+    return s.reshape(c), sq.reshape(c)
+
+
+def _pl_apply_bn_relu(x4, scale, shift):
+    n, c, h, w = x4.shape
+    hw = h * w
+    x3 = x4.reshape(n, c, hw)
+    block_c, block_x = _channel_blocks(n, c, hw)
+    grid = (n, c // block_c, hw // block_x)
+    x_spec = pl.BlockSpec((None, block_c, block_x),
+                          lambda nb, cb, xb: (nb, cb, xb))
+    p_spec = pl.BlockSpec((1, block_c), lambda nb, cb, xb: (0, cb))
+    out = pallas_call(
+        _bn_apply_relu_kernel,
+        out_shape=jax.ShapeDtypeStruct(x3.shape, x4.dtype),
+        grid=grid, in_specs=[x_spec, p_spec, p_spec],
+        out_specs=x_spec)(x3, scale.reshape(1, c), shift.reshape(1, c))
+    return out.reshape(n, c, h, w)
+
+
+_FUSED_CBR_ATTRS = None        # populated at registration below
+
+
+def _cbr_conv(attrs, data, weight):
+    from .nn import _convolution
+    return _convolution(attrs, data, weight)
+
+
+def _cbr_xla_impl(attrs, data, weight, gamma, beta, moving_mean,
+                  moving_var, is_train):
+    """The exact XLA composition: Convolution -> BatchNorm -> ReLU,
+    sharing ops/nn.py's kernels so numerics are the composition's."""
+    from .nn import _bn_fwd
+    conv = _cbr_conv(attrs, data, weight)
+    # _bn_fwd returns ([out, mean, var], [new_mean, new_var])
+    outs, new_aux = _bn_fwd(attrs, [conv, gamma, beta],
+                            [moving_mean, moving_var], is_train, None)
+    y = jnp.maximum(outs[0], 0)
+    return y, new_aux
+
+
+def _cbr_scale_shift(attrs, gamma, mean, var, beta):
+    eps = parse_float(attrs.get("eps", 1e-3))
+    if parse_bool(attrs.get("fix_gamma", True)):
+        gamma = jnp.ones_like(gamma)
+    inv = jax.lax.rsqrt(var + eps)
+    scale = (inv * gamma.astype(jnp.float32))
+    shift = beta.astype(jnp.float32) - mean * scale
+    return scale, shift
+
+
+def _cbr_pallas_impl(attrs, data, weight, gamma, beta, moving_mean,
+                     moving_var, is_train):
+    conv = _cbr_conv(attrs, data, weight)
+    use_global = parse_bool(attrs.get("use_global_stats", False))
+    momentum = parse_float(attrs.get("momentum", 0.9))
+    if is_train and not use_global:
+        n, c, h, w = conv.shape
+        cnt = float(n * h * w)
+        s, sq = _pl_channel_stats(conv)
+        mean = s / cnt
+        var = jnp.maximum(sq / cnt - mean * mean, 0.0)
+        new_mean = momentum * moving_mean + (1 - momentum) * mean
+        new_var = momentum * moving_var + (1 - momentum) * var
+    else:
+        mean, var = moving_mean, moving_var
+        new_mean, new_var = moving_mean, moving_var
+    scale, shift = _cbr_scale_shift(attrs, gamma, mean, var, beta)
+    y = _pl_apply_bn_relu(conv, scale, shift)
+    return y, [new_mean, new_var]
+
+
+def _cbr_make(attrs, is_train):
+    """custom_vjp closure over (static) attrs + train flag: Pallas
+    forward emitting ``(y, new_mean, new_var)`` in one pass, backward
+    recomputed through the XLA composition (aux cotangents discarded —
+    moving statistics are side state, exactly as in BatchNorm)."""
+    def xla_out(data, weight, gamma, beta, mm, mv):
+        return _cbr_xla_impl(attrs, data, weight, gamma, beta,
+                             jax.lax.stop_gradient(mm),
+                             jax.lax.stop_gradient(mv), is_train)[0]
+
+    @jax.custom_vjp
+    def fn(data, weight, gamma, beta, mm, mv):
+        y, new_aux = _cbr_pallas_impl(attrs, data, weight, gamma, beta,
+                                      mm, mv, is_train)
+        return y, new_aux[0], new_aux[1]
+
+    def fwd(data, weight, gamma, beta, mm, mv):
+        return fn(data, weight, gamma, beta, mm, mv), \
+            (data, weight, gamma, beta, mm, mv)
+
+    def bwd(res, cts):
+        data, weight, gamma, beta, mm, mv = res
+        ct_y = cts[0]                 # aux-state cotangents are zeros
+        _, vjp_fn = jax.vjp(
+            lambda d, w, g, b: xla_out(d, w, g, b, mm, mv),
+            data, weight, gamma, beta)
+        return vjp_fn(ct_y) + (jnp.zeros_like(mm), jnp.zeros_like(mv))
+
+    fn.defvjp(fwd, bwd)
+    return fn
+
+
+def fused_conv_bn_relu(data, weight, gamma, beta, moving_mean,
+                       moving_var, is_train=False, **attrs):
+    """Functional surface of the fused conv+BN+ReLU Pallas kernel.
+
+    Returns ``(out, [new_moving_mean, new_moving_var])`` — the same
+    aux-state contract as BatchNorm (the executor swaps new aux after a
+    training forward)."""
+    y, nm, nv = _cbr_make(attrs, bool(is_train))(
+        data, weight, gamma, beta, moving_mean, moving_var)
+    return y, [nm, nv]
+
+
+def _cbr_xla_variant(attrs, inputs, aux, is_train, rng):
+    data, weight, gamma, beta = inputs
+    y, new_aux = _cbr_xla_impl(attrs, data, weight, gamma, beta,
+                               aux[0], aux[1], is_train)
+    return [y], new_aux
+
+
+def _cbr_pallas_variant(attrs, inputs, aux, is_train, rng):
+    data, weight, gamma, beta = inputs
+    y, nm, nv = _cbr_make(attrs, bool(is_train))(
+        data, weight, gamma, beta, aux[0], aux[1])
+    return [y], [nm, nv]
+
+
+def _cbr_eligible(attrs, in_shapes, in_dtypes):
+    kern = attrs.get("kernel")
+    if kern is None or len(tuple(kern)) != 2:
+        return False
+    if len(in_shapes) < 1 or len(in_shapes[0]) != 4:
+        return False
+    return str(in_dtypes[0]) in ("float32", "bfloat16", "float16")
+
+
+def _cbr_infer(attrs, in_shapes):
+    from .nn import _conv_infer
+    conv_attrs = dict(attrs, no_bias=True)
+    new_in, out_s, _ = _conv_infer(conv_attrs, in_shapes[:2])
+    nf = parse_int(attrs["num_filter"])
+    c = (nf,)
+    return [new_in[0], new_in[1], c, c], out_s, [c, c]
+
+
+def _register_fused_conv_bn_relu():
+    if "FusedConvBNReLU" in OP_REGISTRY:
+        return
+    from .nn import _CONV_ATTRS
+    attrs = {k: v for k, v in _CONV_ATTRS.items() if k != "no_bias"}
+    attrs.update({"eps": (parse_float, 1e-3),
+                  "momentum": (parse_float, 0.9),
+                  "fix_gamma": (parse_bool, True),
+                  "use_global_stats": (parse_bool, False)})
+    register("FusedConvBNReLU",
+             inputs=("data", "weight", "gamma", "beta"),
+             aux=("moving_mean", "moving_var"),
+             full=_cbr_xla_variant,
+             attr_spec=attrs, infer_shape=_cbr_infer,
+             variants={"pallas": (_cbr_pallas_variant, _cbr_eligible)})
+
+
+_register_fused_conv_bn_relu()
+
+
+# ==========================================================================
+# fused optimizer updates (promoted from rtc.py's correctness demo)
+# ==========================================================================
+_TILE_ROWS = 256
+_LANES = 128
+
+
+def _pad_to_tiles(v):
+    n = v.size
+    cols = _LANES
+    rows = -(-n // cols)
+    rows_pad = -(-rows // 16) * 16        # bf16-safe sublane multiple
+    flat = jnp.ravel(v)
+    flat = jnp.pad(flat, (0, rows_pad * cols - n))
+    return flat.reshape(rows_pad, cols), n
+
+
+def _tiled_elementwise(kernel, arrays, n_out):
+    """Run an elementwise kernel over same-shaped operands: flatten,
+    pad to (16k, 128) tiles, grid over row blocks, un-pad."""
+    shape = arrays[0].shape
+    padded = []
+    n = None
+    for a in arrays:
+        p, n = _pad_to_tiles(a)
+        padded.append(p)
+    rows = padded[0].shape[0]
+    # block rows: a 16-multiple divisor so the grid tiles rows exactly
+    block = 16 * _divisor_block(rows // 16, _TILE_ROWS // 16)
+    spec = pl.BlockSpec((block, _LANES), lambda i: (i, 0))
+    outs = pallas_call(
+        kernel,
+        out_shape=[jax.ShapeDtypeStruct(padded[0].shape,
+                                        padded[0].dtype)] * n_out,
+        grid=(rows // block,),
+        in_specs=[spec] * len(padded),
+        out_specs=[spec] * n_out)(*padded)
+    return tuple(o.reshape(-1)[:n].reshape(shape) for o in outs)
+
+
+def _hyper(attrs):
+    lr = parse_float(attrs["lr"])
+    wd = parse_float(attrs.get("wd", 0.0))
+    rescale = parse_float(attrs.get("rescale_grad", 1.0))
+    clip = attrs.get("clip_gradient")
+    clip = parse_float(clip) if clip is not None and \
+        parse_float(clip) > 0 else None
+    return lr, wd, rescale, clip
+
+
+def _prep(g, w, wd, rescale, clip):
+    g = g * rescale
+    if clip is not None:
+        g = jnp.clip(g, -clip, clip)
+    return g + wd * w
+
+
+def _sgd_mom_kernel(attrs):
+    lr, wd, rescale, clip = _hyper(attrs)
+    momentum = parse_float(attrs.get("momentum", 0.0))
+
+    def kernel(w_ref, g_ref, m_ref, ow_ref, om_ref):
+        g = _prep(g_ref[...], w_ref[...], wd, rescale, clip)
+        m = momentum * m_ref[...] - lr * g
+        om_ref[...] = m
+        ow_ref[...] = w_ref[...] + m
+    return kernel
+
+
+def _adam_kernel(attrs):
+    lr, wd, rescale, clip = _hyper(attrs)
+    b1 = parse_float(attrs.get("beta1", 0.9))
+    b2 = parse_float(attrs.get("beta2", 0.999))
+    eps = parse_float(attrs.get("epsilon", 1e-8))
+
+    def kernel(w_ref, g_ref, mean_ref, var_ref, ow_ref, omean_ref,
+               ovar_ref):
+        w = w_ref[...]
+        g = _prep(g_ref[...], w, wd, rescale, clip)
+        mean = b1 * mean_ref[...] + (1 - b1) * g
+        var = b2 * var_ref[...] + (1 - b2) * g * g
+        omean_ref[...] = mean
+        ovar_ref[...] = var
+        ow_ref[...] = w - lr * mean / (jnp.sqrt(var) + eps)
+    return kernel
+
+
+def pallas_sgd_mom_update(weight, grad, mom, lr, momentum=0.0, wd=0.0,
+                          rescale_grad=1.0, clip_gradient=None):
+    """Fused SGD-momentum update on jax arrays: (weight', mom')."""
+    attrs = {"lr": lr, "momentum": momentum, "wd": wd,
+             "rescale_grad": rescale_grad, "clip_gradient": clip_gradient}
+    return _tiled_elementwise(_sgd_mom_kernel(attrs),
+                              [weight, grad, mom], 2)
+
+
+def pallas_adam_update(weight, grad, mean, var, lr, beta1=0.9,
+                       beta2=0.999, epsilon=1e-8, wd=0.0,
+                       rescale_grad=1.0, clip_gradient=None):
+    """Fused Adam update on jax arrays: (weight', mean', var')."""
+    attrs = {"lr": lr, "beta1": beta1, "beta2": beta2, "epsilon": epsilon,
+             "wd": wd, "rescale_grad": rescale_grad,
+             "clip_gradient": clip_gradient}
+    return _tiled_elementwise(_adam_kernel(attrs),
+                              [weight, grad, mean, var], 3)
+
+
+def _opt_variant(op_name, kernel_builder, n_in, n_out):
+    """Pallas variant of a registered optimizer op, with the uniform
+    XLA-recompute custom VJP (updates are rarely differentiated, but
+    the contract holds through either tier)."""
+    xla_fwd = get_op(op_name).forward
+
+    def variant(attrs, inputs, aux, is_train, rng):
+        def pallas_fn(*vals):
+            return _tiled_elementwise(kernel_builder(attrs), list(vals),
+                                      n_out)
+
+        def xla_fn(*vals):
+            outs, _ = xla_fwd(attrs, list(vals), [], is_train, rng)
+            return tuple(outs)
+
+        fn = _xla_recompute_vjp(pallas_fn, xla_fn, n_in)
+        return list(fn(*inputs)), []
+
+    def eligible(attrs, in_shapes, in_dtypes):
+        if len(set(tuple(s) for s in in_shapes)) != 1:
+            return False
+        return all(str(d) in ("float32", "bfloat16", "float16")
+                   for d in in_dtypes)
+
+    return variant, eligible
+
+
+def _register_opt_variants():
+    sgd = get_op("sgd_mom_update")
+    if "pallas" not in sgd.variants:
+        sgd.add_variant("pallas",
+                        *_opt_variant("sgd_mom_update", _sgd_mom_kernel,
+                                      3, 2))
+    adam = get_op("adam_update")
+    if "pallas" not in adam.variants:
+        adam.add_variant("pallas",
+                         *_opt_variant("adam_update", _adam_kernel, 4, 3))
+
+
+def _register_softmax_ce_variant():
+    sm = get_op("SoftmaxOutput")
+    if "pallas" not in sm.variants:
+        sm.add_variant("pallas", _softmax_ce_variant,
+                       eligible=_softmax_ce_eligible)
+
+
+_register_opt_variants()
+_register_softmax_ce_variant()

@@ -67,6 +67,19 @@ class OpDef:
         the explicit opt-out the graph verifier (analysis rule GV107)
         accepts in place of ``infer_shape``, so an op can never *silently*
         fall back to abstract evaluation that stalls on partial shapes.
+    variants : alternative kernel implementations keyed by tier name
+        (today: ``"pallas"``). ``forward`` is always the XLA composition
+        and the fallback of last resort; the kernel-tier selection layer
+        (kernel_tier.py) picks per (backend, shape, dtype) under
+        ``MXNET_KERNEL_TIER``. Values are full-signature forwards or
+        ``(forward, eligible)`` pairs where ``eligible(attrs, in_shapes,
+        in_dtypes) -> bool`` gates shapes/attrs the kernel supports.
+    flops / bytes_moved : optional cost metadata,
+        ``fn(attrs, in_shapes) -> float`` — forward-pass floating-point
+        ops and HBM bytes touched for one execution at those input
+        shapes. Powers the MFU/roofline telemetry (telemetry/mfu.py);
+        ops without it are invisible to MFU accounting (analysis rule
+        MF601 lists them).
     need_rng : forward consumes the rng key (Dropout, samplers).
     is_loss : op is a loss head (SoftmaxOutput family) — executor seeds its
         cotangent with ones for backward() with no out_grads.
@@ -79,9 +92,18 @@ class OpDef:
                  num_outputs=1, output_names=None, attr_spec=None,
                  infer_shape=None, infer_type=None, need_rng=False,
                  is_loss=False, mutate_inputs=(), num_visible=None,
-                 shape_passthrough=False, doc=""):
+                 shape_passthrough=False, variants=None, flops=None,
+                 bytes_moved=None, doc=""):
         self.name = name
         self.forward = forward
+        self.variants = {}
+        for vname, vfn in (variants or {}).items():
+            if isinstance(vfn, tuple):
+                self.add_variant(vname, vfn[0], eligible=vfn[1])
+            else:
+                self.add_variant(vname, vfn)
+        self.flops = flops
+        self.bytes_moved = bytes_moved
         self._inputs = inputs
         self._aux = aux
         self._num_outputs = num_outputs
@@ -134,6 +156,64 @@ class OpDef:
         if callable(self._output_names):
             return list(self._output_names(attrs or {}))
         return list(self._output_names)
+
+    # --- kernel-tier variants + cost metadata ---------------------------
+    def add_variant(self, name, forward, eligible=None):
+        """Attach an alternative kernel implementation.
+
+        ``forward`` has the full op signature (attrs, inputs, aux,
+        is_train, rng) -> (outputs, new_aux); ``eligible(attrs,
+        in_shapes, in_dtypes)`` optionally restricts the shapes/attrs
+        the kernel handles. ``name="xla"`` is reserved for the stock
+        ``self.forward`` composition and cannot be overridden.
+        """
+        if name == "xla":
+            raise MXNetError(
+                f"op {self.name!r}: 'xla' names the stock forward; "
+                "register a differently-named variant")
+        self.variants[name] = {"fn": forward, "eligible": eligible}
+        return self
+
+    def variant_fn(self, name):
+        """Forward callable for one tier: 'xla' -> the stock forward."""
+        if name == "xla":
+            return self.forward
+        return self.variants[name]["fn"]
+
+    def variant_eligible(self, name, attrs, in_shapes, in_dtypes):
+        if name == "xla":
+            return True
+        rec = self.variants.get(name)
+        if rec is None:
+            return False
+        if rec["eligible"] is None:
+            return True
+        try:
+            return bool(rec["eligible"](attrs, in_shapes, in_dtypes))
+        except Exception:
+            return False
+
+    def set_cost(self, flops=None, bytes_moved=None):
+        """Attach/replace cost metadata (fn(attrs, in_shapes)->float)."""
+        if flops is not None:
+            self.flops = flops
+        if bytes_moved is not None:
+            self.bytes_moved = bytes_moved
+        return self
+
+    def has_cost(self):
+        return self.flops is not None and self.bytes_moved is not None
+
+    def cost(self, attrs, in_shapes):
+        """(flops, bytes) for one forward execution, or None when the op
+        has no metadata or the estimate fails (partial shapes)."""
+        if not self.has_cost():
+            return None
+        try:
+            return (float(self.flops(attrs, in_shapes)),
+                    float(self.bytes_moved(attrs, in_shapes)))
+        except Exception:
+            return None
 
     def normalize_attrs(self, kwargs):
         """Parse raw kwargs/JSON strings into the typed attr dict."""

@@ -26,21 +26,16 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
 from .base import MXNetError
-from .ndarray import NDArray
 from .ops.registry import register as _register_op, OP_REGISTRY
+# the production kernels (and the shared interpret-gated pallas_call)
+# live in ops/pallas_kernels.py; rtc re-exports the public surfaces so
+# the reference-shaped mx.rtc API is unchanged
+from .ops.pallas_kernels import (pallas_call, _interpret,  # noqa: F401
+                                 pallas_sgd_mom_update)
 
-__all__ = ["Rtc", "register_pallas_op", "pallas_call"]
-
-
-def _interpret():
-    """Mosaic-compile on TPU; interpret elsewhere (CPU test mesh)."""
-    return jax.default_backend() != "tpu"
-
-
-def pallas_call(kernel, out_shape, **kwargs):
-    """``pl.pallas_call`` with backend-appropriate compile/interpret mode."""
-    kwargs.setdefault("interpret", _interpret())
-    return pl.pallas_call(kernel, out_shape=out_shape, **kwargs)
+__all__ = ["Rtc", "register_pallas_op", "pallas_call",
+           "pallas_sgd_mom_update", "flash_attention",
+           "flash_attention_partial"]
 
 
 class Rtc:
@@ -106,7 +101,8 @@ class Rtc:
 def register_pallas_op(name, kernel, out_shapes, inputs=("data",),
                        vjp_kernel=None, grid=None, in_specs=None,
                        out_specs=None, vjp_grid=None, vjp_in_specs=None,
-                       vjp_out_specs=None, attr_spec=None):
+                       vjp_out_specs=None, attr_spec=None,
+                       reference=None):
     """Register a Pallas kernel as a graph operator.
 
     Parameters
@@ -123,6 +119,16 @@ def register_pallas_op(name, kernel, out_shapes, inputs=("data",),
         backward: vjp_grid/vjp_in_specs/vjp_out_specs (the vjp kernel's
         inputs are *vals + *cotangents, outputs one grad per input);
         omitting them for a gridded forward raises at registration.
+    reference : optional XLA composition ``fn(attrs, *inputs) -> out``
+        with the kernel's exact semantics. When given, the op registers
+        with the reference as its ``forward`` and the Pallas kernel as
+        the ``variants["pallas"]`` alternative — the SAME fallback +
+        numerics-gate codepath the built-in production kernels use
+        (kernel_tier.py): ``MXNET_KERNEL_TIER=xla`` forces the
+        reference, ``auto`` autotunes per shape on TPU, and
+        ``kernel_tier.numerics_gate`` can verify the pair. Without a
+        reference the Pallas kernel is the only implementation and runs
+        under every tier (interpret mode off-TPU).
     """
     if vjp_kernel is not None and grid is not None and vjp_grid is None:
         raise MXNetError(
@@ -206,100 +212,71 @@ def register_pallas_op(name, kernel, out_shapes, inputs=("data",),
                 _cache[key] = op
         return op(*in_vals)
 
-    return _register_op(name, inputs=inputs, simple=simple_forward,
-                        attr_spec=attr_spec or {})
+    if reference is None:
+        return _register_op(name, inputs=inputs, simple=simple_forward,
+                            attr_spec=attr_spec or {})
+
+    # with a reference composition, the user kernel rides the SAME
+    # variants/tier mechanism as the built-in production kernels
+    def pallas_variant(attrs, in_list, aux, is_train, rng):
+        out = simple_forward(attrs, *in_list)
+        if isinstance(out, (tuple, list)):
+            return list(out), []
+        return [out], []
+
+    return _register_op(name, inputs=inputs, simple=reference,
+                        attr_spec=attr_spec or {},
+                        variants={"pallas": pallas_variant})
 
 
 # --------------------------------------------------------------------------
 # built-in: fused SGD-momentum update (the reference ships this fused on
-# the GPU as sgd_mom_update, optimizer_op.cc:17-60; here it is the
-# resident example of a Pallas kernel in the op graph). Same convention as
-# ops/optimizer_op.py: g = wd*w + clip(rescale*grad);
-# mom' = momentum*mom - lr*g; weight' = weight + mom'.
+# the GPU as sgd_mom_update, optimizer_op.cc:17-60). The kernel itself is
+# PROMOTED to ops/pallas_kernels.py as a production variant of the
+# sgd_mom_update registry op; this public op name keeps the explicit
+# surface — forward is the XLA composition, the Pallas kernel rides the
+# variants table, so MXNET_KERNEL_TIER selects per backend/shape like
+# every other tiered op. Same convention as ops/optimizer_op.py:
+# g = wd*w + clip(rescale*grad); mom' = momentum*mom - lr*g;
+# weight' = weight + mom'.
 # --------------------------------------------------------------------------
-_TILE_ROWS = 256
-_LANES = 128
-
-
-def _sgd_mom_kernel(attrs):
-    lr = float(attrs.get("lr"))
-    momentum = float(attrs.get("momentum", 0.0))
-    wd = float(attrs.get("wd", 0.0))
-    rescale = float(attrs.get("rescale_grad", 1.0))
-    clip = attrs.get("clip_gradient")
-    clip = float(clip) if clip is not None and float(clip) > 0 else None
-
-    def kernel(w_ref, g_ref, m_ref, ow_ref, om_ref):
-        g = g_ref[...] * rescale
-        if clip is not None:
-            g = jnp.clip(g, -clip, clip)
-        g = g + wd * w_ref[...]
-        m = momentum * m_ref[...] - lr * g
-        om_ref[...] = m
-        ow_ref[...] = w_ref[...] + m
-    return kernel
-
-
-def _pad_to_tiles(v):
-    n = v.size
-    cols = _LANES
-    rows = -(-n // cols)
-    rows_pad = -(-rows // 8) * 8          # float32 sublane multiple
-    flat = jnp.ravel(v)
-    flat = jnp.pad(flat, (0, rows_pad * cols - n))
-    return flat.reshape(rows_pad, cols), n
-
-
-def pallas_sgd_mom_update(weight, grad, mom, lr, momentum=0.0, wd=0.0,
-                          rescale_grad=1.0, clip_gradient=None):
-    """Functional fused update on jax arrays: returns (weight', mom')."""
-    attrs = {"lr": lr, "momentum": momentum, "wd": wd,
-             "rescale_grad": rescale_grad, "clip_gradient": clip_gradient}
-    w2, n = _pad_to_tiles(weight)
-    g2, _ = _pad_to_tiles(grad)
-    m2, _ = _pad_to_tiles(mom)
-    rows = w2.shape[0]
-    block = min(_TILE_ROWS, rows)
-    # rows is a multiple of 8; use a divisor block so the grid tiles evenly
-    while rows % block:
-        block -= 8
-    spec = pl.BlockSpec((block, _LANES), lambda i: (i, 0))
-    out = pallas_call(
-        _sgd_mom_kernel(attrs),
-        out_shape=[jax.ShapeDtypeStruct(w2.shape, w2.dtype)] * 2,
-        grid=(rows // block,),
-        in_specs=[spec, spec, spec], out_specs=[spec, spec])(w2, g2, m2)
-    new_w = out[0].reshape(-1)[:n].reshape(weight.shape)
-    new_m = out[1].reshape(-1)[:n].reshape(mom.shape)
-    return new_w, new_m
-
-
-def _nd(x):
-    return x.asjax() if isinstance(x, NDArray) else jnp.asarray(x)
-
-
 def _register_builtin():
     if "pallas_sgd_mom_update" in OP_REGISTRY:
         return
 
-    def forward(attrs, weight, grad, mom):
-        return pallas_sgd_mom_update(
-            weight, grad, mom,
+    def _hyper(attrs):
+        return dict(
             lr=float(attrs["lr"]),
             momentum=float(attrs.get("momentum", 0.0)),
             wd=float(attrs.get("wd", 0.0)),
             rescale_grad=float(attrs.get("rescale_grad", 1.0)),
             clip_gradient=attrs.get("clip_gradient"))
 
+    def xla_forward(attrs, weight, grad, mom):
+        h = _hyper(attrs)
+        g = grad * h["rescale_grad"]
+        if h["clip_gradient"] is not None and \
+                float(h["clip_gradient"]) > 0:
+            c = float(h["clip_gradient"])
+            g = jnp.clip(g, -c, c)
+        g = g + h["wd"] * weight
+        new_m = h["momentum"] * mom - h["lr"] * g
+        return weight + new_m, new_m
+
+    def pallas_variant(attrs, inputs, aux, is_train, rng):
+        w, g, m = inputs
+        return list(pallas_sgd_mom_update(w, g, m, **_hyper(attrs))), []
+
     _register_op("pallas_sgd_mom_update",
                  inputs=("weight", "grad", "mom"),
-                 simple=forward, num_outputs=2,
+                 simple=xla_forward, num_outputs=2,
                  output_names=["weight_out", "mom_out"],
                  attr_spec={"lr": (float, None),
                             "momentum": (float, 0.0),
                             "wd": (float, 0.0),
                             "rescale_grad": (float, 1.0),
-                            "clip_gradient": (lambda v: float(v), None)})
+                            "clip_gradient": (lambda v: float(v), None)},
+                 variants={"pallas": pallas_variant})
 
 
 _register_builtin()
@@ -512,19 +489,45 @@ def _register_flash():
     if "pallas_flash_attention" in OP_REGISTRY:
         return
 
-    def forward(attrs, q, k, v):
+    def xla_forward(attrs, q, k, v):
+        # the exact composition the flash kernel is gated against —
+        # VERDICT §5 measured flash both beating and losing to this,
+        # which is precisely why the tier autotunes instead of trusting
+        # the kernel's name
         from .base import parse_bool
-        return flash_attention(q, k, v,
-                               causal=parse_bool(attrs.get("causal",
-                                                           False)),
-                               block_q=int(attrs.get("block_q", 128)),
-                               block_k=int(attrs.get("block_k", 128)))
+        from .parallel.ring_attention import attention as xla_attention
+        return xla_attention(q, k, v,
+                             causal=parse_bool(attrs.get("causal", False)))
+
+    def pallas_variant(attrs, inputs, aux, is_train, rng):
+        from .base import parse_bool
+        q, k, v = inputs
+        out = flash_attention(q, k, v,
+                              causal=parse_bool(attrs.get("causal",
+                                                          False)),
+                              block_q=int(attrs.get("block_q", 128)),
+                              block_k=int(attrs.get("block_k", 128)))
+        return [out], []
+
+    def eligible(attrs, in_shapes, in_dtypes):
+        if len(in_shapes[0]) != 4:
+            return False
+        t = in_shapes[0][2]
+        bq = min(int(attrs.get("block_q", 128)), t)
+        bk = min(int(attrs.get("block_k", 128)), t)
+        return t % bq == 0 and t % bk == 0
 
     _register_op("pallas_flash_attention", inputs=("q", "k", "v"),
-                 simple=forward,
+                 simple=xla_forward,
                  attr_spec={"causal": (None, False),
                             "block_q": (int, 128),
-                            "block_k": (int, 128)})
+                            "block_k": (int, 128)},
+                 variants={"pallas": (pallas_variant, eligible)})
 
 
 _register_flash()
+
+# rtc's ops register after ops/cost.py's import-time pass — re-seed so
+# pallas_sgd_mom_update / pallas_flash_attention carry their estimators
+from .ops import cost as _cost          # noqa: E402
+_cost.seed_costs()

@@ -50,6 +50,7 @@ from . import core
 from . import metrics
 from . import flightrec
 from . import memory
+from . import mfu
 from . import sentinel
 from . import chrome_trace
 from . import prometheus
@@ -59,7 +60,7 @@ __all__ = ["span", "event", "record_event", "enable", "disable", "enabled",
            "clear", "get_spans", "get_events", "null_span", "wrap_dispatch",
            "Counter", "Gauge", "Histogram", "counter", "gauge", "histogram",
            "get_metric", "snapshot", "reset", "NanSentinel", "AnomalyError",
-           "flightrec", "memory", "sentinel",
+           "flightrec", "memory", "mfu", "sentinel",
            "chrome_trace", "prometheus", "jsonl"]
 
 

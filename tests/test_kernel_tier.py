@@ -1,0 +1,276 @@
+"""Kernel tier: variant selection, one-shot autotune, numerics gates.
+
+Everything runs on the CPU test mesh: Pallas executes in interpret mode
+(rtc.py's gate), so parity is checkable everywhere, and the autotune
+path is driven by monkeypatching the backend probe + timer — the
+measured branch itself is exercised without TPU hardware.
+"""
+import json
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import kernel_tier
+from mxnet_tpu.ops.registry import get_op
+from mxnet_tpu.telemetry import metrics
+
+
+@pytest.fixture(autouse=True)
+def _clean_tier(monkeypatch):
+    monkeypatch.delenv("MXNET_KERNEL_TIER", raising=False)
+    monkeypatch.delenv("MXNET_AUTOTUNE_CACHE_DIR", raising=False)
+    kernel_tier.clear()
+    yield
+    kernel_tier.clear()
+
+
+def _softmax_site():
+    sm = get_op("SoftmaxOutput")
+    attrs = sm.normalize_attrs({})
+    shapes = [(8, 10), (8,)]
+    dtypes = ["float32", "float32"]
+    return sm, attrs, shapes, dtypes
+
+
+# ------------------------------------------------------------- selection
+def test_mode_parsing(monkeypatch):
+    assert kernel_tier.mode() == "auto"
+    monkeypatch.setenv("MXNET_KERNEL_TIER", "xla")
+    assert kernel_tier.mode() == "xla"
+    monkeypatch.setenv("MXNET_KERNEL_TIER", "PALLAS")
+    assert kernel_tier.mode() == "pallas"
+    monkeypatch.setenv("MXNET_KERNEL_TIER", "nonsense")
+    assert kernel_tier.mode() == "auto"
+
+
+def test_forced_xla(monkeypatch):
+    monkeypatch.setenv("MXNET_KERNEL_TIER", "xla")
+    sm, attrs, shapes, dtypes = _softmax_site()
+    assert kernel_tier.resolve(sm, attrs, shapes, dtypes, True) == "xla"
+
+
+def test_forced_pallas(monkeypatch):
+    monkeypatch.setenv("MXNET_KERNEL_TIER", "pallas")
+    sm, attrs, shapes, dtypes = _softmax_site()
+    assert kernel_tier.resolve(sm, attrs, shapes, dtypes, True) == "pallas"
+    # ineligible shape (3-d data) falls back to xla even when forced
+    assert kernel_tier.resolve(sm, attrs, [(2, 3, 4), (2, 3)],
+                               ["float32", "float32"], True) == "xla"
+
+
+def test_auto_on_cpu_is_xla():
+    """The acceptance contract: auto off-TPU always resolves XLA, no
+    autotune ever runs."""
+    sm, attrs, shapes, dtypes = _softmax_site()
+    before = kernel_tier.cache_info()["decisions"]
+    assert kernel_tier.resolve(sm, attrs, shapes, dtypes, True) == "xla"
+    assert kernel_tier.cache_info()["decisions"] == before
+
+
+def test_op_without_variants_passthrough():
+    fc = get_op("FullyConnected")
+    assert kernel_tier.resolve(fc, {"num_hidden": 4}, [(2, 8)],
+                               ["float32"], True) == "xla"
+
+
+# ------------------------------------------------------------- autotune
+def _fake_tpu(monkeypatch, pallas_ms, xla_ms):
+    """Drive the auto path without hardware: backend reads 'tpu', the
+    timer replays scripted medians (xla first, then pallas — autotune's
+    call order)."""
+    times = iter([xla_ms / 1e3, pallas_ms / 1e3])
+    monkeypatch.setattr(kernel_tier, "_backend", lambda: "tpu")
+    monkeypatch.setattr(kernel_tier, "_device_kind", lambda: "TPU test")
+    monkeypatch.setattr(kernel_tier, "_time_variant",
+                        lambda run, r, x, reps: next(times))
+
+
+def test_auto_autotune_picks_measured_winner(monkeypatch):
+    sm, attrs, shapes, dtypes = _softmax_site()
+    _fake_tpu(monkeypatch, pallas_ms=1.0, xla_ms=2.0)
+    assert kernel_tier.resolve(sm, attrs, shapes, dtypes, True) == "pallas"
+    dec = kernel_tier.decisions()[-1]
+    assert dec["variant"] == "pallas" and dec["source"] == "autotune"
+    assert "faster" in dec["reason"]
+
+
+def test_auto_never_picks_slower_pallas(monkeypatch):
+    sm, attrs, shapes, dtypes = _softmax_site()
+    _fake_tpu(monkeypatch, pallas_ms=3.0, xla_ms=1.0)
+    assert kernel_tier.resolve(sm, attrs, shapes, dtypes, True) == "xla"
+    dec = kernel_tier.decisions()[-1]
+    assert dec["variant"] == "xla" and "slower" in dec["reason"]
+    # the audit log invariant: nothing chosen that measured slower
+    for d in kernel_tier.decisions():
+        if d.get("variant") == "pallas" and "pallas_ms" in d:
+            assert d["pallas_ms"] < d["xla_ms"]
+
+
+def test_numerics_gate_failure_forces_xla(monkeypatch):
+    sm, attrs, shapes, dtypes = _softmax_site()
+    _fake_tpu(monkeypatch, pallas_ms=0.1, xla_ms=9.9)
+    monkeypatch.setattr(kernel_tier, "numerics_gate",
+                        lambda *a, **k: (False, 1.0))
+    assert kernel_tier.resolve(sm, attrs, shapes, dtypes, True) == "xla"
+    assert "numerics" in kernel_tier.decisions()[-1]["reason"]
+
+
+def test_autotune_cache_hit_accounting(monkeypatch):
+    sm, attrs, shapes, dtypes = _softmax_site()
+    _fake_tpu(monkeypatch, pallas_ms=1.0, xla_ms=2.0)
+    runs = metrics.counter("kernel_tier.autotune.runs")
+    hits = metrics.counter("kernel_tier.cache.hit")
+    r0, h0 = runs.value, hits.value
+    kernel_tier.resolve(sm, attrs, shapes, dtypes, True)
+    assert runs.value == r0 + 1
+    # second resolve at the same key: cached winner, no re-timing
+    assert kernel_tier.resolve(sm, attrs, shapes, dtypes, True) == "pallas"
+    assert runs.value == r0 + 1
+    assert hits.value == h0 + 1
+    # a different shape is a different key -> fresh autotune
+    times = iter([2.0e-3, 1.0e-3])
+    monkeypatch.setattr(kernel_tier, "_time_variant",
+                        lambda run, r, x, reps: next(times))
+    kernel_tier.resolve(sm, attrs, [(16, 10), (16,)], dtypes, True)
+    assert runs.value == r0 + 2
+
+
+def test_autotune_persistence_roundtrip(tmp_path, monkeypatch):
+    monkeypatch.setenv("MXNET_AUTOTUNE_CACHE_DIR", str(tmp_path))
+    sm, attrs, shapes, dtypes = _softmax_site()
+    _fake_tpu(monkeypatch, pallas_ms=1.0, xla_ms=2.0)
+    assert kernel_tier.resolve(sm, attrs, shapes, dtypes, True) == "pallas"
+    path = tmp_path / "kernel_tier.json"
+    assert path.exists()
+    doc = json.loads(path.read_text())
+    assert any(v["variant"] == "pallas" for v in doc.values())
+    # a fresh process (simulated by clear()) reuses the persisted winner
+    # without re-running the autotune
+    kernel_tier.clear()
+    monkeypatch.setattr(kernel_tier, "_backend", lambda: "tpu")
+    monkeypatch.setattr(kernel_tier, "_device_kind", lambda: "TPU test")
+    monkeypatch.setattr(
+        kernel_tier, "_time_variant",
+        lambda *a, **k: pytest.fail("persisted winner re-timed"))
+    assert kernel_tier.resolve(sm, attrs, shapes, dtypes, True) == "pallas"
+    assert kernel_tier.decisions()[-1]["source"] == "persisted"
+
+
+def test_uncacheable_attrs_fall_back(monkeypatch):
+    _fake_tpu(monkeypatch, pallas_ms=1.0, xla_ms=2.0)
+    sm, attrs, shapes, dtypes = _softmax_site()
+    attrs = dict(attrs, bogus=np.arange(3))     # array attr: RC401-unsafe
+    assert kernel_tier.resolve(sm, attrs, shapes, dtypes, True) == "xla"
+
+
+# ------------------------------------------------- numerics parity gates
+_DTYPE_CASES = [("float32", None), ("bfloat16", None)]
+
+
+@pytest.mark.parametrize("dtype,tol", _DTYPE_CASES)
+def test_parity_softmax_ce(dtype, tol):
+    sm = get_op("SoftmaxOutput")
+    attrs = sm.normalize_attrs({})
+    ok, err = kernel_tier.numerics_gate(
+        sm, attrs, [(16, 12), (16,)], [dtype, "float32"], tol=tol)
+    assert ok, f"softmax-CE parity failed at {dtype}: {err}"
+
+
+@pytest.mark.parametrize("dtype,tol", _DTYPE_CASES)
+def test_parity_fused_conv_bn_relu(dtype, tol):
+    cbr = get_op("FusedConvBNReLU")
+    attrs = cbr.normalize_attrs(dict(kernel=(3, 3), num_filter=8,
+                                     pad=(1, 1), fix_gamma=False))
+    shapes = [(2, 4, 8, 8), (8, 4, 3, 3), (8,), (8,), (8,), (8,)]
+    dtypes = [dtype, dtype, "float32", "float32", "float32", "float32"]
+    ok, err = kernel_tier.numerics_gate(cbr, attrs, shapes, dtypes,
+                                        is_train=True, tol=tol)
+    assert ok, f"conv+BN+ReLU parity failed at {dtype}: {err}"
+
+
+@pytest.mark.parametrize("dtype,tol", _DTYPE_CASES)
+def test_parity_sgd_mom_update(dtype, tol):
+    op = get_op("sgd_mom_update")
+    attrs = op.normalize_attrs(dict(lr=0.05, momentum=0.9, wd=1e-4,
+                                    rescale_grad=0.5, clip_gradient=2.0))
+    ok, err = kernel_tier.numerics_gate(
+        op, attrs, [(50, 33)] * 3, [dtype] * 3, is_train=False, tol=tol)
+    assert ok, f"sgd_mom parity failed at {dtype}: {err}"
+
+
+@pytest.mark.parametrize("dtype,tol", _DTYPE_CASES)
+def test_parity_adam_update(dtype, tol):
+    op = get_op("adam_update")
+    attrs = op.normalize_attrs(dict(lr=0.01, wd=1e-4))
+    ok, err = kernel_tier.numerics_gate(
+        op, attrs, [(40, 16)] * 4, [dtype] * 4, is_train=False, tol=tol)
+    assert ok, f"adam parity failed at {dtype}: {err}"
+
+
+def test_parity_custom_vjp_gradients():
+    """The Pallas variants' custom VJPs match the XLA compositions'
+    gradients (softmax-CE uses its hand backward kernel; conv+BN+ReLU
+    recomputes through XLA)."""
+    sm = get_op("SoftmaxOutput")
+    attrs = sm.normalize_attrs({"grad_scale": 2.0,
+                                "normalization": "batch"})
+    rng = np.random.RandomState(0)
+    d = jnp.asarray(rng.randn(8, 10).astype("f"))
+    lab = jnp.asarray((rng.rand(8) * 10).astype("f"))
+
+    def loss(fn):
+        return lambda dd: fn(attrs, [dd, lab], [], True, None)[0][0].sum()
+
+    gx = jax.grad(loss(sm.forward))(d)
+    gp = jax.grad(loss(sm.variant_fn("pallas")))(d)
+    np.testing.assert_allclose(np.asarray(gx), np.asarray(gp),
+                               rtol=1e-5, atol=1e-6)
+
+
+# -------------------------------------------- end-to-end tier regression
+def _fit_params(tier, monkeypatch):
+    if tier is None:
+        monkeypatch.delenv("MXNET_KERNEL_TIER", raising=False)
+    else:
+        monkeypatch.setenv("MXNET_KERNEL_TIER", tier)
+    kernel_tier.clear()
+    mx.random.seed(7)
+    rng = np.random.RandomState(1)
+    X = rng.rand(32, 8).astype(np.float32)
+    Y = (rng.rand(32) * 4).astype(np.float32)
+    it = mx.io.NDArrayIter(X, Y, batch_size=8, label_name="softmax_label")
+    d = mx.sym.var("data")
+    h = mx.sym.FullyConnected(d, num_hidden=16, name="fc1")
+    h = mx.sym.Activation(h, act_type="relu")
+    h = mx.sym.FullyConnected(h, num_hidden=4, name="fc2")
+    net = mx.sym.SoftmaxOutput(h, name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.fit(it, num_epoch=2, initializer=mx.initializer.Uniform(0.1),
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9})
+    args, _ = mod.get_params()
+    return {k: v.asnumpy() for k, v in args.items()}
+
+
+def test_tier_xla_bit_exact_with_default(monkeypatch):
+    """MXNET_KERNEL_TIER=xla reproduces the pre-tier (unset) results
+    bit for bit, and auto on CPU is identical to both — autotune can
+    never degrade correctness off-TPU."""
+    base = _fit_params(None, monkeypatch)
+    forced = _fit_params("xla", monkeypatch)
+    auto = _fit_params("auto", monkeypatch)
+    for k in base:
+        assert np.array_equal(base[k], forced[k]), k
+        assert np.array_equal(base[k], auto[k]), k
+
+
+def test_forced_pallas_trains_close(monkeypatch):
+    """Forced-pallas training (interpret mode on CPU) stays numerically
+    close to the XLA run — the variants' custom VJPs are sound through
+    a real fit loop."""
+    ref = _fit_params("xla", monkeypatch)
+    pal = _fit_params("pallas", monkeypatch)
+    for k in ref:
+        np.testing.assert_allclose(ref[k], pal[k], rtol=2e-3, atol=2e-4)

@@ -1,0 +1,215 @@
+"""MFU/roofline accounting: cost metadata, coverage, reporting surfaces.
+
+Covers telemetry/mfu.py's cost-table fold, the roofline classifier, the
+registry gauges the fit loop records, the MF601 coverage lint rule, the
+mxlint --mfu-audit surface, and tools/diagnose.py's roofline section.
+"""
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.analysis import lint_symbol
+from mxnet_tpu.ops import cost as cost_mod
+from mxnet_tpu.ops.registry import OP_REGISTRY, register
+from mxnet_tpu.telemetry import metrics, mfu
+
+TOOLS = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools")
+
+
+def _mlp():
+    d = mx.sym.var("data")
+    h = mx.sym.FullyConnected(d, num_hidden=16, name="fc1")
+    h = mx.sym.Activation(h, act_type="relu")
+    h = mx.sym.FullyConnected(h, num_hidden=4, name="fc2")
+    return mx.sym.SoftmaxOutput(h, name="softmax")
+
+
+# ------------------------------------------------------------ cost table
+def test_resnet20_cost_table_full_coverage():
+    from mxnet_tpu import models
+    sym = models.resnet.get_symbol(10, 20, "3,32,32")
+    t = mfu.cost_table(sym, {"data": (4, 3, 32, 32),
+                             "softmax_label": (4,)})
+    assert t["uncovered"] == []
+    assert t["covered_nodes"] == t["compute_nodes"]
+    assert t["flops"] > 1e8                      # ~3.4e8 fwd at batch 4
+    assert t["train_flops"] > t["flops"]
+    conv = t["per_op"]["Convolution"]
+    assert conv["flops"] / t["flops"] > 0.9      # conv-dominated
+
+
+def test_fc_flops_exact():
+    sym = _mlp()
+    t = mfu.cost_table(sym, {"data": (8, 32), "softmax_label": (8,)})
+    # fc1: 2*8*32*16 + 8*16 bias; fc2: 2*8*16*4 + 8*4
+    expect = (2 * 8 * 32 * 16 + 8 * 16) + (2 * 8 * 16 * 4 + 8 * 4)
+    assert t["per_op"]["FullyConnected"]["flops"] == expect
+
+
+def test_roofline_classification():
+    from mxnet_tpu import models
+    sym = models.resnet.get_symbol(10, 20, "3,32,32")
+    t = mfu.cost_table(sym, {"data": (4, 3, 32, 32),
+                             "softmax_label": (4,)})
+    peak, bw = mfu.device_peaks("TPU v5e")
+    rows = mfu.roofline(t, peak, bw)
+    assert rows[0]["op"] == "Convolution"        # biggest share first
+    for r in rows:
+        assert r["bound"] in ("compute", "memory")
+        assert 0 <= r.get("attainable_frac", 0) <= 1
+        assert r["ai"] >= 0
+    assert abs(sum(r["share"] for r in rows) - 1.0) < 1e-6
+    # no peaks known (CPU): rows still classify, no attainable_frac
+    rows_cpu = mfu.roofline(t)
+    assert all("attainable_frac" not in r for r in rows_cpu)
+
+
+def test_model_mfu_math():
+    assert mfu.model_mfu(1e12, 0.01, 1e14) == pytest.approx(1.0)
+    assert mfu.model_mfu(1e12, 0.01, None) is None
+    assert mfu.model_mfu(None, 0.01, 1e14) is None
+
+
+def test_device_peaks_table():
+    peak, bw = mfu.device_peaks("TPU v5e")
+    assert peak == 197e12 and bw == 819e9
+    assert mfu.device_peaks("TPU v4", dtype="f32")[0] == 137e12
+    assert mfu.device_peaks("Colossus") == (None, None)
+
+
+def test_record_gauges():
+    metrics.reset()
+    sym = _mlp()
+    t = mfu.cost_table(sym, {"data": (8, 32), "softmax_label": (8,)})
+    mfu.record_gauges(t, step_seconds=0.01, peak_flops=1e12)
+    g = metrics.get_metric("mfu.op.flops", op="FullyConnected")
+    assert g is not None and g.value > 0
+    assert metrics.get_metric("mfu.node_coverage").value == 1.0
+    assert metrics.get_metric("mfu.model").value > 0
+    assert metrics.get_metric("mfu.achieved_flops_per_sec").value > 0
+
+
+def test_executor_cost_table():
+    sym = _mlp()
+    exe = sym.simple_bind(mx.cpu(), data=(8, 32))
+    t = exe.cost_table()
+    assert t is not None and t["flops"] > 0
+
+
+# ------------------------------------------------- fit-loop MFU gauges
+def test_fit_records_mfu_gauges():
+    mx.telemetry.enable()
+    try:
+        metrics.reset()
+        rng = np.random.RandomState(0)
+        X = rng.rand(16, 32).astype(np.float32)
+        Y = (rng.rand(16) * 4).astype(np.float32)
+        it = mx.io.NDArrayIter(X, Y, batch_size=8,
+                               label_name="softmax_label")
+        mod = mx.mod.Module(_mlp(), context=mx.cpu())
+        mod.fit(it, num_epoch=1, initializer=mx.initializer.Uniform(0.1),
+                optimizer_params={"learning_rate": 0.1})
+        ach = metrics.get_metric("mfu.achieved_flops_per_sec")
+        assert ach is not None and ach.value > 0
+        cov = metrics.get_metric("mfu.node_coverage")
+        assert cov is not None and cov.value == 1.0
+        # no peak on the CPU backend: the MFU-of-peak gauge stays unset
+        assert metrics.get_metric("mfu.model") is None
+    finally:
+        mx.telemetry.disable()
+        metrics.reset()
+
+
+# --------------------------------------------------- MF601 + mxlint
+def test_mf601_fires_for_uncovered_op():
+    if "_nocost_probe" not in OP_REGISTRY:
+        register("_nocost_probe", inputs=("data",),
+                 simple=lambda attrs, x: x,
+                 infer_shape=lambda attrs, s, out_known=None:
+                 (s, [s[0]], []))
+        mx.sym._init_symbol_module(mx.sym.__dict__)
+    net = mx.sym._nocost_probe(mx.sym.var("data"))
+    report = lint_symbol(net, shapes={"data": (2, 4)})
+    assert "MF601" in report.rules
+    assert any(d.op == "_nocost_probe" for d in report)
+
+
+def test_bundled_models_mf601_clean():
+    """The flagship-model op set is fully seeded — MF601 stays quiet
+    over the zoo (the zero-false-positive gate for the new rule)."""
+    from mxnet_tpu import models
+    sym = models.inception_bn.get_symbol(10)
+    report = lint_symbol(sym, shapes={"data": (1, 3, 224, 224)})
+    assert "MF601" not in report.rules
+
+
+def test_mxlint_mfu_audit(capsys):
+    sys.path.insert(0, TOOLS)
+    try:
+        import mxlint
+        rc = mxlint.main(["--mfu-audit"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "missing cost metadata" in out
+        assert "Convolution" not in out          # covered op not listed
+    finally:
+        sys.path.remove(TOOLS)
+
+
+def test_optimizer_flops_helper():
+    assert cost_mod.optimizer_flops("sgd_mom", 100) == 600.0
+    assert cost_mod.optimizer_flops("adam", 10) == 120.0
+    assert cost_mod.optimizer_flops("unknown_opt", 10) == 60.0
+
+
+# ------------------------------------------------------- diagnose render
+def test_diagnose_renders_roofline(tmp_path):
+    sys.path.insert(0, TOOLS)
+    try:
+        import diagnose
+        lines = [
+            json.dumps({"type": "gauge", "name": "mfu.op.flops",
+                        "labels": {"op": "Convolution"}, "value": 9e9}),
+            json.dumps({"type": "gauge", "name": "mfu.op.ai",
+                        "labels": {"op": "Convolution"}, "value": 180.0}),
+            json.dumps({"type": "gauge", "name": "mfu.op.flops",
+                        "labels": {"op": "BatchNorm"}, "value": 1e9}),
+            json.dumps({"type": "gauge", "name": "mfu.op.ai",
+                        "labels": {"op": "BatchNorm"}, "value": 1.2}),
+            json.dumps({"type": "gauge", "name": "mfu.model",
+                        "labels": {}, "value": 0.41}),
+            json.dumps({"type": "gauge",
+                        "name": "mfu.achieved_flops_per_sec",
+                        "labels": {}, "value": 8.1e13}),
+            json.dumps({"type": "gauge", "name": "mfu.node_coverage",
+                        "labels": {}, "value": 0.97}),
+        ]
+        text = diagnose.render_jsonl(lines)
+        assert "roofline / MFU:" in text
+        assert "model MFU 41.0% of peak" in text
+        assert "coverage: 97%" in text
+        assert "Convolution" in text and "compute-bound" in text
+        assert "BatchNorm" in text and "memory-bound" in text
+
+        # crash-report path renders the same section from the metrics
+        # snapshot
+        crash = {
+            "type": "crash_report", "time": "t", "pid": 1,
+            "where": "executor.forward",
+            "metrics": {"counters": {}, "gauges": {
+                'mfu.op.flops{op="Convolution"}': 9e9,
+                'mfu.op.ai{op="Convolution"}': 180.0,
+                "mfu.node_coverage": 0.5,
+            }},
+            "ring": [],
+        }
+        text2 = diagnose.render_crash(crash)
+        assert "roofline / MFU:" in text2
+        assert "LOW" in text2                    # coverage warning
+    finally:
+        sys.path.remove(TOOLS)

@@ -118,6 +118,84 @@ def _lint_section(counters, lint_records):
     return lines
 
 
+def _fmt_flops(f):
+    f = float(f)
+    for unit in ("FLOP/s", "kFLOP/s", "MFLOP/s", "GFLOP/s", "TFLOP/s"):
+        if abs(f) < 1000 or unit == "TFLOP/s":
+            return f"{f:.1f} {unit}"
+        f /= 1000
+
+
+def _roofline_section(gauges, spans, top=8):
+    """MFU/roofline report from the mfu.* gauges (telemetry/mfu.py).
+
+    ``gauges`` is an iterable of (name, labels_dict, value). When op.*
+    spans carry real per-op wall time (NaiveEngine / monitored runs),
+    achieved FLOP/s per op is derived from them; under jit the per-op
+    rows are static attribution (share of step FLOPs + roofline bound).
+    """
+    per_op = {}
+    model = {}
+    for name, labels, val in gauges:
+        if name.startswith("mfu.op."):
+            op = labels.get("op", "?")
+            per_op.setdefault(op, {})[name.rsplit(".", 1)[-1]] = val
+        elif name.startswith("mfu."):
+            model[name] = val
+    if not per_op and not model:
+        return ["roofline/MFU: no mfu.* gauges recorded "
+                "(telemetry off, or no cost metadata)"]
+    lines = ["roofline / MFU:"]
+    if "mfu.model" in model:
+        ach = model.get("mfu.achieved_flops_per_sec")
+        lines.append(f"  model MFU {model['mfu.model'] * 100:.1f}% of peak"
+                     + (f" (achieved {_fmt_flops(ach)})" if ach else ""))
+    elif "mfu.achieved_flops_per_sec" in model:
+        lines.append("  achieved "
+                     f"{_fmt_flops(model['mfu.achieved_flops_per_sec'])} "
+                     "(no peak known for this device; MFU withheld)")
+    if "mfu.node_coverage" in model:
+        cov = model["mfu.node_coverage"]
+        note = "" if cov >= 0.9 else \
+            "  — LOW: run tools/mxlint.py --mfu-audit"
+        lines.append(f"  cost-metadata coverage: {cov * 100:.0f}% of "
+                     f"compute nodes{note}")
+    # real per-op wall time, when the run executed eagerly
+    op_secs = {}
+    for s in spans or []:
+        name = s.get("name", "")
+        if name.startswith("op."):
+            op_secs[name[3:]] = op_secs.get(name[3:], 0.0) + \
+                s.get("dur_us", 0) / 1e6
+    total = sum(r.get("flops", 0.0) for r in per_op.values()) or 1.0
+    rows = sorted(per_op.items(), key=lambda kv: -kv[1].get("flops", 0))
+    for op, rec in rows[:top]:
+        ai = rec.get("ai")
+        line = (f"  {op:<20} {rec.get('flops', 0) / total * 100:5.1f}% of "
+                f"FLOPs")
+        if ai is not None:
+            bound = "compute-bound" if ai >= 100 else "memory-bound"
+            line += f", AI {ai:7.1f} ({bound})"
+        if op in op_secs and op_secs[op] > 0 and rec.get("flops"):
+            line += f", achieved {_fmt_flops(rec['flops'] / op_secs[op])}"
+        lines.append(line)
+    return lines
+
+
+def _gauge_triples_from_series(gauges_by_series):
+    """{'name{k="v"}': value} -> [(name, labels_dict, value)]."""
+    out = []
+    for series, val in (gauges_by_series or {}).items():
+        name, labelstr = _strip_labels(series)
+        labels = {}
+        for part in labelstr.split(","):
+            if "=" in part:
+                k, _, v = part.partition("=")
+                labels[k.strip()] = v.strip().strip('"')
+        out.append((name, labels, val))
+    return out
+
+
 def _anomaly_section(anoms):
     if not anoms:
         return ["anomalies: none recorded"]
@@ -177,6 +255,9 @@ def render_crash(report, top=10):
     out += _anomaly_section(anoms)
     out += _lint_section(metrics.get("counters") or {},
                          [r for r in ring if r.get("kind") == "lint.finding"])
+    out += _roofline_section(
+        _gauge_triples_from_series(metrics.get("gauges") or {}),
+        [r for r in ring if r.get("kind") == "span"], top=top)
 
     # throughput from ring batch records
     batches = [r for r in ring if r.get("kind") == "module.fit.batch"
@@ -284,6 +365,10 @@ def render_jsonl(lines, top=10):
     out += _lint_section(counters,
                          [e for e in events
                           if e.get("kind") == "lint.finding"])
+    out += _roofline_section(
+        [(name, dict(labels), val)
+         for (name, labels), val in gauges.items()],
+        spans, top=top)
     out += _slowest_spans(spans, top)
 
     h = hists.get("module.fit.batch.seconds")

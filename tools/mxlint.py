@@ -145,6 +145,10 @@ def main(argv=None):
                         "(repeatable)")
     p.add_argument("--rules", action="store_true",
                    help="print the rule catalog and exit")
+    p.add_argument("--mfu-audit", action="store_true", dest="mfu_audit",
+                   help="list registry ops missing flops/bytes cost "
+                        "metadata (MFU coverage gaps; rule MF601) and "
+                        "exit")
     p.add_argument("--json", action="store_true", dest="as_json",
                    help="emit findings as one JSON document")
     p.add_argument("--strict", action="store_true",
@@ -158,6 +162,25 @@ def main(argv=None):
         for rule in sorted(RULES):
             sev, title = RULES[rule]
             print(f"{rule}  [{sev:<7}] {title}", file=out)
+        return 0
+
+    if args.mfu_audit:
+        # registry-wide coverage audit (MF601's graph-level cousin):
+        # every op here is invisible to MFU/roofline accounting
+        from mxnet_tpu.ops.cost import uncovered_ops
+        from mxnet_tpu.ops.registry import OP_REGISTRY
+        missing = uncovered_ops()
+        covered = len({id(o) for o in OP_REGISTRY.values()}) - len(missing)
+        if args.as_json:
+            json.dump({"covered_ops": covered,
+                       "uncovered_ops": missing}, out, indent=2)
+            print(file=out)
+        else:
+            for name in missing:
+                print(f"  MF601 [info] op {name!r} has no flops/bytes "
+                      "cost metadata", file=out)
+            print(f"mxlint: {covered} ops covered, {len(missing)} "
+                  "missing cost metadata (seed ops/cost.py)", file=out)
         return 0
 
     if not args.check and not args.paths:
