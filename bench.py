@@ -236,6 +236,24 @@ def setup_flax(imgs, labels):
     return one_step, flops, counter
 
 
+def measure_spmd_variant():
+    """The ``spmd`` variant row: paired spmd-vs-kvstore lap on the
+    local mesh (benchmarks/spmd_vs_kvstore.py), attached to the bench
+    JSON so the MULTICHIP series tracks the GSPMD path. Needs >= 2
+    devices (one device has no gradient collective to compare); returns
+    a skip note otherwise. Run AFTER the main paired laps — it compiles
+    and trains its own programs."""
+    import jax
+    try:
+        if len(jax.devices()) < 2:
+            return {"skipped": f"{len(jax.devices())} device(s); the "
+                    "spmd-vs-kvstore pairing needs a multi-device mesh"}
+        from benchmarks.spmd_vs_kvstore import main as spmd_lap
+        return spmd_lap(quiet=True)
+    except Exception as e:          # the variant must never sink the run
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
 def run_cpu_fallback():
     """Reduced ours-only measurement on the CPU backend.
 
@@ -313,6 +331,7 @@ def run_cpu_fallback():
         "n_laps": len(laps),
         "achieved_flops_per_sec": achieved,
         "roofline": roofline_rows,
+        "spmd": measure_spmd_variant(),
         "note": "accelerator backend unavailable; ours-only fused-step "
                 "throughput on the XLA CPU backend at a CIFAR-scale "
                 "operating point — NOT comparable to the flax-paired "
@@ -332,6 +351,13 @@ def _cpu_fallback_subprocess(reason):
     import subprocess
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     env.pop("JAX_PLATFORM_NAME", None)
+    # 8 virtual devices so the spmd variant row still measures a real
+    # mesh (matches the tier-1 suite's simulated-multichip environment)
+    xla_flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in xla_flags:
+        env["XLA_FLAGS"] = (xla_flags +
+                            " --xla_force_host_platform_device_count=8"
+                            ).strip()
     _log(f"accelerator unavailable ({reason}); "
          "re-running on the CPU backend")
     try:
@@ -506,6 +532,11 @@ def main():
         if isinstance(pallas_smoke[part], dict):
             pallas_smoke[part].pop("traceback", None)
 
+    # spmd variant (also after the paired laps, same reasoning): the
+    # GSPMD path vs the kvstore-overlap path on this host's mesh
+    _log("spmd variant (spmd_vs_kvstore paired lap)")
+    spmd_variant = measure_spmd_variant()
+
     # per-op MFU attribution + roofline from the registry cost metadata
     # (telemetry/mfu.py): coverage is attributed FLOPs over the XLA
     # compiled-program count — the honesty check on the per-op numbers
@@ -571,6 +602,7 @@ def main():
                               "warmup_laps_excluded_per_round": 1,
                               "consistent": paired_ok},
         "pallas_smoke": pallas_smoke,
+        "spmd": spmd_variant,
         "mfu_ours": mfu(ours_img_s, ours_flops),
         "mfu_flax": mfu(flax_img_s, flax_flops),
         "mfu_model_attributed": mfu(ours_img_s, attributed_flops),

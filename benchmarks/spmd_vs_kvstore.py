@@ -1,0 +1,158 @@
+"""SPMD vs kvstore-overlap training: paired-lap characterization.
+
+Measures the same ~13 MiB MLP trained two ways on an 8-virtual-device
+mesh (``XLA_FLAGS=--xla_force_host_platform_device_count=8`` anywhere,
+real chips on a TPU host):
+
+  * ``kvstore`` — the ready-order bucket all-reduce path
+    (kvstore_sched.py behind a single-process dist_sync store): fused
+    step disabled by the store arrangement, per-key push/pull with the
+    overlap scheduler; records ``exposed_comm_s`` from the
+    ``kvstore.exposed.seconds`` counter (host-visible collective wait).
+  * ``spmd`` — ``Module.fit(spmd=True, kvstore=None)``: ONE jitted
+    program over the named mesh, gradient collectives emitted by XLA
+    from the sharding specs. Exposed comm is structurally zero — there
+    is no host-side collective to wait on (the column is reported as
+    0.0 with the in-program note).
+
+The two sides alternate epoch-by-epoch (paired laps) so machine drift
+cancels to first order; the first epoch of each side warms compiles and
+is excluded. Writes ``benchmarks/results/spmd_vs_kvstore.json``; bench
+.py folds the headline ratio into its ``spmd`` variant row.
+
+Run: JAX_PLATFORMS=cpu python benchmarks/spmd_vs_kvstore.py
+"""
+from __future__ import annotations
+
+import json
+import os
+import statistics
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+BATCH = 32
+N_BATCHES = 8
+ROUNDS = 4
+CLASSES = 10
+FEATS = 256
+HIDDEN = 1024
+
+
+def _net():
+    import mxnet_tpu as mx
+    net = mx.sym.var("data")
+    for i in range(3):
+        net = mx.sym.FullyConnected(net, num_hidden=HIDDEN, name=f"fc{i}")
+        net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=CLASSES, name="out")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def _setup(side, n_dev):
+    """Bind + warm one arrangement; returns (module, iterator, opts)."""
+    import mxnet_tpu as mx
+    rng = np.random.RandomState(0)
+    imgs = rng.rand(N_BATCHES * BATCH, FEATS).astype(np.float32)
+    labels = (rng.rand(N_BATCHES * BATCH) * CLASSES).astype(np.float32)
+    it = mx.io.NDArrayIter(imgs, labels, batch_size=BATCH)
+    mod = mx.mod.Module(_net(), context=[mx.cpu(i) for i in range(n_dev)])
+    opt = (("learning_rate", 0.05), ("momentum", 0.9))
+    kwargs = dict(spmd=True, kvstore=None) if side == "spmd" \
+        else dict(spmd=False, kvstore="dist_sync")
+    mod.fit(it, num_epoch=1, initializer=mx.initializer.Xavier(),
+            optimizer_params=opt, **kwargs)
+    if side == "spmd":
+        assert mod._fused_armed, "spmd side must run the fused program"
+        assert mod._kvstore is None
+    else:
+        assert mod._kvstore is not None, \
+            "kvstore side must run the store path"
+    return mod, it, opt, kwargs
+
+
+def _timed_epoch(mod, it, opt, kwargs):
+    import jax
+    it.reset()
+    laps, lap = [], [time.perf_counter()]
+
+    def cb(param):
+        m = param.eval_metric
+        if getattr(m, "_pending", None):
+            float(jax.device_get(m._pending[-1][0]))
+        laps.append(time.perf_counter() - lap[0])
+        lap[0] = time.perf_counter()
+
+    mod.fit(it, num_epoch=1, optimizer_params=opt,
+            batch_end_callback=cb, **kwargs)
+    return laps
+
+
+def main(quiet=False):
+    """``quiet`` suppresses the stdout JSON line (bench.py embeds the
+    result in its own single-line payload instead)."""
+    import mxnet_tpu as mx
+    import jax
+
+    n_dev = min(8, len(jax.devices()))
+    sides = {}
+    for side in ("kvstore", "spmd"):
+        sides[side] = _setup(side, n_dev)
+
+    laps = {"kvstore": [], "spmd": []}
+    exposed = hidden = 0.0
+    for r in range(ROUNDS):
+        for side in ("kvstore", "spmd"):       # paired: same seconds
+            mod, it, opt, kwargs = sides[side]
+            mx.telemetry.reset()
+            mx.telemetry.enable()
+            try:
+                laps[side].extend(_timed_epoch(mod, it, opt, kwargs))
+            finally:
+                snap = mx.telemetry.snapshot()["counters"]
+                mx.telemetry.disable()
+            if side == "kvstore":
+                exposed += snap.get("kvstore.exposed.seconds", 0.0)
+                hidden += snap.get("kvstore.overlap.seconds", 0.0)
+
+    kv = sides["kvstore"][0]._kvstore
+    if kv is not None:
+        kv.close()
+
+    def img_s(ls):
+        return BATCH / statistics.median(ls)
+
+    result = {
+        "n_devices": n_dev,
+        "batch": BATCH,
+        "rounds": ROUNDS,
+        "spmd": {
+            "img_per_sec": round(img_s(laps["spmd"]), 1),
+            "exposed_comm_s": 0.0,
+            "note": "collectives live inside the jitted program; no "
+                    "host-side collective wait exists to expose",
+        },
+        "kvstore": {
+            "img_per_sec": round(img_s(laps["kvstore"]), 1),
+            "exposed_comm_s": round(exposed, 4),
+            "hidden_comm_s": round(hidden, 4),
+        },
+        "spmd_vs_kvstore": round(img_s(laps["spmd"]) /
+                                 img_s(laps["kvstore"]), 3),
+    }
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "results", "spmd_vs_kvstore.json")
+    os.makedirs(os.path.dirname(out), exist_ok=True)
+    with open(out, "w") as f:
+        json.dump(result, f, indent=2)
+    if not quiet:
+        print(json.dumps(result))
+    return result
+
+
+if __name__ == "__main__":
+    main()

@@ -74,6 +74,13 @@ RULES = {
                       "an output every step"),
     "HS504": ("info", "MXNET_FUSED_KEEP_GRADS materializes every "
                       "gradient as a program output"),
+    # ---- sharding / SPMD plan ------------------------------------------
+    "SH601": ("error", "bound array sharding diverges from the SPMD "
+                       "plan's PartitionSpec"),
+    "SH602": ("warning", "ctx_group-tagged parameter degraded to full "
+                         "replication on the model axis"),
+    "SH603": ("error", "donated SPMD-carry entry whose sharding cannot "
+                       "alias the program output (donation breaks)"),
     # ---- MFU coverage ---------------------------------------------------
     "MF601": ("info", "op has no flops/bytes cost metadata (invisible "
                       "to MFU/roofline accounting)"),

@@ -477,6 +477,98 @@ def collective_order(ctx, out):
                      "derive from symbol.list_arguments()"))
 
 
+def sharding_checker(ctx, out):
+    """SH6xx: the SPMD plan vs what is actually bound.
+
+    Only active on bindings carrying a ``parallel/spmd.SpmdPlan``: the
+    plan's PartitionSpecs are the contract every placement and the
+    fused program's donation discipline depend on — an array re-bound
+    with the wrong sharding silently changes the program XLA partitions
+    (wrong collective structure, broken donation aliasing), which no
+    runtime check catches before the step count makes it expensive.
+    """
+    g = ctx.exec_group
+    plan = getattr(g, "_spmd_plan", None) if g is not None else None
+    if plan is None:
+        return
+    exe = g.executor
+    from jax.sharding import NamedSharding
+
+    def matches(arr, want):
+        """Does a bound jax array's sharding realize ``want``?"""
+        try:
+            sh = arr.sharding
+            if hasattr(sh, "is_equivalent_to"):
+                return sh.is_equivalent_to(want, arr.ndim)
+            return str(sh) == str(want)
+        except Exception:
+            return True            # unknown sharding kinds: no finding
+
+    # SH601: bound param/aux arrays vs the plan's specs (data/label
+    # arrays are re-placed per batch and are not audited here)
+    ad = exe.arg_dict
+    for nm in g.param_names:
+        arr = ad.get(nm)
+        if arr is None:
+            continue
+        want = plan.param_sharding(nm)
+        if not matches(arr.asjax(), want):
+            out.append(Diagnostic(
+                "SH601", f"parameter {nm!r} is bound with sharding "
+                f"{arr.asjax().sharding} but the SPMD plan places it as "
+                f"{want.spec}", node=nm,
+                hint="place params through the plan (set_params / "
+                     "exec_group._place); do not _set raw device arrays"))
+
+    # SH602: a ctx_group-tagged param the plan could NOT shard over the
+    # model axis — it silently replicates, paying full memory on every
+    # device of the axis the tag asked to split over
+    for nm, reason in sorted(plan.unsharded_tagged.items()):
+        out.append(Diagnostic(
+            "SH602", f"parameter {nm!r} is ctx_group-tagged for the "
+            f"model axis but stays fully replicated: {reason}", node=nm,
+            hint="pad the dimension to a multiple of the axis size, "
+                 "shrink the model axis, or drop the ctx_group tag"))
+
+    # SH603: donation over the spmd carry — the fused program donates
+    # watched params and state leaves and emits outputs constrained to
+    # the plan's specs; an input whose committed sharding differs can't
+    # alias its output buffer (XLA copies: double memory, or deletes a
+    # still-referenced buffer under a later reshard)
+    if getattr(g, "_fused_prog", None) is not None:
+        watched = list(getattr(g, "_fused_watched", ()) or ())
+        states = getattr(g, "_fused_states", {}) or {}
+        import jax as _jax
+        for nm in watched:
+            arr = ad.get(nm)
+            if arr is not None and not matches(arr.asjax(),
+                                               plan.param_sharding(nm)):
+                out.append(Diagnostic(
+                    "SH603", f"donated parameter {nm!r} enters the "
+                    "fused step with a sharding that differs from the "
+                    "program's output spec "
+                    f"{plan.param_spec(nm)}; donation cannot alias",
+                    node=nm,
+                    hint="re-place the param per the plan before the "
+                         "next step (set_params does this)"))
+                continue
+            want_state = plan.state_sharding(nm)
+            for leaf in _jax.tree.leaves(states.get(nm, ())):
+                shaped_like_param = getattr(leaf, "shape", None) == \
+                    getattr(arr, "shape", None)
+                want = want_state if (plan.zero or shaped_like_param) \
+                    else plan.replicated
+                if not matches(leaf, want):
+                    out.append(Diagnostic(
+                        "SH603", f"optimizer-state leaf of {nm!r} is "
+                        f"sharded {leaf.sharding} but the plan's state "
+                        f"spec is {want.spec}; the donated carry "
+                        "cannot alias", node=nm,
+                        hint="import states through "
+                             "import_fused_states/import_staged_state"))
+                    break
+
+
 def retrace_churn(ctx, out):
     """RC4xx: what would mint a new program_cache key per step.
 
@@ -606,6 +698,7 @@ PASSES = OrderedDict([
     ("graph_verifier", graph_verifier),
     ("donation_checker", donation_checker),
     ("collective_order", collective_order),
+    ("sharding_checker", sharding_checker),
     ("retrace_churn", retrace_churn),
     ("host_sync", host_sync),
     ("mfu_coverage", mfu_coverage),

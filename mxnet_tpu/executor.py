@@ -357,9 +357,17 @@ class Executor:
 
     def __init__(self, symbol, ctx, args, args_grad=None, grad_req="write",
                  aux_states=None, group2ctx=None, shared_exec=None,
-                 compute_dtype=None, mirror=None, validate=None):
+                 compute_dtype=None, mirror=None, validate=None,
+                 mesh_token=None):
         self._symbol = symbol
         self._ctx = ctx
+        # device-topology token for the program-cache key: compiled
+        # programs bake in their mesh's collective structure (psum /
+        # reduce-scatter shard counts), so a binding over a different
+        # mesh or device must never reuse them. Exec groups pass their
+        # mesh/plan token; direct bindings key on the single device.
+        self._mesh_token = mesh_token if mesh_token is not None else \
+            ("dev", ctx.device_type, int(getattr(ctx, "device_id", 0)))
         self._group2ctx = group2ctx or {}
         self._compute_dtype = compute_dtype
         self._monitor_callback = None
@@ -463,6 +471,7 @@ class Executor:
                           for nm, a in zip(self.aux_names, self.aux_arrays)
                           if a is not None),
                     ctx.device_type,
+                    self._mesh_token,
                     bool(_layout_mod.layout_opt_enabled()),
                     str(compute_dtype) if compute_dtype is not None else None,
                     self._remat_segments,

@@ -21,6 +21,14 @@ construction. This module makes the choice *measured*:
   winner process-wide. Off-TPU, auto resolves to XLA without timing,
   so CPU results are bit-identical to ``xla``.
 
+Tier selection composes unchanged under the SPMD mesh
+(``Module.fit(spmd=True)``): dispatch happens inside the traced runner
+per op, before XLA partitions the program, so the chosen implementation
+is sharding-agnostic — the partitioner splits whichever kernel won
+exactly as it would the composition (pinned by tests/test_spmd.py's
+tier-parity gate; per-shape autotune keys see the *global* logical
+shapes, not the per-device shards).
+
 Winners are cached in-process alongside the program cache and follow
 the same keying discipline (``program_cache.attr_cache_stable``: attrs
 that would churn or collide a cache key make the op untunable and it

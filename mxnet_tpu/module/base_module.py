@@ -260,7 +260,8 @@ class BaseModule:
             initializer=None, arg_params=None, aux_params=None,
             allow_missing=False, force_rebind=False, force_init=False,
             begin_epoch=0, num_epoch=None, validation_metric=None,
-            monitor=None, steps_per_dispatch=None, zero_stage=None):
+            monitor=None, steps_per_dispatch=None, zero_stage=None,
+            spmd=None, mesh=None):
         """The training loop (reference base_module.py:368-507 contract).
 
         ``steps_per_dispatch`` (default ``MXNET_STEPS_PER_DISPATCH``,
@@ -275,6 +276,16 @@ class BaseModule:
         each device updates its 1/N parameter shard with 1/N of the
         optimizer state, updated params all-gather back
         (docs/performance.md). Numerically identical to stage 0.
+
+        ``spmd`` (default ``MXNET_SPMD``, else off): True binds the
+        GSPMD arrangement — one jitted program over the named mesh
+        (``mesh``: a ``parallel.MeshConfig``; default ``MXNET_MESH_*``
+        env, else a 1-D data axis over the contexts), params sharded per
+        ctx_group tags, the gradient all-reduce/reduce-scatter emitted
+        by XLA from the sharding specs, kvstore optional (pass
+        ``kvstore=None``; a local store is dropped automatically).
+        Numerically equivalent to the kvstore path
+        (docs/performance.md).
         """
         from ..initializer import Uniform
         if num_epoch is None:
@@ -285,6 +296,10 @@ class BaseModule:
         self._steps_per_dispatch = max(1, int(steps_per_dispatch))
         if zero_stage is not None:
             self._zero_stage = int(zero_stage)
+        if spmd is not None:
+            self._spmd = bool(spmd)
+        if mesh is not None:
+            self._mesh_config = mesh
         self._prepare_fit(train_data, initializer or Uniform(0.01),
                           arg_params, aux_params, allow_missing,
                           force_rebind, force_init, kvstore, optimizer,

@@ -6,15 +6,27 @@ Executor per context (:537-629), fan out forward/backward, sum gradients via
 KVStore.
 
 TPU-native design — the central SPMD decision of this framework: bind ONE
-executor whose data arrays are sharded over a ``jax.sharding.Mesh`` data
-axis and whose params are replicated. XLA's SPMD partitioner then runs the
-very same jitted fwd+bwd program on every chip and inserts the gradient
+executor whose data arrays are sharded over a first-class named
+``jax.sharding.Mesh`` (``parallel/mesh.build_mesh``) and whose params are
+placed per a sharding plan. XLA's SPMD partitioner then runs the very
+same jitted fwd+bwd program on every chip and inserts the gradient
 all-reduce (psum over ICI) automatically — replacing the reference's
-per-device executors + KVStore push/pull with compiler-inserted collectives
-(SURVEY.md §5.8 "TPU-native equivalent"). The class keeps the reference's
-surface (param_arrays/grad_arrays/forward/backward/update_metric) so Module
-and the KVStore update paths work unchanged: with one logical executor,
-``param_arrays`` holds one entry per param.
+per-device executors + KVStore push/pull with compiler-inserted
+collectives (SURVEY.md §5.8 "TPU-native equivalent"). Two arrangements:
+
+* default — 1-D ``data`` mesh over the bound contexts, params
+  replicated (the shape every kvstore-era test pins);
+* ``spmd=True`` (``Module.bind/fit(spmd=True)`` / ``MXNET_SPMD``) — the
+  multi-axis mesh from ``MeshConfig``/``MXNET_MESH_*`` with a
+  ``parallel/spmd.SpmdPlan``: params sharded per ``placement.py``'s
+  ctx_group lowering on the ``model`` axis, optimizer state riding the
+  same specs, ZeRO-1 as a spec change on the state leaves, kvstore
+  optional.
+
+The class keeps the reference's surface (param_arrays/grad_arrays/
+forward/backward/update_metric) so Module and the KVStore update paths
+work unchanged: with one logical executor, ``param_arrays`` holds one
+entry per param.
 """
 from __future__ import annotations
 
@@ -25,13 +37,16 @@ import os
 import numpy as np
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..base import MXNetError
 from ..ndarray import NDArray, zeros as nd_zeros
 from ..io import DataDesc
 from .. import program_cache as _progcache
 from .. import telemetry as _telemetry
+from ..parallel import mesh as _mesh_mod
+from ..parallel import zero as _zero_mod
+from ..parallel.spmd import SpmdPlan
 
 __all__ = ["DataParallelExecutorGroup"]
 
@@ -40,7 +55,8 @@ class DataParallelExecutorGroup:
     def __init__(self, symbol, contexts, workload, data_shapes, label_shapes,
                  param_names, for_training, inputs_need_grad,
                  shared_group=None, logger=logging, fixed_param_names=None,
-                 grad_req="write", state_names=None, compute_dtype=None):
+                 grad_req="write", state_names=None, compute_dtype=None,
+                 spmd=False, mesh_config=None):
         self.symbol = symbol
         self.compute_dtype = compute_dtype
         self.contexts = contexts
@@ -52,6 +68,7 @@ class DataParallelExecutorGroup:
         self.state_names = state_names or []
         self.param_names = param_names
         self._zero_plan = None          # set by setup_fused_step
+        self._state_layout = None       # flat-shard state transport
 
         self.arg_names = symbol.list_arguments()
         self.aux_names = symbol.list_auxiliary_states()
@@ -86,6 +103,9 @@ class DataParallelExecutorGroup:
             self.grad_req[name] = req
 
         # ---- mesh construction over the bound contexts -------------------
+        # both arrangements go through parallel/mesh.build_mesh — ONE
+        # first-class named mesh per binding (the 1-D ad-hoc Mesh this
+        # class used to build inline is the degenerate data-only case)
         devices = [c.jax_device() for c in contexts]
         self._n_dev = len(devices)
         if self._n_dev > 1 and len(set(devices)) != self._n_dev:
@@ -95,25 +115,39 @@ class DataParallelExecutorGroup:
                 "On a CPU host set XLA_FLAGS="
                 "--xla_force_host_platform_device_count=N to get N virtual "
                 "devices.")
-        if self._n_dev > 1:
-            self._mesh = Mesh(np.array(devices), ("data",))
+        self._spmd_plan = None
+        if spmd:
+            # param specs are derived at bind time (shapes needed);
+            # zero is enabled at optimizer-arming time
+            self._spmd_plan = SpmdPlan(
+                SpmdPlan.build_mesh_for(devices, mesh_config))
+            self._mesh = self._spmd_plan.mesh
+            self._data_sharding = self._spmd_plan.data_sharding()
+            self._repl_sharding = self._spmd_plan.replicated
+            self._stacked_sharding = self._spmd_plan.data_sharding(
+                stacked=True)
+            self._n_data = self._spmd_plan.n_data_shards()
+        elif self._n_dev > 1:
+            self._mesh = _mesh_mod.build_mesh(devices=devices)
             self._data_sharding = NamedSharding(self._mesh, P("data"))
             self._repl_sharding = NamedSharding(self._mesh, P())
             # K-stacked batches: axis 0 is the scan step, batch is axis 1
             self._stacked_sharding = NamedSharding(self._mesh,
                                                    P(None, "data"))
+            self._n_data = self._n_dev
         else:
             self._mesh = None
             self._data_sharding = None
             self._repl_sharding = None
             self._stacked_sharding = None
+            self._n_data = 1
 
         self.batch_size = data_shapes[0].shape[
             DataDesc.get_batch_axis(data_shapes[0].layout)]
-        if self._n_dev > 1 and self.batch_size % self._n_dev != 0:
+        if self._n_data > 1 and self.batch_size % self._n_data != 0:
             raise MXNetError(
                 f"batch size {self.batch_size} must be divisible by the "
-                f"number of devices {self._n_dev}")
+                f"data-axis size {self._n_data}")
 
         self.data_shapes = data_shapes
         self.label_shapes = label_shapes
@@ -121,12 +155,17 @@ class DataParallelExecutorGroup:
         self._bind_exec(shared_group)
 
     # ------------------------------------------------------------------ bind
-    def _place(self, arr, kind):
-        """Device-place a jnp array: batch-sharded or replicated."""
+    def _place(self, arr, kind, name=None):
+        """Device-place a jnp array: batch-sharded, per-plan param
+        sharding (SPMD mode), or replicated."""
         if self._mesh is None:
             return jax.device_put(arr, self.contexts[0].jax_device())
-        sharding = self._data_sharding if kind == "data" \
-            else self._repl_sharding
+        if kind == "data":
+            sharding = self._data_sharding
+        elif self._spmd_plan is not None and name is not None:
+            sharding = self._spmd_plan.param_sharding(name)
+        else:
+            sharding = self._repl_sharding
         return jax.device_put(arr, sharding)
 
     def _bind_exec(self, shared_group):
@@ -137,6 +176,12 @@ class DataParallelExecutorGroup:
         arg_shapes, out_shapes, aux_shapes = \
             self.symbol.infer_shape(**shapes)
         arg_types = {d.name: d.dtype for d in self.data_shapes}
+
+        if self._spmd_plan is not None:
+            # lower ctx_group tags onto the model axis now that shapes
+            # are known (re-derived on reshape: divisibility may change)
+            self._spmd_plan.derive_param_specs(
+                self.symbol, dict(zip(self.arg_names, arg_shapes)))
 
         shared_params = {}
         if shared_group is not None:
@@ -159,10 +204,11 @@ class DataParallelExecutorGroup:
                 dtype = arg_types.get(name, np.float32)
                 args[name] = NDArray(self._place(
                     jnp.zeros(shape, dtype=np.dtype(dtype)
-                              if dtype != np.float64 else np.float32), kind))
+                              if dtype != np.float64 else np.float32),
+                    kind, name))
             if self.grad_req.get(name, "null") != "null":
                 grads[name] = NDArray(self._place(
-                    jnp.zeros(shape, dtype=np.float32), kind))
+                    jnp.zeros(shape, dtype=np.float32), kind, name))
         aux = {}
         shared_aux = {}
         if shared_group is not None:
@@ -170,11 +216,23 @@ class DataParallelExecutorGroup:
                                   shared_group.executor.aux_arrays))
         for name, shape in zip(self.aux_names, aux_shapes):
             aux[name] = shared_aux.get(name) or NDArray(
-                self._place(jnp.zeros(shape, dtype=np.float32), "param"))
+                self._place(jnp.zeros(shape, dtype=np.float32), "param",
+                            name))
 
+        # device-topology token for the program-cache keys: a compiled
+        # program bakes its mesh's collective structure in, so a mesh
+        # change (1→8 devices, axis reshape, different spec set) must
+        # never reuse a stale program
+        if self._spmd_plan is not None:
+            mesh_token = self._spmd_plan.cache_token()
+        elif self._mesh is not None:
+            mesh_token = _mesh_mod.mesh_token(self._mesh)
+        else:
+            mesh_token = None           # Executor derives a device token
         self.executor = Executor(self.symbol, self.contexts[0], args, grads,
                                  self.grad_req, aux,
-                                 compute_dtype=self.compute_dtype)
+                                 compute_dtype=self.compute_dtype,
+                                 mesh_token=mesh_token)
         self.execs = [self.executor]  # reference-compat alias
 
         # flat layout — one logical sharded executor, so one array per
@@ -210,6 +268,7 @@ class DataParallelExecutorGroup:
         """
         from ..executor import naive_engine_active
         self._zero_plan = None
+        self._state_layout = None
         plan = optimizer.fused_plan()
         if plan is None or not self.for_training or self.inputs_need_grad:
             return False
@@ -229,16 +288,28 @@ class DataParallelExecutorGroup:
 
         # comm plan: in-program reduce-scatter + sharded update (ZeRO-1)
         # needs a data mesh and an elementwise update; anything else
-        # keeps the replicated all-reduce plan
-        if (zero_stage and self._mesh is not None
+        # keeps the replicated all-reduce plan. Under the SPMD plan,
+        # ZeRO-1 is purely a spec change: state_spec flips to P('data')
+        # over the flat layout and the step applies it via
+        # zero.apply_spec_update — no plan object threaded through.
+        spmd_plan = self._spmd_plan
+        can_shard = (self._mesh is not None and
+                     (spmd_plan.can_zero() if spmd_plan is not None
+                      else self._n_data > 1))
+        if (zero_stage and can_shard
                 and getattr(optimizer, "fused_update_elementwise", False)):
-            from ..parallel.zero import ZeroPlan
-            self._zero_plan = ZeroPlan(self._mesh, "data")
+            if spmd_plan is not None:
+                spmd_plan.enable_zero()
+                self._state_layout = spmd_plan.state_layout
+            else:
+                from ..parallel.zero import ZeroPlan
+                self._zero_plan = ZeroPlan(self._mesh, "data")
+                self._state_layout = self._zero_plan
         elif zero_stage:
             self.logger.info(
-                "zero_stage=%s requested but unavailable (mesh=%s, "
+                "zero_stage=%s requested but unavailable (data shards=%s, "
                 "elementwise=%s); using the replicated update plan",
-                zero_stage, self._mesh is not None,
+                zero_stage, self._n_data,
                 getattr(optimizer, "fused_update_elementwise", False))
         zero_plan = self._zero_plan
 
@@ -292,7 +363,28 @@ class DataParallelExecutorGroup:
             new_w, new_states = {}, {}
             for i, nm in enumerate(watched):
                 g = grads[nm].astype(w[nm].dtype)
-                if zero_plan is None:
+                if spmd_plan is not None:
+                    # spec-driven: the plan's PartitionSpecs pin the
+                    # gradient (the psum/reduce-scatter XLA emits), the
+                    # update layout, and the new weights (donation needs
+                    # input sharding == output sharding)
+                    if spmd_plan.zero:
+                        nw, ns = _zero_mod.apply_spec_update(
+                            update, w[nm], g, states[nm],
+                            lr_arr[i], wd_arr[i], spmd_plan.mesh,
+                            spmd_plan.state_spec(nm),
+                            out_spec=spmd_plan.param_spec(nm))
+                    else:
+                        p_sh = spmd_plan.param_sharding(nm)
+                        g = jax.lax.with_sharding_constraint(g, p_sh)
+                        nw, ns = update(w[nm], g, states[nm],
+                                        lr_arr[i], wd_arr[i])
+                        nw = jax.lax.with_sharding_constraint(nw, p_sh)
+                        ns = jax.tree.map(
+                            lambda x: jax.lax.with_sharding_constraint(
+                                x, p_sh) if x.shape == nw.shape else x,
+                            ns)
+                elif zero_plan is None:
                     nw, ns = update(w[nm], g, states[nm],
                                     lr_arr[i], wd_arr[i])
                 else:
@@ -341,10 +433,12 @@ class DataParallelExecutorGroup:
         # the comm-plan token keys the traced collective structure:
         # replicated all-reduce vs reduce-scatter/shard-update/all-gather
         # trace differently even for identical symbols and optimizers
+        zero_armed = zero_plan is not None or \
+            (spmd_plan is not None and spmd_plan.zero)
         self._fused_cache_key = exe.program_cache_key(
             "fused_step", tuple(watched), tuple(metric_pairs), keep_grads,
             optimizer.fused_plan_token(),
-            ("comm", "rs" if zero_plan is not None else "ar"))
+            ("comm", "rs" if zero_armed else "ar"))
         self._fused_prog = None
         if self._fused_cache_key is not None:
             self._fused_prog = _progcache.get(self._fused_cache_key)
@@ -379,54 +473,62 @@ class DataParallelExecutorGroup:
         self._fused_states = {}
         for nm in watched:
             w = exe.arg_dict[nm].asjax()
-            if zero_plan is None:
-                self._fused_states[nm] = jax.tree.map(
-                    lambda x, _w=w: jax.device_put(x, _w.sharding),
-                    init_state(w))
-            else:
-                # created directly in the (n, chunk) sharded layout:
-                # each device holds only its 1/N state slice
-                self._fused_states[nm] = zero_plan.init_state(
+            if self._state_layout is not None:
+                # ZeRO-1 (either plan): created directly in the
+                # (n, chunk) sharded layout — each device holds only
+                # its 1/N state slice
+                self._fused_states[nm] = self._state_layout.init_state(
                     init_state, w)
+            else:
+                # param-shaped state rides the param's own sharding
+                # (replicated, or the SPMD plan's model-axis spec);
+                # differently-shaped leaves replicate
+                def _put(x, _w=w):
+                    if self._mesh is None or \
+                            getattr(x, "shape", ()) == _w.shape:
+                        return jax.device_put(x, _w.sharding)
+                    return jax.device_put(x, self._repl_sharding)
+                self._fused_states[nm] = jax.tree.map(_put, init_state(w))
         return True
 
     # ----------------------------------------------- fused-state transport
     def export_fused_states(self):
         """Host-format (param-shaped numpy) fused optimizer states — the
         checkpoint representation, identical for the replicated and the
-        ZeRO-sharded plans so checkpoints move between arrangements."""
-        if self._zero_plan is None:
+        ZeRO-sharded layouts (either plan) so checkpoints move between
+        arrangements."""
+        if self._state_layout is None:
             return jax.tree.map(np.asarray, self._fused_states)
-        return {nm: self._zero_plan.export_state(
+        return {nm: self._state_layout.export_state(
                     st, self.executor.arg_dict[nm].shape)
                 for nm, st in self._fused_states.items()}
 
     def import_fused_states(self, states_host):
         """Load host-format states back into the armed plan's layout."""
-        if self._zero_plan is None:
+        if self._state_layout is None:
             self._fused_states = jax.tree.map(
                 lambda old, new: jax.device_put(np.asarray(new),
                                                 old.sharding),
                 self._fused_states, states_host)
             return
         self._fused_states = {
-            nm: (self._zero_plan.import_state(states_host[nm])
+            nm: (self._state_layout.import_state(states_host[nm])
                  if nm in states_host else st)
             for nm, st in self._fused_states.items()}
 
     def import_staged_state(self, nm, staged):
         """Project one param's staged (param-shaped, possibly nested)
         optimizer state onto the fused device layout."""
-        zero_plan = self._zero_plan
+        layout = self._state_layout
 
         def walk(old, new):
             if isinstance(old, (tuple, list)):
                 return type(old)(walk(o, n) for o, n in zip(old, new))
             arr = new.asnumpy() if isinstance(new, NDArray) \
                 else np.asarray(new)
-            if zero_plan is not None:
-                return jax.device_put(zero_plan._flat(jnp.asarray(arr)),
-                                      zero_plan.sharded)
+            if layout is not None:
+                return jax.device_put(layout._flat(jnp.asarray(arr)),
+                                      layout.sharded)
             return jax.device_put(arr, old.sharding)
 
         self._fused_states[nm] = walk(self._fused_states[nm], staged)
@@ -434,9 +536,9 @@ class DataParallelExecutorGroup:
     def defused_states(self):
         """Device-side fused states in param shape, for migrating into
         the staged updater (Module._defuse)."""
-        if self._zero_plan is None:
+        if self._state_layout is None:
             return dict(self._fused_states)
-        return {nm: self._zero_plan.device_state_to_param_shape(
+        return {nm: self._state_layout.device_state_to_param_shape(
                     st, self.executor.arg_dict[nm].shape)
                 for nm, st in self._fused_states.items()}
 
@@ -689,7 +791,8 @@ class DataParallelExecutorGroup:
             if name in ad:
                 val = arr.asjax() if isinstance(arr, NDArray) \
                     else jnp.asarray(arr)
-                val = self._place(val.astype(ad[name].dtype), "param")
+                val = self._place(val.astype(ad[name].dtype), "param",
+                                  name)
                 if fused and name in self._fused_watched:
                     # the fused step donates its param inputs; astype/
                     # device_put are identity when dtype+placement already
@@ -704,7 +807,7 @@ class DataParallelExecutorGroup:
                 val = arr.asjax() if isinstance(arr, NDArray) \
                     else jnp.asarray(arr)
                 xd[name]._set(self._place(val.astype(xd[name].dtype),
-                                          "param"))
+                                          "param", name))
 
     def get_params(self, arg_params, aux_params):
         """Copy params out (device->host). reference: executor_group.py."""

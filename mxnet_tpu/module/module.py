@@ -71,6 +71,8 @@ class Module(BaseModule):
         self._fused_done = False
         self._steps_per_dispatch = 1
         self._zero_stage = None         # None -> MXNET_ZERO_STAGE, else 0
+        self._spmd = None               # None -> MXNET_SPMD at bind time
+        self._mesh_config = None        # parallel.MeshConfig (spmd mode)
 
     # ------------------------------------------------------------ checkpoint
     @staticmethod
@@ -192,10 +194,30 @@ class Module(BaseModule):
         self._params_dirty = False
 
     # ------------------------------------------------------------------ bind
+    def _resolve_spmd(self, explicit=None):
+        """SPMD mode: explicit bind arg > fit kwarg (self._spmd) >
+        MXNET_SPMD env; default off (the kvstore-era arrangement)."""
+        import os
+        if explicit is not None:
+            return bool(explicit)
+        if self._spmd is not None:
+            return bool(self._spmd)
+        return os.environ.get("MXNET_SPMD", "").lower() in \
+            ("1", "true", "yes", "on")
+
     def bind(self, data_shapes, label_shapes=None, for_training=True,
              inputs_need_grad=False, force_rebind=False, shared_module=None,
-             grad_req="write"):
-        """Compile the symbol into the sharded executor group."""
+             grad_req="write", spmd=None, mesh=None):
+        """Compile the symbol into the sharded executor group.
+
+        ``spmd=True`` (or ``MXNET_SPMD=1`` / ``fit(spmd=True)``) binds
+        the GSPMD arrangement: one program over the named mesh from
+        ``mesh`` (a ``parallel.MeshConfig``; default ``MXNET_MESH_*``
+        env, else a 1-D data axis over the contexts), params sharded per
+        the symbol's ctx_group tags on the model axis, gradient
+        collectives emitted by XLA from the sharding specs — the
+        kvstore becomes optional (docs/performance.md).
+        """
         if force_rebind:
             self._reset_bind()
         if self.binded:
@@ -209,6 +231,9 @@ class Module(BaseModule):
         self.inputs_need_grad = inputs_need_grad
         self.binded = True
         self._grad_req = grad_req
+        if mesh is not None:
+            self._mesh_config = mesh
+        self._spmd_active = self._resolve_spmd(spmd)
 
         shared_group = None
         if shared_module is not None:
@@ -222,7 +247,8 @@ class Module(BaseModule):
             shared_group, logger=self.logger,
             fixed_param_names=self._fixed_param_names, grad_req=grad_req,
             state_names=self._state_names,
-            compute_dtype=self._compute_dtype)
+            compute_dtype=self._compute_dtype,
+            spmd=self._spmd_active, mesh_config=self._mesh_config)
 
         if shared_module is not None:
             self.params_initialized = True
@@ -251,6 +277,28 @@ class Module(BaseModule):
             self.logger.warning("optimizer is already initialized; "
                                 "ignoring init_optimizer()")
             return
+
+        # SPMD mode: the gradient collectives live inside the jitted
+        # program (XLA emits them from the sharding specs) — a local/
+        # device kvstore would be a second, redundant reduction plan, so
+        # it is dropped; dist_* stores keep owning cross-process
+        # reduction (the mesh here is single-process) and disable spmd's
+        # in-program arrangement via the normal fused-step gating.
+        spmd_plan = getattr(self._exec_group, "_spmd_plan", None)
+        if spmd_plan is not None and kvstore is not None:
+            kv_type = kvstore if isinstance(kvstore, str) \
+                else getattr(kvstore, "type", "")
+            if "dist" in kv_type:
+                self.logger.warning(
+                    "spmd mode with a %r kvstore: cross-process "
+                    "reduction stays on the kvstore path (the in-program "
+                    "collectives cover this process's mesh only)", kv_type)
+            else:
+                self.logger.info(
+                    "spmd mode: %r kvstore dropped — gradient "
+                    "collectives are emitted by XLA from the mesh "
+                    "sharding specs", kv_type)
+                kvstore = None
 
         kvstore, update_on_kvstore = _create_kvstore(
             kvstore, len(self._context), self._arg_params)
@@ -294,6 +342,12 @@ class Module(BaseModule):
             self._fused_armed = bool(
                 self._exec_group.setup_fused_step(optimizer,
                                                   zero_stage=zero_stage))
+        if spmd_plan is not None and not self._fused_armed:
+            self.logger.warning(
+                "spmd requested but the fused train step could not arm "
+                "(monitor/NaiveEngine/non-fusable optimizer or grad_req, "
+                "or a dist kvstore); the staged per-phase path runs over "
+                "the mesh instead")
 
         if kvstore:
             _initialize_kvstore(kvstore=kvstore,

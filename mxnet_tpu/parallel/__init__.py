@@ -8,7 +8,9 @@ parallelism is a sharding of one jitted program over a
 over ICI/DCN (psum/all_gather/reduce_scatter/ppermute).
 """
 from .mesh import (MeshConfig, build_mesh, current_mesh, mesh_scope,
-                   data_sharding, replicated, shard, DEFAULT_AXES)
+                   data_sharding, replicated, shard, mesh_token,
+                   DEFAULT_AXES)
 from .collectives import (all_reduce, all_gather, reduce_scatter, ppermute,
                           barrier, shard_map)
-from .zero import ZeroPlan
+from .zero import ZeroPlan, FlatShardLayout, apply_spec_update
+from .spmd import SpmdPlan

@@ -17,6 +17,7 @@ physical layout declaration).
 """
 from __future__ import annotations
 
+import os
 import threading
 from dataclasses import dataclass, field
 
@@ -25,6 +26,9 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 DEFAULT_AXES = ("data", "model", "seq", "pipe", "expert")
+
+#: env knobs overriding per-axis mesh sizes (docs/env_var.md)
+ENV_AXIS_VARS = {a: f"MXNET_MESH_{a.upper()}" for a in DEFAULT_AXES}
 
 _LOCAL = threading.local()
 
@@ -44,6 +48,29 @@ class MeshConfig:
                 "pipe": self.pipe, "expert": self.expert}
         base.update(self.extras)
         return {k: v for k, v in base.items() if v > 1}
+
+    @classmethod
+    def from_env(cls, n_devices=None):
+        """MeshConfig from the MXNET_MESH_* env overrides, or None when
+        no axis is set. Unset axes default to 1; a mesh built from the
+        result therefore consumes exactly the product of the set axes
+        (callers typically default the data axis to the device count
+        when no override is present)."""
+        sizes = {}
+        for axis, var in ENV_AXIS_VARS.items():
+            raw = os.environ.get(var, "")
+            if raw:
+                try:
+                    sizes[axis] = int(raw)
+                except ValueError:
+                    raise ValueError(f"{var}={raw!r} is not an integer")
+        if not sizes:
+            return None
+        if n_devices is not None and "data" not in sizes:
+            other = int(np.prod(list(sizes.values())))
+            if other and n_devices % other == 0 and n_devices // other > 1:
+                sizes["data"] = n_devices // other
+        return cls(**sizes)
 
 
 def build_mesh(config=None, devices=None, **axis_sizes):
@@ -93,6 +120,20 @@ def current_mesh():
     if stack:
         return stack[-1]
     return None
+
+
+def mesh_token(mesh):
+    """Stable program-cache token naming a mesh's topology: platform,
+    axis layout, and the exact device assignment. Two bindings whose
+    meshes differ in ANY of these must never share a compiled program —
+    traced collective structure (psum/reduce-scatter shapes, ZeRO shard
+    counts) bakes the topology in (docs/performance.md; the PR-7
+    program-cache hazard fix)."""
+    devs = tuple(int(getattr(d, "id", -1)) for d in mesh.devices.flat)
+    plat = getattr(next(iter(mesh.devices.flat)), "platform", "?")
+    return ("mesh", plat, tuple(zip(mesh.axis_names,
+                                    (mesh.shape[a]
+                                     for a in mesh.axis_names))), devs)
 
 
 def data_sharding(mesh, batch_axis=0):

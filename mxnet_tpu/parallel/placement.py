@@ -28,7 +28,7 @@ import numpy as np
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-__all__ = ["ModelParallelPlan", "build_plan"]
+__all__ = ["ModelParallelPlan", "build_plan", "param_partition_specs"]
 
 
 class ModelParallelPlan:
@@ -70,16 +70,73 @@ def _shard_spec(shape, n_dev, consumer=None, axis_name="model"):
     Known matmul-like consumers shard their weight's output dimension;
     1-D params (per-channel vectors) shard elementwise; anything else is
     replicated — never guess at a 2-D+ tensor's contraction structure.
+    Returns (PartitionSpec, reason) where reason is non-empty exactly
+    when the spec degraded to replicated.
     """
     axis = _PREFERRED_AXIS.get(consumer) if consumer else None
     if axis is None and len(shape) == 1:
         axis = 0
-    if axis is not None and axis < len(shape) and \
-            shape[axis] % n_dev == 0 and shape[axis] >= n_dev:
-        spec = [None] * len(shape)
-        spec[axis] = axis_name
-        return P(*spec)
-    return P()
+    if axis is None:
+        return P(), ("no consumer with a known output dimension "
+                     "(conflicting or unknown matmul-like consumers)")
+    if axis >= len(shape):
+        return P(), f"preferred axis {axis} out of range for {shape}"
+    if shape[axis] % n_dev != 0 or shape[axis] < n_dev:
+        return P(), (f"dim {axis} of {shape} is not divisible by the "
+                     f"{n_dev}-way {axis_name!r} axis")
+    spec = [None] * len(shape)
+    spec[axis] = axis_name
+    return P(*spec), ""
+
+
+def param_partition_specs(symbol, arg_shapes_by_name, n_dev,
+                          axis_name="model"):
+    """ctx_group-tagged params -> {name: (PartitionSpec, reason)}.
+
+    The spec-derivation core shared by ``build_plan`` (legacy 1-D model
+    mesh from group2ctx devices) and the SPMD path (``parallel/spmd.py``
+    lowering onto a named mesh's ``model`` axis): each tagged param
+    shards along the output dimension its consumers agree on, and
+    degrades to replicated — with the reason recorded, surfaced by the
+    SH602 lint rule — when no safe axis exists.
+    """
+    nodes = symbol._topo_nodes()
+
+    # every consumer of each tagged param, with its input slot
+    consumers_of = {}
+    for node in nodes:
+        if node.is_variable:
+            continue
+        in_names = node.opdef().input_names(node.attrs)
+        for (inp, _), slot in zip(node.inputs, in_names):
+            if inp.is_variable:
+                consumers_of.setdefault(id(inp), []).append(
+                    (node.op, slot))
+
+    def _resolve_consumer(pid):
+        """Agree on one preferred axis across all consumers; a tied param
+        whose consumers want different axes replicates (sharding either
+        way would put a contraction dim on the wire for one of them)."""
+        axes = {_PREFERRED_AXIS.get(c) for c in consumers_of.get(pid, [])}
+        axes.discard(None)
+        if len(axes) != 1:
+            return None
+        for c in consumers_of[pid]:
+            if _PREFERRED_AXIS.get(c) is not None:
+                return c
+        return None
+
+    specs = {}
+    for node in nodes:
+        if not node.is_variable or not node._extra.get("ctx_group"):
+            continue
+        shape = arg_shapes_by_name.get(node.name)
+        if shape is None:
+            continue
+        specs[node.name] = _shard_spec(
+            shape, n_dev, consumer=_resolve_consumer(id(node)),
+            axis_name=axis_name)
+    return specs
 
 
 def build_plan(symbol, group2ctx, arg_shapes_by_name):
@@ -106,40 +163,10 @@ def build_plan(symbol, group2ctx, arg_shapes_by_name):
     n_dev = len(devices)
     replicated = NamedSharding(mesh, P())
 
-    # every consumer of each tagged param, with its input slot
-    consumers_of = {}
-    for node in nodes:
-        if node.is_variable:
-            continue
-        in_names = node.opdef().input_names(node.attrs)
-        for (inp, _), slot in zip(node.inputs, in_names):
-            if inp.is_variable:
-                consumers_of.setdefault(id(inp), []).append(
-                    (node.op, slot))
-
-    def _resolve_consumer(pid):
-        """Agree on one preferred axis across all consumers; a tied param
-        whose consumers want different axes replicates (sharding either
-        way would put a contraction dim on the wire for one of them)."""
-        axes = {_PREFERRED_AXIS.get(c) for c in consumers_of.get(pid, [])}
-        axes.discard(None)
-        if len(axes) != 1:
-            return None
-        for c in consumers_of[pid]:
-            if _PREFERRED_AXIS.get(c) is not None:
-                return c
-        return None
-
-    param_shardings = {}
-    for node in nodes:
-        if not node.is_variable or not node._extra.get("ctx_group"):
-            continue
-        shape = arg_shapes_by_name.get(node.name)
-        if shape is None:
-            continue
-        param_shardings[node.name] = NamedSharding(
-            mesh, _shard_spec(shape, n_dev,
-                              consumer=_resolve_consumer(id(node))))
+    param_shardings = {
+        name: NamedSharding(mesh, spec)
+        for name, (spec, _reason) in param_partition_specs(
+            symbol, arg_shapes_by_name, n_dev).items()}
 
     # cross-group edges: the producer's outputs must be gathered before a
     # different group consumes them (the _CrossDeviceCopy analog)

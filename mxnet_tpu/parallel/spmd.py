@@ -1,0 +1,172 @@
+"""SpmdPlan: one GSPMD program over the named mesh.
+
+The training path's central object in SPMD mode
+(``Module.bind/fit(spmd=True)`` / ``MXNET_SPMD=1``): it owns the
+first-class ``jax.sharding.Mesh`` (axes from ``MeshConfig`` /
+``MXNET_MESH_*`` env overrides, default a 1-D ``data`` axis over the
+bound contexts) and the ``PartitionSpec`` for every bound array —
+data batch-sharded on ``data``, params sharded per ``placement.py``'s
+lowering of ``ctx_group`` annotations onto the ``model`` axis
+(replicated by default), optimizer state riding the param's spec, or
+``P(data)`` over the canonical flat (n, chunk) layout once ZeRO-1 is
+enabled. The executor group reads ONLY specs/shardings from this plan;
+XLA's SPMD partitioner emits every collective (gradient all-reduce or
+reduce-scatter, boundary all-gathers) from them — no kvstore, no
+host-side reduction loop (SNIPPETS.md [2]/[3] pattern; ROADMAP item 1).
+
+ZeRO-1 under this plan is exactly a spec change: ``enable_zero()``
+flips ``state_spec`` from the param's spec to ``P(data_axis)`` and the
+fused step routes the update through ``zero.apply_spec_update`` — the
+same flat layout, state shapes, and bit-identical math as the
+kvstore-era ``ZeroPlan``, minus the plan object threaded through the
+step.
+
+Everything that determines the traced collective structure is folded
+into ``cache_token()`` so a compiled program can never be reused across
+meshes or spec sets (program_cache key discipline).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .mesh import MeshConfig, build_mesh, mesh_token
+from .placement import param_partition_specs
+from .zero import FlatShardLayout
+
+__all__ = ["SpmdPlan"]
+
+
+class SpmdPlan:
+    """Mesh + PartitionSpecs for one SPMD binding."""
+
+    def __init__(self, mesh, param_specs=None, unsharded_tagged=None,
+                 data_axis="data", model_axis="model", batch_axis=0):
+        self.mesh = mesh
+        self.data_axis = data_axis
+        self.model_axis = model_axis
+        self.batch_axis = batch_axis
+        #: name -> PartitionSpec for params that are NOT fully replicated
+        self.param_specs = dict(param_specs or {})
+        #: name -> reason, for ctx_group-tagged params that degraded to
+        #: replicated (the SH602 lint rule reads this)
+        self.unsharded_tagged = dict(unsharded_tagged or {})
+        self.zero = False               # flipped by enable_zero()
+        self.replicated = NamedSharding(mesh, P())
+        self._state_layout = None
+
+    # ------------------------------------------------------------- build
+    @classmethod
+    def build(cls, symbol, devices, arg_shapes_by_name, config=None,
+              batch_axis=0):
+        """Plan for one binding: mesh from ``config`` (else the
+        ``MXNET_MESH_*`` env overrides, else a 1-D data axis over
+        ``devices``), params lowered from the symbol's ctx_group tags
+        onto the model axis when one exists."""
+        plan = cls(cls.build_mesh_for(devices, config),
+                   batch_axis=batch_axis)
+        plan.derive_param_specs(symbol, arg_shapes_by_name)
+        return plan
+
+    @staticmethod
+    def build_mesh_for(devices, config=None):
+        """The binding's mesh: explicit MeshConfig > MXNET_MESH_* env >
+        a 1-D data axis over every bound device."""
+        if config is None:
+            config = MeshConfig.from_env(len(devices))
+        if config is None:
+            config = MeshConfig(data=len(devices))
+        return build_mesh(config, devices=devices)
+
+    def derive_param_specs(self, symbol, arg_shapes_by_name):
+        """(Re)lower the symbol's ctx_group tags onto the model axis —
+        called at bind time once arg shapes are known (and again on
+        reshape, since divisibility is shape-dependent)."""
+        self.param_specs.clear()
+        self.unsharded_tagged.clear()
+        n_model = self.mesh.shape.get(self.model_axis, 1)
+        if n_model > 1:
+            for name, (spec, reason) in param_partition_specs(
+                    symbol, arg_shapes_by_name, n_model,
+                    axis_name=self.model_axis).items():
+                if reason:
+                    self.unsharded_tagged[name] = reason
+                else:
+                    self.param_specs[name] = spec
+        return self
+
+    # ------------------------------------------------------------- specs
+    def param_spec(self, name):
+        return self.param_specs.get(name, P())
+
+    def param_sharding(self, name):
+        return NamedSharding(self.mesh, self.param_spec(name))
+
+    def data_sharding(self, stacked=False):
+        """Batch sharded over the data axis; ``stacked`` prepends the
+        K-step scan axis (unsharded) before the batch axis."""
+        spec = [None] * (self.batch_axis + 1)
+        spec[self.batch_axis] = self.data_axis
+        if stacked:
+            spec = [None] + spec
+        return NamedSharding(self.mesh, P(*spec))
+
+    def state_spec(self, name):
+        """Optimizer-state spec for one watched param's leaves: the
+        param's own spec, or — ZeRO-1 — ``P(data_axis)`` over the flat
+        (n, chunk) layout. This one method IS the ZeRO-1 toggle."""
+        if self.zero:
+            return P(self.data_axis)
+        return self.param_spec(name)
+
+    def state_sharding(self, name):
+        return NamedSharding(self.mesh, self.state_spec(name))
+
+    # -------------------------------------------------------------- zero
+    def can_zero(self):
+        return self.mesh.shape.get(self.data_axis, 1) > 1
+
+    def enable_zero(self):
+        """ZeRO-1 as a spec change: state leaves move to the flat
+        (n, chunk) layout sharded over the data axis."""
+        self.zero = True
+        self._state_layout = FlatShardLayout(self.mesh, self.data_axis)
+
+    @property
+    def state_layout(self):
+        """FlatShardLayout for state transport (checkpoints, defuse)
+        when ZeRO is on; None means param-shaped state."""
+        return self._state_layout
+
+    # ------------------------------------------------------------ tokens
+    def cache_token(self):
+        """Program-cache token: mesh topology + the exact spec set.
+        Two bindings differing in either trace different collective
+        structure (the ZeRO comm plan is keyed separately, via the
+        fused key's ``("comm", ...)`` token)."""
+        return (mesh_token(self.mesh),
+                tuple(sorted((nm, str(sp))
+                             for nm, sp in self.param_specs.items())))
+
+    def describe(self):
+        """Human/lint-facing summary (diagnostics, docs examples)."""
+        return {
+            "mesh": {a: self.mesh.shape[a] for a in self.mesh.axis_names},
+            "data_axis": self.data_axis,
+            "sharded_params": {nm: str(sp)
+                               for nm, sp in self.param_specs.items()},
+            "replicated_tagged": dict(self.unsharded_tagged),
+            "zero": self.zero,
+        }
+
+    # ----------------------------------------------------------- placing
+    def place_param(self, name, value):
+        return jax.device_put(value, self.param_sharding(name))
+
+    def n_data_shards(self):
+        return int(self.mesh.shape.get(self.data_axis, 1))
+
+    def n_devices(self):
+        return int(np.prod([self.mesh.shape[a]
+                            for a in self.mesh.axis_names]))

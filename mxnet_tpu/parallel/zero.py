@@ -14,24 +14,36 @@ memory drops N-fold; total collective bytes match the all-reduce
 around the update).
 
 Realization here: the fused/scan train step stays ONE jitted SPMD
-program. ``ZeroPlan.apply`` reshapes each gradient/parameter to a
-``(n_shard, chunk)`` padded flat view and pins it to the mesh's data
-axis with ``lax.with_sharding_constraint`` — the XLA SPMD partitioner
-then materializes the vjp gradient *directly as a reduce-scatter*
-(the all-reduce it would have inserted sinks into the sharded
-consumer), runs the elementwise update shard-locally, and turns the
-replicated constraint on the new weights into the all-gather. Because
-the collectives live inside the program, XLA's latency-hiding
-scheduler overlaps the gradient reduce-scatter of late layers with the
-still-running backward of early layers — the in-program form of
-comm/compute overlap (docs/performance.md).
+program. The gradient/parameter are reshaped to a ``(n_shard, chunk)``
+padded flat view pinned to a mesh axis with
+``lax.with_sharding_constraint`` — the XLA SPMD partitioner then
+materializes the vjp gradient *directly as a reduce-scatter* (the
+all-reduce it would have inserted sinks into the sharded consumer),
+runs the elementwise update shard-locally, and turns the replicated (or
+model-sharded, under the SPMD path) constraint on the new weights into
+the all-gather. Because the collectives live inside the program, XLA's
+latency-hiding scheduler overlaps the gradient reduce-scatter of late
+layers with the still-running backward of early layers — the in-program
+form of comm/compute overlap (docs/performance.md).
+
+Two consumers share this module:
+
+* the kvstore-era fused path keeps :class:`ZeroPlan` — layout + apply
+  in one object, selected by ``Module.fit(zero_stage=1)``;
+* the SPMD path (``parallel/spmd.py``) treats ZeRO-1 as a
+  *PartitionSpec change on the optimizer-state leaves*: the plan's
+  ``state_spec`` switches from the param's spec to ``P(data_axis)``
+  over the canonical flat layout, and the fused step applies it through
+  :func:`apply_spec_update` — no plan object threaded through the step,
+  just specs. :class:`FlatShardLayout` carries the layout/transport
+  half (state init, checkpoint export/import) for both.
 
 The update must be elementwise over (w, g, state) for the flat-shard
 view to be exact — true for the fused SGD/momentum/Adam plans
 (``Optimizer.fused_update_elementwise``); non-elementwise optimizers
 keep the replicated plan. Shard-local math is bit-identical to the
 replicated update (same reduced values, same scalar ops), pinned by
-tests/test_zero.py.
+tests/test_zero.py and tests/test_spmd.py.
 """
 from __future__ import annotations
 
@@ -40,68 +52,94 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-__all__ = ["ZeroPlan"]
+__all__ = ["ZeroPlan", "FlatShardLayout", "flat_shards", "unflat_shards",
+           "apply_spec_update"]
 
 
-class ZeroPlan:
-    """Flat-shard transform over one mesh axis for optimizer updates."""
+# ------------------------------------------------------- flat-shard views
+def flat_shards(x, n):
+    """(n, chunk) zero-padded flat view (traced or concrete).
+
+    The padding MUST be ``jnp.pad``, not a ``jnp.concatenate`` with a
+    zeros tensor: on a multi-axis mesh the XLA SPMD partitioner
+    (jax 0.4.37) mis-reshards concatenate-fed values when the result is
+    pinned to one axis — each element comes back multiplied by the size
+    of the other axes (verified: pad partitions correctly, concat
+    doubles on a (data=4, model=2) mesh).
+    """
+    size = int(np.prod(x.shape)) if x.shape else 1
+    chunk = -(-size // n)                   # ceil(size / n)
+    pad = chunk * n - size
+    f = jnp.ravel(x)
+    if pad:
+        f = jnp.pad(f, (0, pad))
+    return f.reshape(n, -1)
+
+
+def unflat_shards(f, shape):
+    """Inverse of :func:`flat_shards` (drops the zero padding)."""
+    size = int(np.prod(shape)) if shape else 1
+    flat = jnp.ravel(f)
+    if flat.shape[0] != size:
+        flat = flat[:size]
+    return flat.reshape(shape)
+
+
+def apply_spec_update(update, w, g, s, lr, wd, mesh, state_spec,
+                      out_spec=None):
+    """One elementwise optimizer update on 1/n flat shards, driven by
+    PartitionSpecs alone (the SPMD path's ZeRO-1).
+
+    ``state_spec`` names the mesh axis the (n, chunk) flat layout shards
+    over (its first entry — e.g. ``P('data')``); ``out_spec`` is the
+    updated parameter's own spec (``P()`` replicates = the all-gather;
+    a model-sharded param keeps its spec). ``s`` is the persistent
+    state pytree already in (n, chunk) sharded form. Returns (new_w in
+    the original shape, new_s still flat-sharded).
+    """
+    axis = state_spec[0]
+    n = mesh.shape[axis]
+    sharded = NamedSharding(mesh, state_spec)
+    shape = w.shape
+    wf = jax.lax.with_sharding_constraint(flat_shards(w, n), sharded)
+    # the constraint below is where the partitioner turns the vjp
+    # gradient's pending all-reduce into a reduce-scatter
+    gf = jax.lax.with_sharding_constraint(flat_shards(g, n), sharded)
+    new_wf, new_s = update(wf, gf, s, lr, wd)
+    new_s = jax.tree.map(
+        lambda x: jax.lax.with_sharding_constraint(x, sharded), new_s)
+    # constraint on the updated shards = the all-gather back to the
+    # parameter's own layout
+    out_sharding = NamedSharding(mesh, out_spec if out_spec is not None
+                                 else P())
+    new_w = jax.lax.with_sharding_constraint(
+        unflat_shards(new_wf, shape), out_sharding)
+    return new_w, new_s
+
+
+class FlatShardLayout:
+    """(n, chunk) flat-shard state layout over one mesh axis: creation,
+    checkpoint transport, and defuse projections — everything about the
+    layout EXCEPT the in-program update (ZeroPlan.apply or
+    :func:`apply_spec_update`)."""
 
     def __init__(self, mesh, axis="data"):
         self.mesh = mesh
         self.axis = axis
         self.n = mesh.shape[axis]
-        self.sharded = NamedSharding(mesh, P(axis))
+        self.spec = P(axis)
+        self.sharded = NamedSharding(mesh, self.spec)
         self.replicated = NamedSharding(mesh, P())
-
-    def describe(self):
-        """Ordered in-program collective sequence one parameter update
-        traces under this plan — what the collective-order analysis
-        pass (analysis rule CO302) and diagnostics render. The order is
-        structural (baked into the traced program), hence identical on
-        every worker by construction."""
-        return (("reduce_scatter", self.axis, self.n),
-                ("all_gather", self.axis, self.n))
 
     # ------------------------------------------------------------ layout
     def _chunk(self, size):
         return -(-size // self.n)           # ceil(size / n)
 
     def _flat(self, x):
-        """(n, chunk) zero-padded flat view (traced or concrete)."""
-        size = int(np.prod(x.shape)) if x.shape else 1
-        pad = self._chunk(size) * self.n - size
-        f = jnp.ravel(x)
-        if pad:
-            f = jnp.concatenate([f, jnp.zeros((pad,), f.dtype)])
-        return f.reshape(self.n, -1)
+        return flat_shards(x, self.n)
 
     def _unflat(self, f, shape):
-        size = int(np.prod(shape)) if shape else 1
-        flat = jnp.ravel(f)
-        if flat.shape[0] != size:
-            flat = flat[:size]
-        return flat.reshape(shape)
-
-    # ------------------------------------------------------------- update
-    def apply(self, update, w, g, s, lr, wd):
-        """Run one elementwise optimizer update on 1/n shards.
-
-        ``w``/``g`` are full (replicated-layout) traced arrays; ``s`` is
-        the persistent state pytree already in (n, chunk) sharded form
-        (see ``init_state``). Returns (new_w in the original shape,
-        new_s still flat-sharded)."""
-        shape = w.shape
-        wf = jax.lax.with_sharding_constraint(self._flat(w), self.sharded)
-        # the constraint below is where the partitioner turns the vjp
-        # gradient's pending all-reduce into a reduce-scatter
-        gf = jax.lax.with_sharding_constraint(self._flat(g), self.sharded)
-        new_wf, new_s = update(wf, gf, s, lr, wd)
-        new_s = jax.tree.map(
-            lambda x: jax.lax.with_sharding_constraint(x, self.sharded),
-            new_s)
-        # replicated constraint on the updated shards = the all-gather
-        new_wf = jax.lax.with_sharding_constraint(new_wf, self.replicated)
-        return self._unflat(new_wf, shape), new_s
+        return unflat_shards(f, shape)
 
     # -------------------------------------------------------------- state
     def init_state(self, init_state, w):
@@ -133,3 +171,28 @@ class ZeroPlan:
         """Device-side unflatten (for defusing into the staged updater)."""
         return jax.tree.map(
             lambda x: self._unflat(jnp.asarray(x), shape), state)
+
+
+class ZeroPlan(FlatShardLayout):
+    """Flat-shard transform over one mesh axis for optimizer updates
+    (layout + in-program apply, the kvstore-era fused path's plan)."""
+
+    def describe(self):
+        """Ordered in-program collective sequence one parameter update
+        traces under this plan — what the collective-order analysis
+        pass (analysis rule CO302) and diagnostics render. The order is
+        structural (baked into the traced program), hence identical on
+        every worker by construction."""
+        return (("reduce_scatter", self.axis, self.n),
+                ("all_gather", self.axis, self.n))
+
+    # ------------------------------------------------------------- update
+    def apply(self, update, w, g, s, lr, wd):
+        """Run one elementwise optimizer update on 1/n shards.
+
+        ``w``/``g`` are full (replicated-layout) traced arrays; ``s`` is
+        the persistent state pytree already in (n, chunk) sharded form
+        (see ``init_state``). Returns (new_w in the original shape,
+        new_s still flat-sharded)."""
+        return apply_spec_update(update, w, g, s, lr, wd,
+                                 self.mesh, self.spec)

@@ -13,7 +13,16 @@ This module is the process-wide home for those programs. Keys capture
 everything that determines the traced computation:
 
   (symbol signature hash, bound arg/aux shapes+dtypes, ctx kind,
-   layout flag, compute_dtype, remat segments) + (kind, kind-extras)
+   mesh/topology token, layout flag, compute_dtype, remat segments)
+  + (kind, kind-extras)
+
+The mesh token (``parallel.mesh.mesh_token`` / ``SpmdPlan.cache_
+token``) names the device topology — platform, axis layout, exact
+device assignment, and (spmd) the param spec set. It exists because
+compiled train programs bake their mesh's collective structure in
+(psum shard counts, ZeRO reduce-scatter shapes): a mesh-shape change —
+e.g. 1 → 8 host-platform devices in one process — must MISS, never
+reuse a stale program (tests/test_program_cache.py pins the negative).
 
 where ``kind`` is one of ``fwd_infer`` / ``fwd_train`` / ``fwd_bwd`` /
 ``fused_step`` / ``scan`` and the extras carry what only that kind

@@ -129,16 +129,19 @@ def test_shard_spec_consumer_aware():
     # FullyConnected weight (num_hidden, in_dim): shard axis 0 even when
     # the contraction dim is larger (the old largest-dim rule got this
     # wrong and paid a partial-sum per matmul)
-    assert _shard_spec((4, 1024), 2, ("FullyConnected", "weight")) == \
+    assert _shard_spec((4, 1024), 2, ("FullyConnected", "weight"))[0] == \
         P("model", None)
-    assert _shard_spec((8, 3, 3, 3), 2, ("Convolution", "weight")) == \
+    assert _shard_spec((8, 3, 3, 3), 2, ("Convolution", "weight"))[0] == \
         P("model", None, None, None)
-    assert _shard_spec((1024, 8), 2, ("Embedding", "weight")) == \
+    assert _shard_spec((1024, 8), 2, ("Embedding", "weight"))[0] == \
         P(None, "model")
-    # unknown consumer, 2-D: replicate rather than guess
-    assert _shard_spec((1024, 512), 2, ("Correlation", "data1")) == P()
-    assert _shard_spec((1024, 512), 2, None) == P()
+    # unknown consumer, 2-D: replicate rather than guess (the reason —
+    # the second return — feeds the SH602 lint finding)
+    spec, reason = _shard_spec((1024, 512), 2, ("Correlation", "data1"))
+    assert spec == P() and reason
+    assert _shard_spec((1024, 512), 2, None)[0] == P()
     # per-channel vector: elementwise-safe
-    assert _shard_spec((64,), 2, None) == P("model")
+    assert _shard_spec((64,), 2, None)[0] == P("model")
     # indivisible: replicate
-    assert _shard_spec((7, 6), 2, ("FullyConnected", "weight")) == P()
+    spec, reason = _shard_spec((7, 6), 2, ("FullyConnected", "weight"))
+    assert spec == P() and "divisible" in reason

@@ -200,6 +200,53 @@ def test_cache_key_dtype_negative():
         mx.telemetry.disable()
 
 
+def test_cache_key_mesh_topology_negative():
+    """A device-topology change (1 -> 8 host-platform devices in one
+    process) must MISS: compiled programs bake in their mesh's
+    collective structure (psum shard counts, ZeRO reduce-scatter
+    shapes), so reusing a 1-device trace on an 8-device mesh — or vice
+    versa — silently runs the wrong program."""
+    import jax
+    if len(jax.devices("cpu")) < 8:
+        import pytest
+        pytest.skip("needs 8 virtual cpu devices")
+    mx.program_cache.clear()
+    mx.telemetry.reset()
+    mx.telemetry.enable()
+    try:
+        rs = np.random.RandomState(0)
+        sym = _mlp()
+        keys = []
+        for n_dev in (1, 8):
+            mod = mx.mod.Module(sym,
+                                context=[mx.cpu(i) for i in range(n_dev)])
+            mod.bind([("data", (8, 6))], [("softmax_label", (8,))])
+            mod.init_params(mx.initializer.Xavier())
+            mod.init_optimizer(optimizer_params={"learning_rate": 0.1})
+            assert mod._fused_armed
+            data = [mx.nd.array(rs.rand(8, 6).astype(np.float32))]
+            label = [mx.nd.array(rs.randint(0, 3, (8,))
+                                 .astype(np.float32))]
+            mod.forward_backward(mx.io.DataBatch(data, label))
+            mod.update()
+            keys.append(mod._exec_group._fused_cache_key)
+        assert keys[0] is not None and keys[1] is not None
+        assert keys[0] != keys[1], \
+            "mesh topology must be part of the program-cache key"
+        hit, miss = _counters()
+        assert hit == 0, "the 8-device bind must not reuse the " \
+            "1-device program"
+        # spmd spec sets key separately from the plain data mesh
+        mod = mx.mod.Module(sym, context=[mx.cpu(i) for i in range(8)])
+        mod.bind([("data", (8, 6))], [("softmax_label", (8,))], spmd=True)
+        mod.init_params(mx.initializer.Xavier())
+        mod.init_optimizer(kvstore=None,
+                           optimizer_params={"learning_rate": 0.1})
+        assert mod._exec_group._fused_cache_key not in keys
+    finally:
+        mx.telemetry.disable()
+
+
 def test_lru_eviction_and_gauge():
     """The cache is a bounded LRU; the programs_live gauge tracks it."""
     mx.program_cache.clear()

@@ -1,0 +1,441 @@
+"""GSPMD training path: one jitted program over the named mesh.
+
+ISSUE 7 tentpole: ``Module.bind/fit(spmd=True)`` / ``MXNET_SPMD`` lowers
+the fused and K-step-scan steps onto the ``parallel/mesh.py`` mesh with
+``NamedSharding``-annotated params/data, the gradient collectives
+emitted by XLA from the sharding specs instead of the kvstore — these
+tests pin (a) the previously-untested substrate (MeshConfig/build_mesh
+axis layout, placement.build_plan output-dim rules), (b) spmd-vs-
+kvstore fit parity at K=1 and K=4 on the 8-virtual-device mesh,
+(c) ZeRO-1-as-spec parity with the kvstore-era ZeroPlan (bit-for-bit
+state shapes, N-fold cut preserved), (d) the kvstore-optional contract
+and env-var plumbing, (e) the SH6xx mesh-aware lint rules, and (f) the
+kernel tier composing unchanged under the mesh.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu.parallel import MeshConfig, build_mesh, mesh_token, SpmdPlan
+from mxnet_tpu import analysis
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices("cpu")) < 8, reason="needs 8 virtual cpu devices")
+
+BATCH = 8
+N_BATCHES = 8
+CLASSES = 3
+FEATS = 6
+
+
+def _mlp(dropout=0.0, tagged=False):
+    data = mx.sym.var("data")
+    if tagged:
+        with mx.AttrScope(ctx_group="stage0"):
+            fc = mx.sym.FullyConnected(data=data, num_hidden=16,
+                                       name="fc1")
+    else:
+        fc = mx.sym.FullyConnected(data=data, num_hidden=16, name="fc1")
+    act = mx.sym.Activation(fc, act_type="relu")
+    if dropout:
+        act = mx.sym.Dropout(act, p=dropout)
+    fc2 = mx.sym.FullyConnected(act, num_hidden=CLASSES, name="fc2")
+    return mx.sym.SoftmaxOutput(fc2, name="softmax")
+
+
+def _data():
+    rs = np.random.RandomState(0)
+    X = rs.rand(N_BATCHES * BATCH, FEATS).astype(np.float32)
+    y = rs.randint(0, CLASSES, (N_BATCHES * BATCH,)).astype(np.float32)
+    return X, y
+
+
+def _init_args():
+    rs = np.random.RandomState(1)
+    return {
+        "fc1_weight": mx.nd.array(rs.randn(16, FEATS).astype(np.float32)
+                                  * 0.1),
+        "fc1_bias": mx.nd.array(np.zeros(16, np.float32)),
+        "fc2_weight": mx.nd.array(rs.randn(CLASSES, 16).astype(np.float32)
+                                  * 0.1),
+        "fc2_bias": mx.nd.array(np.zeros(CLASSES, np.float32)),
+    }
+
+
+def _fit(spmd, kvstore="local", zero_stage=0, K=1, mesh=None, dropout=0.0,
+         tagged=False, num_epoch=2, n_dev=8):
+    """One fit; returns (params, per-batch metric trajectory, module)."""
+    X, y = _data()
+    mx.random.seed(7)
+    it = mx.io.NDArrayIter(X, y, batch_size=BATCH)
+    mod = mx.mod.Module(_mlp(dropout, tagged),
+                        context=[mx.cpu(i) for i in range(n_dev)])
+    accs = []
+
+    def cb(param):
+        accs.append(param.eval_metric.get()[1])
+
+    mod.fit(it, num_epoch=num_epoch, spmd=spmd, mesh=mesh,
+            zero_stage=zero_stage, steps_per_dispatch=K, kvstore=kvstore,
+            batch_end_callback=cb,
+            arg_params={k: v.copy() for k, v in _init_args().items()},
+            optimizer_params=(("learning_rate", 0.1), ("momentum", 0.9)))
+    args, _ = mod.get_params()
+    return {k: v.asnumpy() for k, v in args.items()}, accs, mod
+
+
+# ===================================================== substrate: mesh
+def test_mesh_config_axis_layout():
+    """MeshConfig drops size-1 axes; build_mesh orders axes so the
+    chatty (model/seq) axes are innermost — adjacent devices."""
+    cfg = MeshConfig(data=4, model=2, seq=1)
+    assert cfg.sizes() == {"data": 4, "model": 2}
+    mesh = build_mesh(cfg)
+    assert mesh.axis_names == ("data", "model")
+    assert mesh.shape["data"] == 4 and mesh.shape["model"] == 2
+    # model innermost: one data row holds adjacent device ids
+    ids = np.array([[d.id for d in row] for row in mesh.devices])
+    assert ids.shape == (4, 2)
+    assert (ids[:, 1] - ids[:, 0] == 1).all()
+
+    # full 5-axis ordering: pipe/data outer, expert/seq/model inner
+    mesh5 = build_mesh(MeshConfig(data=2, model=2, seq=2))
+    assert mesh5.axis_names == ("data", "seq", "model")
+
+    # defaulting: no sizes -> 1-D data axis over every device
+    mesh1 = build_mesh()
+    assert mesh1.axis_names == ("data",)
+    assert mesh1.shape["data"] == len(jax.devices())
+
+    with pytest.raises(ValueError):
+        build_mesh(MeshConfig(data=1024))
+
+
+def test_mesh_config_from_env(monkeypatch):
+    """MXNET_MESH_* env overrides build the config; the data axis
+    defaults to the leftover device count."""
+    monkeypatch.setenv("MXNET_MESH_MODEL", "2")
+    cfg = MeshConfig.from_env(8)
+    assert cfg.model == 2 and cfg.data == 4
+    monkeypatch.setenv("MXNET_MESH_DATA", "2")
+    cfg = MeshConfig.from_env(8)
+    assert cfg.data == 2 and cfg.model == 2
+    monkeypatch.delenv("MXNET_MESH_MODEL")
+    monkeypatch.delenv("MXNET_MESH_DATA")
+    assert MeshConfig.from_env(8) is None
+    monkeypatch.setenv("MXNET_MESH_DATA", "nope")
+    with pytest.raises(ValueError):
+        MeshConfig.from_env(8)
+
+
+def test_mesh_token_distinguishes_topologies():
+    devs = jax.devices("cpu")
+    t1 = mesh_token(build_mesh(MeshConfig(data=8), devices=devs))
+    t2 = mesh_token(build_mesh(MeshConfig(data=4, model=2), devices=devs))
+    t3 = mesh_token(build_mesh(MeshConfig(data=4), devices=devs))
+    assert len({t1, t2, t3}) == 3
+    # same topology -> same token
+    assert t1 == mesh_token(build_mesh(MeshConfig(data=8), devices=devs))
+
+
+# ================================================ substrate: placement
+def test_build_plan_output_dim_rules():
+    """placement.build_plan shards matmul-like weights on their OUTPUT
+    dim (never a contraction dim) and replicates what it cannot prove;
+    biases of sharded layers shard elementwise."""
+    from jax.sharding import PartitionSpec as P
+    from mxnet_tpu.parallel.placement import build_plan
+
+    data = mx.sym.var("data")
+    with mx.AttrScope(ctx_group="g0"):
+        fc = mx.sym.FullyConnected(data, num_hidden=16, name="fc1")
+        act = mx.sym.Activation(fc, act_type="relu")
+    with mx.AttrScope(ctx_group="g1"):
+        fc2 = mx.sym.FullyConnected(act, num_hidden=3, name="fc2")
+    sym = mx.sym.SoftmaxOutput(fc2, name="softmax")
+
+    shapes = {"fc1_weight": (16, FEATS), "fc1_bias": (16,),
+              "fc2_weight": (3, 16), "fc2_bias": (3,)}
+    plan = build_plan(sym, {"g0": mx.cpu(0), "g1": mx.cpu(1)}, shapes)
+    assert plan is not None
+    # FC weight (num_hidden, in_dim): output dim is 0 — sharding dim 1
+    # would put the contraction on the wire every apply
+    assert plan.param_shardings["fc1_weight"].spec == P("model", None)
+    assert plan.param_shardings["fc1_bias"].spec == P("model")
+    # 3 not divisible by 2 -> replicated, never mis-sharded
+    assert plan.param_shardings["fc2_weight"].spec == P()
+    # no group2ctx / no tags -> no plan at all
+    assert build_plan(sym, {}, shapes) is None
+    assert build_plan(_mlp(), {"g0": mx.cpu(0), "g1": mx.cpu(1)},
+                      shapes) is None
+
+
+def test_spmd_plan_records_replication_reasons():
+    """A tagged-but-unshardable param is recorded with its reason (the
+    SH602 surface)."""
+    sym = _mlp(tagged=True)
+    plan = SpmdPlan.build(
+        sym, jax.devices("cpu")[:8],
+        {"fc1_weight": (16, FEATS), "fc1_bias": (16,),
+         "fc2_weight": (3, 16), "fc2_bias": (3,)},
+        config=MeshConfig(data=2, model=4))
+    from jax.sharding import PartitionSpec as P
+    assert plan.param_spec("fc1_weight") == P("model", None)
+    assert plan.param_spec("fc2_weight") == P()          # untagged
+    assert "fc1_bias" in plan.param_specs
+    assert plan.unsharded_tagged == {}                   # 16 % 4 == 0
+    plan5 = SpmdPlan.build(
+        sym, jax.devices("cpu")[:8],
+        {"fc1_weight": (15, FEATS), "fc1_bias": (15,)},
+        config=MeshConfig(data=2, model=4))
+    assert "fc1_weight" in plan5.unsharded_tagged
+    assert "divisible" in plan5.unsharded_tagged["fc1_weight"]
+
+
+# ============================================== spmd-vs-kvstore parity
+@pytest.mark.parametrize("K", [1, 4])
+def test_spmd_fit_matches_kvstore_overlap(K):
+    """fit(spmd=True) must reproduce the kvstore-overlap arrangement —
+    per-batch loss/metric trajectory and final params — at K=1 and
+    under the K=4 scan (acceptance criterion)."""
+    p_kv, a_kv, mod_kv = _fit(False, kvstore="dist_sync", K=1)
+    assert mod_kv._kvstore is not None          # the kvstore path ran
+    p_sp, a_sp, mod_sp = _fit(True, K=K)
+    assert mod_sp._kvstore is None
+    assert mod_sp._fused_armed
+    if K > 1:
+        assert mod_sp._exec_group._scan_K == K
+    for k in p_kv:
+        np.testing.assert_allclose(p_kv[k], p_sp[k], rtol=2e-5, atol=2e-6,
+                                   err_msg=k)
+    np.testing.assert_allclose(a_kv, a_sp, rtol=1e-6)
+
+
+def test_spmd_matches_update_on_kvstore_store():
+    """Parity against the device-store post-hoc push/pull arrangement
+    (the store's updater owns the math there)."""
+    p_kv, a_kv, mod_kv = _fit(False, kvstore="device")
+    assert mod_kv._update_on_kvstore
+    p_sp, a_sp, _ = _fit(True)
+    for k in p_kv:
+        np.testing.assert_allclose(p_kv[k], p_sp[k], rtol=2e-5, atol=2e-6,
+                                   err_msg=k)
+    np.testing.assert_allclose(a_kv, a_sp, rtol=1e-6)
+
+
+def test_spmd_kvstore_dropped_and_optional():
+    """In spmd mode a local/device kvstore is dropped (in-program
+    collectives own the reduction); kvstore=None works outright."""
+    _, _, mod = _fit(True, kvstore="device")
+    assert mod._kvstore is None and not mod._update_on_kvstore
+    _, _, mod2 = _fit(True, kvstore=None)
+    assert mod2._kvstore is None and mod2._fused_armed
+
+
+def test_spmd_env_var(monkeypatch):
+    """MXNET_SPMD=1 selects the spmd binding without the kwarg."""
+    monkeypatch.setenv("MXNET_SPMD", "1")
+    _, _, mod = _fit(None)
+    assert mod._exec_group._spmd_plan is not None
+    monkeypatch.setenv("MXNET_SPMD", "0")
+    _, _, mod = _fit(None)
+    assert mod._exec_group._spmd_plan is None
+
+
+def test_spmd_model_axis_parity():
+    """data=4 x model=2 with ctx_group-tagged params: fc1 shards on the
+    model axis, numerics match pure data-parallel."""
+    p0, a0, _ = _fit(False)
+    p1, a1, mod = _fit(True, tagged=True, mesh=MeshConfig(data=4, model=2))
+    plan = mod._exec_group._spmd_plan
+    from jax.sharding import PartitionSpec as P
+    assert plan.param_spec("fc1_weight") == P("model", None)
+    exe = mod._exec_group.executor
+    sh = exe.arg_dict["fc1_weight"].asjax().sharding
+    assert sh.is_equivalent_to(plan.param_sharding("fc1_weight"), 2)
+    # each model-shard holds half the rows
+    shards = exe.arg_dict["fc1_weight"].asjax().addressable_shards
+    assert {s.data.shape for s in shards} == {(8, FEATS)}
+    for k in p0:
+        np.testing.assert_allclose(p0[k], p1[k], rtol=2e-5, atol=2e-6,
+                                   err_msg=k)
+    np.testing.assert_allclose(a0, a1, rtol=1e-6)
+
+
+def test_spmd_dropout_scan_self_consistent():
+    """K=4 scan == K=1 under spmd with dropout (shared device rng
+    chain, same contract as the kvstore-era fused path)."""
+    p1, a1, _ = _fit(True, dropout=0.3, K=1)
+    p4, a4, mod = _fit(True, dropout=0.3, K=4)
+    assert mod._exec_group._scan_K == 4
+    for k in p1:
+        np.testing.assert_allclose(p1[k], p4[k], rtol=2e-5, atol=2e-6,
+                                   err_msg=k)
+    np.testing.assert_allclose(a1, a4, rtol=1e-12)
+
+
+# ======================================================= ZeRO-1 as spec
+@pytest.mark.parametrize("optimizer", ["sgd", "adam"])
+def test_spmd_zero1_as_spec_matches_zeroplan(optimizer):
+    """ZeRO-1 under spmd is a PartitionSpec change on the state leaves;
+    it must match the kvstore-era ZeroPlan arrangement bit-for-bit in
+    state SHAPES (same (n, chunk) flat layout, N-fold cut) and to float
+    ulps in values."""
+    X, y = _data()
+
+    def fit(spmd):
+        mx.random.seed(7)
+        it = mx.io.NDArrayIter(X, y, batch_size=BATCH)
+        mod = mx.mod.Module(_mlp(),
+                            context=[mx.cpu(i) for i in range(8)])
+        opt_params = (("learning_rate", 0.1), ("momentum", 0.9)) \
+            if optimizer == "sgd" else (("learning_rate", 0.01),)
+        mod.fit(it, num_epoch=1, spmd=spmd, zero_stage=1,
+                kvstore=None if spmd else "local", optimizer=optimizer,
+                arg_params={k: v.copy() for k, v in _init_args().items()},
+                optimizer_params=opt_params)
+        args, _ = mod.get_params()
+        return ({k: v.asnumpy() for k, v in args.items()}, mod)
+
+    p_zp, mod_zp = fit(False)
+    p_sp, mod_sp = fit(True)
+    assert mod_zp._exec_group._zero_plan is not None     # ZeroPlan path
+    assert mod_sp._exec_group._zero_plan is None         # spec path
+    assert mod_sp._exec_group._spmd_plan.zero
+
+    st_zp = mod_zp._exec_group._fused_states
+    st_sp = mod_sp._exec_group._fused_states
+    for nm in st_zp:
+        for l_zp, l_sp in zip(jax.tree.leaves(st_zp[nm]),
+                              jax.tree.leaves(st_sp[nm])):
+            assert l_zp.shape == l_sp.shape == (8, l_zp.shape[1])
+            # N-fold cut: one 1/N slice per device on both paths
+            assert len(l_sp.addressable_shards) == 8
+            assert all(s.data.shape[0] == 1
+                       for s in l_sp.addressable_shards)
+            np.testing.assert_allclose(np.asarray(l_zp),
+                                       np.asarray(l_sp),
+                                       rtol=1e-6, atol=1e-7, err_msg=nm)
+    for k in p_zp:
+        np.testing.assert_allclose(p_zp[k], p_sp[k], rtol=1e-6,
+                                   atol=1e-6, err_msg=k)
+
+
+def test_spmd_zero1_checkpoint_roundtrip(tmp_path):
+    """spmd ZeRO states save/load across arrangements (same param-shaped
+    checkpoint representation as ZeroPlan)."""
+    fname = str(tmp_path / "spmd_zero.states")
+    _, _, mod_sp = _fit(True, zero_stage=1, num_epoch=1)
+    assert mod_sp._exec_group._state_layout is not None
+    mod_sp.save_optimizer_states(fname)
+    _, _, mod_zp = _fit(False, zero_stage=1, num_epoch=1)
+    mod_zp.load_optimizer_states(fname)
+    s_sp = mod_sp._exec_group.export_fused_states()
+    s_zp = mod_zp._exec_group.export_fused_states()
+    for nm in s_sp:
+        for a, b in zip(jax.tree.leaves(s_sp[nm]),
+                        jax.tree.leaves(s_zp[nm])):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
+                                          err_msg=nm)
+
+
+def test_spmd_zero1_with_model_axis():
+    """ZeRO flat-shard update composes with model-sharded params on a
+    2-D mesh (the pad-vs-concatenate partitioner hazard regression)."""
+    p0, a0, _ = _fit(False)
+    p1, a1, mod = _fit(True, tagged=True, zero_stage=1,
+                       mesh=MeshConfig(data=4, model=2))
+    assert mod._exec_group._spmd_plan.zero
+    for k in p0:
+        np.testing.assert_allclose(p0[k], p1[k], rtol=2e-5, atol=2e-6,
+                                   err_msg=k)
+    np.testing.assert_allclose(a0, a1, rtol=1e-6)
+
+
+# ======================================================== SH6xx linting
+def test_spmd_lint_clean():
+    """A healthy spmd module binds with ZERO SH findings (the
+    conftest-wide validate=warn gate must stay clean)."""
+    _, _, mod = _fit(True, zero_stage=1)
+    rep = analysis.lint_module(mod)
+    assert [d for d in rep if d.rule.startswith("SH")] == []
+
+
+def test_sh601_sh603_sharding_mismatch():
+    """A param re-bound with the wrong sharding trips SH601 (binding
+    contract) and SH603 (donated carry cannot alias)."""
+    _, _, mod = _fit(True)
+    exe = mod._exec_group.executor
+    exe.arg_dict["fc1_weight"]._set(
+        jax.device_put(exe.arg_dict["fc1_weight"].asjax(),
+                       mod._exec_group._data_sharding))
+    rules = sorted(d.rule for d in analysis.lint_module(mod)
+                   if d.rule.startswith("SH"))
+    assert rules == ["SH601", "SH603"]
+
+
+def test_sh602_accidental_replication():
+    """A ctx_group-tagged param that cannot shard on the model axis
+    (indivisible dim) is flagged as accidentally replicated."""
+    _, _, mod = _fit(True, tagged=True, mesh=MeshConfig(data=2, model=4))
+    plan = mod._exec_group._spmd_plan
+    assert plan.unsharded_tagged == {}          # 16 % 4 == 0: clean
+    X, y = _data()
+    it = mx.io.NDArrayIter(X, y, batch_size=BATCH)
+    data = mx.sym.var("data")
+    with mx.AttrScope(ctx_group="stage0"):
+        fc = mx.sym.FullyConnected(data, num_hidden=15, name="fc1")
+    act = mx.sym.Activation(fc, act_type="relu")
+    fc2 = mx.sym.FullyConnected(act, num_hidden=CLASSES, name="fc2")
+    sym = mx.sym.SoftmaxOutput(fc2, name="softmax")
+    mod2 = mx.mod.Module(sym, context=[mx.cpu(i) for i in range(8)])
+    mod2.bind([("data", (BATCH, FEATS))], [("softmax_label", (BATCH,))],
+              spmd=True, mesh=MeshConfig(data=2, model=4))
+    mod2.init_params(mx.initializer.Xavier())
+    rep = analysis.lint_module(mod2)
+    sh602 = [d for d in rep if d.rule == "SH602"]
+    assert sh602 and any(d.node == "fc1_weight" for d in sh602)
+    assert all(d.rule != "SH601" for d in rep)
+
+
+def test_sh603_state_leaf_mismatch():
+    """An optimizer-state leaf imported with the wrong sharding trips
+    the donated-carry rule."""
+    _, _, mod = _fit(True, zero_stage=1)
+    g = mod._exec_group
+    nm = g._fused_watched[0]
+    g._fused_states[nm] = jax.tree.map(
+        lambda x: jax.device_put(np.asarray(x), g._repl_sharding),
+        g._fused_states[nm])
+    rules = [d.rule for d in analysis.lint_module(mod)]
+    assert "SH603" in rules
+
+
+# =============================================== kernel tier composition
+def test_kernel_tier_composes_under_mesh(monkeypatch):
+    """MXNET_KERNEL_TIER=xla under spmd is bit-identical to the default
+    (auto resolves to xla on CPU): tier dispatch happens inside the
+    traced runner and is sharding-agnostic."""
+    monkeypatch.setenv("MXNET_KERNEL_TIER", "xla")
+    p_xla, a_xla, _ = _fit(True)
+    monkeypatch.delenv("MXNET_KERNEL_TIER")
+    p_auto, a_auto, _ = _fit(True)
+    for k in p_xla:
+        np.testing.assert_array_equal(p_xla[k], p_auto[k], err_msg=k)
+    np.testing.assert_array_equal(a_xla, a_auto)
+
+
+# ===================================================== score/eval path
+def test_spmd_score_and_predict():
+    """Eval forward runs over the same sharded binding (score consumes
+    the train module directly)."""
+    X, y = _data()
+    _, _, mod = _fit(True, zero_stage=1)
+    it = mx.io.NDArrayIter(X, y, batch_size=BATCH)
+    res = dict(mod.score(it, "acc"))
+    assert 0.0 <= res["accuracy"] <= 1.0
