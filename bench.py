@@ -254,6 +254,54 @@ def measure_spmd_variant():
         return {"error": f"{type(e).__name__}: {e}"}
 
 
+def measure_serve_variant():
+    """The ``serve`` variant row: req/s at a p99 SLO under an open-loop
+    Poisson load against the continuous-batching server (mxnet_tpu/
+    serve) — the second bench axis ROADMAP item 3 names, next to
+    img/s. A small MLP keeps the serving overheads (scheduler, pad/
+    slice, dispatch) the measured quantity rather than model FLOPs;
+    runs on whatever backend the process has (TPU main path and CPU
+    fallback both emit it). Never sinks the run."""
+    import jax  # noqa: F401  (backend must already be up)
+    import numpy as np
+    import mxnet_tpu as mx
+
+    SLO_MS = 100
+    try:
+        data = mx.sym.var("data")
+        fc = mx.sym.FullyConnected(data=data, num_hidden=64, name="sv1")
+        act = mx.sym.Activation(fc, act_type="relu")
+        fc2 = mx.sym.FullyConnected(act, num_hidden=16, name="sv2")
+        sym = mx.sym.SoftmaxOutput(fc2, name="softmax")
+        mod = mx.mod.Module(sym)
+        mod.bind([("data", (8, 32))], [("softmax_label", (8,))],
+                 for_training=False)
+        mod.init_params(mx.initializer.Xavier())
+        server = mx.serve.serve(mod, name="bench", ladder=[1, 2, 4, 8],
+                                default_deadline_ms=SLO_MS)
+        gen = mx.serve.PoissonLoadGen(
+            server,
+            lambda i, rng: {"data": rng.rand(1 + i % 3, 32)
+                            .astype(np.float32)},
+            model="bench", rate=150.0, n_requests=300, seed=0)
+        try:
+            out = gen.run(slo_ms=SLO_MS)
+        finally:
+            server.stop()
+        stats = server.stats()
+        m = stats["models"]["bench"]
+        out.update({
+            "batch_occupancy": m["batch_occupancy"],
+            "padding_waste_pct": m["padding_waste_pct"],
+            "dispatches": m["dispatches"],
+            "compiles_since_warmup": stats["compiles_since_warmup"],
+            "ladder": m["ladder"],
+        })
+        return out
+    except Exception as e:          # the variant must never sink the run
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
 def run_cpu_fallback():
     """Reduced ours-only measurement on the CPU backend.
 
@@ -332,6 +380,7 @@ def run_cpu_fallback():
         "achieved_flops_per_sec": achieved,
         "roofline": roofline_rows,
         "spmd": measure_spmd_variant(),
+        "serve": measure_serve_variant(),
         "note": "accelerator backend unavailable; ours-only fused-step "
                 "throughput on the XLA CPU backend at a CIFAR-scale "
                 "operating point — NOT comparable to the flax-paired "
@@ -537,6 +586,11 @@ def main():
     _log("spmd variant (spmd_vs_kvstore paired lap)")
     spmd_variant = measure_spmd_variant()
 
+    # serve variant (also post-laps): req/s at a p99 SLO through the
+    # continuous-batching server — the second bench axis (ROADMAP 3)
+    _log("serve variant (Poisson open-loop vs p99 SLO)")
+    serve_variant = measure_serve_variant()
+
     # per-op MFU attribution + roofline from the registry cost metadata
     # (telemetry/mfu.py): coverage is attributed FLOPs over the XLA
     # compiled-program count — the honesty check on the per-op numbers
@@ -603,6 +657,7 @@ def main():
                               "consistent": paired_ok},
         "pallas_smoke": pallas_smoke,
         "spmd": spmd_variant,
+        "serve": serve_variant,
         "mfu_ours": mfu(ours_img_s, ours_flops),
         "mfu_flax": mfu(flax_img_s, flax_flops),
         "mfu_model_attributed": mfu(ours_img_s, attributed_flops),

@@ -57,5 +57,6 @@ symbol._init_symbol_module(symbol.__dict__)
 from . import image
 from . import predict
 from .predict import export_model, Predictor
+from . import serve  # continuous-batching inference server (serve/)
 
 __version__ = "0.1.0"

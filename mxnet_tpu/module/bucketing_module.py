@@ -160,6 +160,31 @@ class BucketingModule(BaseModule):
             self._buckets[bucket_key] = mod
         self._active_key = bucket_key
 
+    def warm_buckets(self, bucket_shapes):
+        """Bind every bucket in ``bucket_shapes`` up front.
+
+        ``bucket_shapes``: iterable of ``(bucket_key, data_shapes,
+        label_shapes)`` triples. Serving warmup calls this so every rung
+        of a bucket ladder is bound (and its forward program traced on
+        first use through the process-wide program cache) before the
+        first request arrives — bucket switches in steady state then
+        never construct executors or compile. Restores the previously
+        active bucket. Returns the list of bucket keys bound."""
+        assert self.binded and self.params_initialized, \
+            "bind() + init_params() must run before warm_buckets()"
+        prev = self._active_key
+        bound = []
+        for key, data_shapes, label_shapes in bucket_shapes:
+            self.switch_bucket(key, data_shapes, label_shapes)
+            bound.append(key)
+        self._active_key = prev
+        return bound
+
+    @property
+    def bucket_keys(self):
+        """Keys with a bound module (the warmed rungs)."""
+        return list(self._buckets)
+
     # --------------------------------------------------------- optimizer
     def init_optimizer(self, kvstore="local", optimizer="sgd",
                        optimizer_params=(("learning_rate", 0.01),),

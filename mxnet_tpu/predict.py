@@ -39,14 +39,17 @@ _PARAMS = "weights.params"
 
 
 def export_model(path, symbol, arg_params, aux_params, data_shapes,
-                 compute_dtype=None):
+                 compute_dtype=None, data_dtypes=None):
     """Serialize an inference program for ``symbol`` to ``path``.
 
     ``data_shapes``: dict input name -> shape (the non-parameter inputs,
     like MXPredCreate's input_shapes). ``arg_params``/``aux_params``:
     trained parameters (NDArray or array-like). ``compute_dtype``:
     optional mixed-precision compute dtype (e.g. jnp.bfloat16) baked
-    into the exported program.
+    into the exported program. ``data_dtypes``: dict input name ->
+    dtype (default float32) — recorded per input in the manifest and
+    baked into the exported program's input avals, so bf16/int inputs
+    (embedding ids, token streams) round-trip through the artifact.
     """
     import jax
     import jax.numpy as jnp
@@ -55,6 +58,8 @@ def export_model(path, symbol, arg_params, aux_params, data_shapes,
     from .ndarray import NDArray, save as nd_save
 
     data_shapes = {k: tuple(v) for k, v in data_shapes.items()}
+    data_dtypes = {k: np.dtype(
+        (data_dtypes or {}).get(k, np.float32)) for k in data_shapes}
     runner, arg_names, aux_names, _ = _build_graph_runner(
         symbol, compute_dtype=compute_dtype)
     param_names = [n for n in arg_names
@@ -89,7 +94,7 @@ def export_model(path, symbol, arg_params, aux_params, data_shapes,
         outs, _ = runner(args, aux, False, jax.random.PRNGKey(0))
         return outs
 
-    data_example = {n: jnp.zeros(s, jnp.float32)
+    data_example = {n: jnp.zeros(s, data_dtypes[n])
                     for n, s in data_shapes.items()}
     exported = jexport.export(
         jax.jit(infer), platforms=("cpu", "tpu"))(params, aux,
@@ -99,6 +104,7 @@ def export_model(path, symbol, arg_params, aux_params, data_shapes,
     manifest = {
         "format_version": _FORMAT_VERSION,
         "inputs": {n: list(s) for n, s in data_shapes.items()},
+        "input_dtypes": {n: dt.name for n, dt in data_dtypes.items()},
         "param_names": param_names,
         "aux_names": aux_names,
         "output_names": symbol.list_outputs(),
@@ -168,18 +174,33 @@ class Predictor:
     def input_shapes(self):
         return {n: tuple(s) for n, s in self._manifest["inputs"].items()}
 
+    @property
+    def input_dtypes(self):
+        """Per-input dtypes recorded at export time (manifest
+        ``input_dtypes``; float32 for pre-dtype artifacts)."""
+        recorded = self._manifest.get("input_dtypes") or {}
+        return {n: np.dtype(recorded.get(n, "float32"))
+                for n in self._manifest["inputs"]}
+
     def forward(self, **inputs):
-        """Run the exported program; returns the output list."""
+        """Run the exported program; returns the output list.
+
+        Inputs are cast to the manifest's recorded per-input dtype (the
+        exported program's input avals) — a bf16-exported model takes
+        float32 host arrays, an embedding model takes integer ids.
+        """
         import jax.numpy as jnp
         from .ndarray import NDArray
 
+        dtypes = self.input_dtypes
         data = {}
         for n, shape in self.input_shapes.items():
             if n not in inputs:
                 raise MXNetError(f"missing input {n!r}")
             v = inputs[n]
-            v = v.asjax() if isinstance(v, NDArray) else jnp.asarray(
-                v, jnp.float32)
+            v = v.asjax() if isinstance(v, NDArray) else jnp.asarray(v)
+            if v.dtype != dtypes[n]:
+                v = v.astype(dtypes[n])
             if tuple(v.shape) != shape:
                 raise MXNetError(
                     f"input {n!r}: shape {tuple(v.shape)} != exported "
@@ -188,6 +209,63 @@ class Predictor:
         outs = self._exported.call(self._params, self._aux, data)
         self._outputs = [NDArray(o) for o in outs]
         return self._outputs
+
+    def batch_forward(self, **inputs):
+        """Forward with a DYNAMIC leading batch dim.
+
+        The exported program's batch size is fixed; this accepts any
+        number of rows, runs them through the program in exported-batch
+        windows — the tail window zero-padded with the serving pad
+        helper (serve.batching.pad_rows) and sliced back afterwards
+        (bit-transparent, same contract as the server's batcher) — and
+        returns outputs with the caller's row count. One host->device
+        staging per window, not per call-site array.
+        """
+        from .ndarray import NDArray
+        from .serve.batching import pad_rows, slice_rows
+
+        shapes = self.input_shapes
+        dtypes = self.input_dtypes
+        batch = next(iter(shapes.values()))[0]
+        vals, rows = {}, None
+        for n, shape in shapes.items():
+            if n not in inputs:
+                raise MXNetError(f"missing input {n!r}")
+            if shape[0] != batch:
+                raise MXNetError(
+                    "batch_forward needs a common exported batch dim; "
+                    f"input {n!r} has {shape[0]} != {batch}")
+            v = inputs[n]
+            v = np.asarray(v.asnumpy() if isinstance(v, NDArray) else v,
+                           dtype=dtypes[n])
+            if tuple(v.shape[1:]) != shape[1:]:
+                raise MXNetError(
+                    f"input {n!r}: rows of shape {tuple(v.shape[1:])} != "
+                    f"exported {shape[1:]}")
+            if rows is None:
+                rows = v.shape[0]
+            elif v.shape[0] != rows:
+                raise MXNetError("inputs disagree on the row count")
+            vals[n] = v
+        if not rows:
+            raise MXNetError("batch_forward needs at least one row")
+
+        per_window = []
+        for off in range(0, rows, batch):
+            n_valid = min(batch, rows - off)
+            window = {n: pad_rows(v[off:off + n_valid], batch)
+                      for n, v in vals.items()}
+            outs = self.forward(**window)
+            per_window.append(slice_rows(outs, 0, n_valid))
+        merged = []
+        for i in range(len(per_window[0])):
+            if len(per_window) == 1:
+                merged.append(per_window[0][i])
+            else:
+                merged.append(NDArray(np.concatenate(
+                    [w[i].asnumpy() for w in per_window], axis=0)))
+        self._outputs = merged
+        return merged
 
     def get_output(self, index=0):
         """reference: MXPredGetOutput — output of the last forward."""

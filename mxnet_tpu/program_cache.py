@@ -39,6 +39,18 @@ compiled executable. The ``executor.jit_cache.hit``/``.miss`` telemetry
 counters account lookups (per-instance and process-wide hits count the
 same — both mean "no new compile") and the
 ``executor.jit_cache.programs_live`` gauge tracks residency.
+
+Serving additions (mxnet_tpu/serve): an inference server's bucket-
+ladder programs are warmed once at startup and must then survive for
+the process lifetime — a training rebind storm evicting a serving
+program would reintroduce a compile into a latency SLO. ``pin(key)``
+exempts an entry from LRU eviction (eviction skips pinned entries;
+if every entry is pinned the cache grows past capacity rather than
+break a pin); ``unpin(key)`` restores normal lifecycle. ``contains``/
+``keys`` give warmup code residency introspection, and
+``compile_count()`` is a monotone count of fresh program insertions —
+the steady-state contract "zero compiles after warmup" is the delta
+of this counter, independent of the telemetry switch.
 """
 from __future__ import annotations
 
@@ -54,7 +66,8 @@ import numpy as np
 from .telemetry import metrics as _metrics
 
 __all__ = ["symbol_signature", "get", "put", "clear", "size",
-           "attr_cache_stable"]
+           "attr_cache_stable", "pin", "unpin", "pinned", "contains",
+           "keys", "compile_count"]
 
 _ID_REPR = re.compile(r" at 0x[0-9a-fA-F]+")
 
@@ -108,6 +121,8 @@ def attr_cache_stable(value, _depth=0):
 
 _lock = threading.Lock()
 _cache = OrderedDict()        # key tuple -> program callable
+_pinned = set()               # keys exempt from LRU eviction (serving)
+_compiles = 0                 # monotone count of fresh insertions
 
 
 def _capacity():
@@ -147,21 +162,77 @@ def get(key):
 
 
 def put(key, fn):
-    """Insert a program, evicting least-recently-used beyond capacity."""
+    """Insert a program, evicting least-recently-used beyond capacity.
+
+    Pinned entries are never evicted: the scan walks oldest-first over
+    unpinned keys only, so a fully-pinned cache overflows capacity
+    instead of breaking a serving warmup's residency guarantee.
+    """
+    global _compiles
     cap = _capacity()
     with _lock:
+        if key not in _cache:
+            _compiles += 1      # a fresh trace/compile entered the cache
         _cache[key] = fn
         _cache.move_to_end(key)
         while len(_cache) > cap:
-            _cache.popitem(last=False)
+            victim = next((k for k in _cache
+                           if k not in _pinned and k != key), None)
+            if victim is None:      # everything else pinned: overflow
+                break
+            del _cache[victim]
         _note_size_locked()
     return fn
 
 
+def pin(key):
+    """Exempt ``key`` from LRU eviction (no-op if absent). Returns
+    whether the key is resident — serving warmup asserts on it."""
+    with _lock:
+        if key in _cache:
+            _pinned.add(key)
+            return True
+        return False
+
+
+def unpin(key):
+    """Restore normal LRU lifecycle for ``key``."""
+    with _lock:
+        _pinned.discard(key)
+
+
+def pinned():
+    """Snapshot of the pinned key set."""
+    with _lock:
+        return set(_pinned)
+
+
+def contains(key):
+    """Residency probe without touching LRU recency."""
+    with _lock:
+        return key in _cache
+
+
+def keys():
+    """Snapshot of resident keys, LRU-oldest first."""
+    with _lock:
+        return list(_cache)
+
+
+def compile_count():
+    """Monotone count of fresh program insertions (never reset by
+    ``clear``): ``compile_count()`` deltas prove zero-compile steady
+    state regardless of the telemetry enable switch."""
+    with _lock:
+        return _compiles
+
+
 def clear():
-    """Drop every cached program (tests; frees compiled executables)."""
+    """Drop every cached program (tests; frees compiled executables).
+    Pins are dropped with their entries."""
     with _lock:
         _cache.clear()
+        _pinned.clear()
         _note_size_locked()
 
 

@@ -1,0 +1,269 @@
+"""Dynamic-batch assembly: bucket ladder, pad/slice, admission queue.
+
+The shape discipline: a request carries one or more *rows* (examples) —
+its inputs have a leading row dimension. The batcher coalesces queued
+requests FIFO into one batch of N total rows, pads it up to the
+smallest ladder bucket B >= N (``pad_rows``: zero rows appended, which
+is compute waste but never numerics — every op downstream of the data
+input is row-independent in inference mode), runs the pre-compiled
+bucket-B program, and slices rows back per request (``slice_rows``).
+The pad/slice pair is bit-transparent: row i of the padded batch's
+output is exactly the program's output for row i, so a served response
+is bitwise-equal to a direct forward of the same rows through the same
+bucket program (tests/test_serve.py pins this).
+
+``AdmissionQueue`` owns the per-model FIFO plus the deadline
+bookkeeping the scheduler's flush decision reads: a request is admitted
+with ``deadline = arrival + deadline_s`` and the queue exposes
+``flush_at(exec_est)`` — the latest moment dispatch can start and still
+meet the earliest queued deadline given the bucket's measured execution
+time. Waiting past ``flush_at`` in the hope of filling a larger bucket
+is the pad-vs-wait break-even the scheduler never crosses.
+"""
+from __future__ import annotations
+
+import collections
+import itertools
+import os
+import threading
+
+import numpy as np
+
+from ..base import MXNetError
+
+__all__ = ["QueueFullError", "BucketLadder", "default_ladder",
+           "bucket_for", "pad_rows", "slice_rows", "Request",
+           "ResponseHandle", "AdmissionQueue"]
+
+_req_ids = itertools.count()
+
+
+class QueueFullError(MXNetError):
+    """Admission rejected: the model's queue is at MXNET_SERVE_MAX_QUEUE."""
+
+
+def default_ladder():
+    """The bucket ladder from ``MXNET_SERVE_BUCKETS`` (default
+    ``1,2,4,8,16,32``): comma-separated batch sizes, sorted ascending,
+    duplicates dropped."""
+    raw = os.environ.get("MXNET_SERVE_BUCKETS", "1,2,4,8,16,32")
+    try:
+        sizes = sorted({int(tok) for tok in raw.split(",") if tok.strip()})
+    except ValueError:
+        raise MXNetError(f"MXNET_SERVE_BUCKETS={raw!r}: expected "
+                         "comma-separated batch sizes")
+    if not sizes or sizes[0] < 1:
+        raise MXNetError(f"MXNET_SERVE_BUCKETS={raw!r}: bucket sizes "
+                         "must be >= 1")
+    return sizes
+
+
+class BucketLadder:
+    """Sorted batch-size rungs one model serves at."""
+
+    def __init__(self, sizes=None):
+        sizes = list(sizes) if sizes is not None else default_ladder()
+        if not sizes:
+            raise MXNetError("empty bucket ladder")
+        self.sizes = sorted({int(s) for s in sizes})
+        if self.sizes[0] < 1:
+            raise MXNetError("bucket sizes must be >= 1")
+
+    @property
+    def max(self):
+        return self.sizes[-1]
+
+    def bucket_for(self, rows):
+        """Smallest rung >= rows (the pad target), or None past the top."""
+        for s in self.sizes:
+            if s >= rows:
+                return s
+        return None
+
+    def __iter__(self):
+        return iter(self.sizes)
+
+    def __repr__(self):
+        return f"BucketLadder({self.sizes})"
+
+
+def bucket_for(rows, ladder):
+    """Module-level convenience over ``BucketLadder.bucket_for``."""
+    ladder = ladder if isinstance(ladder, BucketLadder) \
+        else BucketLadder(ladder)
+    return ladder.bucket_for(rows)
+
+
+def pad_rows(arr, bucket):
+    """Pad ``arr`` (rows leading) with zero rows up to ``bucket``.
+
+    numpy in, numpy out — batch assembly happens host-side; one
+    device_put of the assembled batch follows (the engine's single
+    host->device transfer per dispatch).
+    """
+    arr = np.asarray(arr)
+    rows = arr.shape[0]
+    if rows > bucket:
+        raise MXNetError(f"{rows} rows cannot pad down to bucket {bucket}")
+    if rows == bucket:
+        return arr
+    pad = np.zeros((bucket - rows,) + arr.shape[1:], dtype=arr.dtype)
+    return np.concatenate([arr, pad], axis=0)
+
+
+def slice_rows(outputs, start, rows):
+    """Rows ``[start, start+rows)`` of every output — the response path
+    that undoes the batch/pad. Accepts NDArray or jax/numpy arrays and
+    returns NDArrays."""
+    from ..ndarray import NDArray
+    out = []
+    for o in outputs:
+        val = o.asjax() if isinstance(o, NDArray) else o
+        out.append(NDArray(val[start:start + rows]))
+    return out
+
+
+class Request:
+    """One admitted unit of work: inputs (name -> rows-leading numpy
+    array), row count, arrival/deadline in scheduler-clock seconds."""
+
+    __slots__ = ("id", "model", "inputs", "rows", "arrival", "deadline",
+                 "handle")
+
+    def __init__(self, model, inputs, rows, arrival, deadline):
+        self.id = next(_req_ids)
+        self.model = model
+        self.inputs = inputs
+        self.rows = rows
+        self.arrival = arrival
+        self.deadline = deadline
+        self.handle = ResponseHandle(self)
+
+
+class ResponseHandle:
+    """Thread-safe sync+async result surface for one request.
+
+    Sync: ``result(timeout)`` blocks until the dispatch thread (or a
+    ``pump()`` call) completes the request, returning the sliced output
+    NDArrays or raising the dispatch error. Async: ``done()`` polls,
+    ``add_done_callback(fn)`` runs ``fn(handle)`` at completion (or
+    immediately if already complete) on the completing thread.
+    ``latency``/``bucket``/``completed_at`` carry the telemetry facts
+    the load generator aggregates.
+    """
+
+    def __init__(self, request):
+        self._event = threading.Event()
+        self._lock = threading.Lock()
+        self._callbacks = []
+        self._outputs = None
+        self._error = None
+        self.request = request
+        self.bucket = None          # set at dispatch
+        self.completed_at = None    # scheduler-clock seconds
+
+    def done(self):
+        return self._event.is_set()
+
+    @property
+    def latency(self):
+        """Admission-to-completion seconds (None until done)."""
+        if self.completed_at is None:
+            return None
+        return self.completed_at - self.request.arrival
+
+    def missed_deadline(self):
+        return (self.completed_at is not None
+                and self.completed_at > self.request.deadline)
+
+    def result(self, timeout=None):
+        if not self._event.wait(timeout):
+            raise MXNetError(
+                f"request {self.request.id} not complete within "
+                f"{timeout}s (queue stuck or server stopped?)")
+        if self._error is not None:
+            raise self._error
+        return self._outputs
+
+    def exception(self):
+        return self._error if self._event.is_set() else None
+
+    def add_done_callback(self, fn):
+        with self._lock:
+            if not self._event.is_set():
+                self._callbacks.append(fn)
+                return
+        fn(self)
+
+    def _complete(self, outputs=None, error=None, bucket=None, now=None):
+        with self._lock:
+            self._outputs = outputs
+            self._error = error
+            self.bucket = bucket
+            self.completed_at = now
+            callbacks, self._callbacks = self._callbacks, []
+            self._event.set()
+        for fn in callbacks:
+            try:
+                fn(self)
+            except Exception:       # a client callback must not kill
+                pass                # the dispatch thread
+
+
+class AdmissionQueue:
+    """Per-model FIFO with the scheduler's flush bookkeeping.
+
+    Not self-locking: the owning scheduler serializes access under its
+    own lock (admission, flush decisions and batch draining must be one
+    atomic step against each other).
+    """
+
+    def __init__(self, model, max_requests):
+        self.model = model
+        self.max_requests = max_requests
+        self._q = collections.deque()
+        self.rows_pending = 0
+
+    def __len__(self):
+        return len(self._q)
+
+    def admit(self, request):
+        if len(self._q) >= self.max_requests:
+            raise QueueFullError(
+                f"model {self.model!r}: queue depth {len(self._q)} at "
+                f"MXNET_SERVE_MAX_QUEUE={self.max_requests}")
+        self._q.append(request)
+        self.rows_pending += request.rows
+
+    def oldest_deadline(self):
+        """Earliest deadline among queued requests (FIFO admission with
+        one default deadline keeps the head earliest; min() stays
+        correct for mixed per-request deadlines)."""
+        if not self._q:
+            return None
+        return min(r.deadline for r in self._q)
+
+    def flush_at(self, exec_est):
+        """Latest dispatch start that still meets the earliest queued
+        deadline, given ``exec_est`` seconds of bucket execution. The
+        scheduler dispatches at this instant rather than keep waiting
+        for a larger bucket — the pad-vs-wait break-even."""
+        d = self.oldest_deadline()
+        return None if d is None else d - exec_est
+
+    def drain(self, max_rows):
+        """Pop FIFO-prefix requests whose rows fit in ``max_rows``."""
+        took, rows = [], 0
+        while self._q and rows + self._q[0].rows <= max_rows:
+            r = self._q.popleft()
+            rows += r.rows
+            took.append(r)
+        self.rows_pending -= rows
+        return took, rows
+
+    def fail_all(self, error, now=None):
+        """Complete every queued request with ``error`` (server stop)."""
+        while self._q:
+            r = self._q.popleft()
+            self.rows_pending -= r.rows
+            r.handle._complete(error=error, now=now)

@@ -1,0 +1,145 @@
+"""Multi-tenant model registry + deadline-aware fair scheduling.
+
+Several models share one device pool (engines execute serially on the
+dispatch thread — one XLA stream, the device is the shared resource);
+the registry owns, per model, the engine, the admission queue, and the
+scheduling bookkeeping. The pick rule combines the two properties the
+ISSUE names:
+
+* **deadline-aware**: a model becomes *ready* when its queued rows fill
+  the largest ladder bucket (no batching benefit left in waiting) OR
+  when the scheduler clock reaches its ``flush_at`` — the earliest
+  queued deadline minus the measured execution estimate for the bucket
+  that would serve the queue *right now*. Past ``flush_at``, waiting
+  for a larger bucket would blow the SLO of a request a smaller bucket
+  can still serve on time (the acceptance property
+  tests/test_serve.py::test_deadline_flush_fake_clock pins).
+* **fair**: among simultaneously-ready models, least-recently-
+  dispatched wins (round-robin under saturation), so one hot tenant
+  cannot starve another — every dispatch bumps the model's serial.
+
+``next_action`` is a pure decision function over (queues, clock): it
+returns ``("dispatch", model)`` or ``("wait", seconds|None)`` and
+mutates nothing, so the deterministic tests drive it directly.
+"""
+from __future__ import annotations
+
+import threading
+
+from ..base import MXNetError
+from .batching import AdmissionQueue
+
+__all__ = ["ModelRegistry"]
+
+
+class _Entry:
+    __slots__ = ("engine", "queue", "last_dispatch_seq")
+
+    def __init__(self, engine, max_queue):
+        self.engine = engine
+        self.queue = AdmissionQueue(engine.name, max_queue)
+        self.last_dispatch_seq = 0
+
+
+class ModelRegistry:
+    """name -> (engine, admission queue, fairness serial)."""
+
+    def __init__(self, max_queue):
+        self._entries = {}
+        self._max_queue = max_queue
+        self._seq = 0
+        self._lock = threading.Lock()   # registration only; the server
+                                        # lock serializes scheduling
+
+    def add(self, engine):
+        with self._lock:
+            if engine.name in self._entries:
+                raise MXNetError(
+                    f"model {engine.name!r} already registered")
+            self._entries[engine.name] = _Entry(engine, self._max_queue)
+        return engine
+
+    def remove(self, name):
+        with self._lock:
+            entry = self._entries.pop(name, None)
+        if entry is None:
+            raise MXNetError(f"no model {name!r} registered")
+        return entry
+
+    def __contains__(self, name):
+        return name in self._entries
+
+    def names(self):
+        return list(self._entries)
+
+    def engine(self, name):
+        entry = self._entries.get(name)
+        if entry is None:
+            raise MXNetError(
+                f"no model {name!r} registered "
+                f"(have: {sorted(self._entries)})")
+        return entry.engine
+
+    def queue(self, name):
+        return self._entries[name].queue
+
+    def entry(self, name):
+        """The (engine, queue, serial) record or None."""
+        return self._entries.get(name)
+
+    def entries(self):
+        return list(self._entries.values())
+
+    def sole_name(self):
+        """The single registered model's name (the ``serve(model)``
+        front end lets submit() omit it)."""
+        names = list(self._entries)
+        if len(names) != 1:
+            raise MXNetError(
+                "submit() needs an explicit model name with "
+                f"{len(names)} models registered (have: {sorted(names)})")
+        return names[0]
+
+    # ---------------------------------------------------------- scheduling
+    def _flush_at(self, entry):
+        """The model's pad-vs-wait break-even instant (None if idle)."""
+        q = entry.queue
+        if not len(q):
+            return None
+        bucket = entry.engine.ladder.bucket_for(
+            min(q.rows_pending, entry.engine.ladder.max))
+        return q.flush_at(entry.engine.exec_estimate(bucket))
+
+    def next_action(self, now):
+        """('dispatch', name) | ('wait', seconds|None), mutating nothing.
+
+        Ready = bucket full or past flush_at; ties break to the least
+        recently dispatched model. With work queued but nothing ready,
+        the wait is until the earliest flush_at; with no work at all the
+        wait is unbounded (None — sleep until a submit signals).
+        """
+        ready, soonest = [], None
+        for name, entry in self._entries.items():
+            q = entry.queue
+            if not len(q):
+                continue
+            if q.rows_pending >= entry.engine.ladder.max:
+                ready.append((entry.last_dispatch_seq, name))
+                continue
+            flush_at = self._flush_at(entry)
+            if flush_at is not None and now >= flush_at:
+                ready.append((entry.last_dispatch_seq, name))
+            elif flush_at is not None:
+                soonest = flush_at if soonest is None \
+                    else min(soonest, flush_at)
+        if ready:
+            ready.sort()
+            return "dispatch", ready[0][1]
+        if soonest is not None:
+            return "wait", max(0.0, soonest - now)
+        return "wait", None
+
+    def note_dispatch(self, name):
+        """Bump the fairness serial for a dispatched model."""
+        self._seq += 1
+        self._entries[name].last_dispatch_seq = self._seq

@@ -1,0 +1,412 @@
+"""InferenceServer: the in-process continuous-batching front end.
+
+``serve(model).submit({"data": x})`` is the whole client API: submit
+returns a thread-safe ``ResponseHandle`` (sync ``result()``, async
+``done()``/``add_done_callback``) and the server's dispatch thread
+drives admission-queue -> dynamic-batch -> pre-compiled bucket program
+-> per-request slices. No sockets: the front end is in-process so
+tier-1 tests exercise the full scheduler/batcher/registry vertical
+hermetically; a network listener is a thin adapter over ``submit``.
+
+Two drive modes:
+
+* ``start()`` — a dispatch thread loops decide/wait/dispatch against
+  the real clock (production and the e2e/soak tests);
+* ``pump()`` — one explicit scheduling step per call against any clock
+  (the deterministic tier-1 path: ``FakeClock`` + scripted arrivals,
+  no wall-clock sleeps).
+
+Telemetry (always on — these metrics ARE the serving product surface,
+exported by ``telemetry.prometheus`` and rendered by tools/diagnose.py):
+
+====================================  ======  ==========================
+``serve.request.latency.seconds``     hist    admission -> completion,
+                                              per model (p50/p99 source)
+``serve.batch.exec.seconds``          hist    bucket program execution
+``serve.queue.depth``                 gauge   per model + global
+``serve.batch.occupancy``             gauge   rows/bucket, last dispatch
+``serve.padding.waste``               gauge   cumulative padded-row
+                                              fraction, per model
+``serve.requests|responses|
+  dispatches|rejected|errors``        ctr     per model
+``serve.rows|padded_rows``            ctr     occupancy/waste numerators
+``serve.deadline.miss``               ctr     completed past deadline
+``serve.program_cache.
+  compiles_since_warmup``             gauge   MUST stay 0 in steady
+                                              state (acceptance gate)
+====================================  ======  ==========================
+
+plus one flight-ring record per dispatch (``serve.dispatch``) so a
+crash report shows the recent serving timeline.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import threading
+
+import numpy as np
+
+from .. import program_cache as _progcache
+from .. import telemetry as _telemetry
+from ..base import MXNetError
+from .batching import Request, pad_rows, slice_rows
+from .clock import MonotonicClock
+from .engine import BucketEngine, PredictorEngine
+from .registry import ModelRegistry
+
+__all__ = ["InferenceServer", "serve"]
+
+log = logging.getLogger(__name__)
+
+
+def _env_int(name, default):
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+class InferenceServer:
+    """Continuous-batching server over a multi-tenant model registry."""
+
+    def __init__(self, clock=None, max_queue=None, default_deadline_ms=None,
+                 logger=None):
+        self._clock = clock if clock is not None else MonotonicClock()
+        self._max_queue = max_queue if max_queue is not None else \
+            _env_int("MXNET_SERVE_MAX_QUEUE", 1024)
+        self._default_deadline_s = (
+            default_deadline_ms if default_deadline_ms is not None
+            else _env_int("MXNET_SERVE_DEADLINE_MS", 100)) / 1000.0
+        self.logger = logger or log
+        self._registry = ModelRegistry(self._max_queue)
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._thread = None
+        self._running = False
+        self._warm_mark = None
+
+    # ------------------------------------------------------------- registry
+    def register(self, name, model=None, symbol=None, arg_params=None,
+                 aux_params=None, data_shapes=None, label_names=None,
+                 ladder=None, context=None, compute_dtype=None,
+                 predictor=None):
+        """Add a model and warm its bucket ladder (compile + pin every
+        rung) so steady-state serving never compiles.
+
+        Sources, one of: ``model`` (a bound+initialized Module — symbol,
+        params, per-row input shapes, context and compute dtype are
+        extracted), ``predictor`` (a ``.mxp`` path or Predictor served
+        directly at its exported batch size), or explicit ``symbol`` +
+        ``arg_params``/``aux_params`` + ``data_shapes`` (dict input name
+        -> per-ROW shape, no batch dim).
+        """
+        if predictor is not None:
+            engine = PredictorEngine(name, predictor, ladder=ladder)
+        else:
+            if model is not None:
+                if not (model.binded and model.params_initialized):
+                    raise MXNetError(
+                        f"register({name!r}): the Module must be bound "
+                        "with initialized params")
+                symbol = model._symbol
+                arg_params, aux_params = model.get_params()
+                data_shapes = {d.name: tuple(d.shape)[1:]
+                               for d in model.data_shapes}
+                label_names = label_names or list(model._label_names)
+                context = context or model._context[0]
+                compute_dtype = compute_dtype or model._compute_dtype
+            if symbol is None or data_shapes is None:
+                raise MXNetError(
+                    f"register({name!r}) needs model=, predictor=, or "
+                    "symbol= + params + data_shapes")
+            engine = BucketEngine(
+                name, symbol, arg_params or {}, aux_params or {},
+                data_shapes, label_names=label_names or ("softmax_label",),
+                ladder=ladder, context=context,
+                compute_dtype=compute_dtype, logger=self.logger)
+
+        with _telemetry.span("serve.warmup", model=name):
+            est = engine.warmup(self._clock)
+        self.logger.info(
+            "serve: model %r warmed — ladder %s, %d compiles, exec est %s",
+            name, engine.ladder.sizes, engine.warmup_compiles,
+            {b: f"{s * 1e3:.2f}ms" for b, s in est.items()})
+        self._registry.add(engine)
+        self._warm_mark = _progcache.compile_count()
+        # the serving gauges exist from registration (scrapes before the
+        # first request see zeros, not absent series)
+        _telemetry.gauge("serve.queue.depth", model=name).set(0)
+        _telemetry.gauge("serve.queue.depth").set(self._depth_total())
+        _telemetry.gauge(
+            "serve.program_cache.compiles_since_warmup").set(0)
+        _telemetry.flightrec.note(
+            "serve.register", model=name, ladder=list(engine.ladder),
+            warmup_compiles=engine.warmup_compiles)
+        return engine
+
+    def unregister(self, name):
+        """Remove a model, failing its queued requests."""
+        entry = self._registry.remove(name)
+        entry.queue.fail_all(
+            MXNetError(f"model {name!r} unregistered"),
+            now=self._clock.now())
+        for key in entry.engine.program_keys():
+            _progcache.unpin(key)
+
+    @property
+    def models(self):
+        return self._registry.names()
+
+    def engine(self, name=None):
+        return self._registry.engine(name or self._registry.sole_name())
+
+    # ------------------------------------------------------------ admission
+    def submit(self, inputs, model=None, deadline_ms=None):
+        """Admit one request; returns its ``ResponseHandle``.
+
+        ``inputs``: dict input name -> array with a leading row dim
+        (1 <= rows <= the model's largest bucket). ``deadline_ms`` is
+        relative to now (default ``MXNET_SERVE_DEADLINE_MS``); the
+        scheduler flushes the request's batch no later than
+        deadline - estimated bucket execution time.
+        """
+        name = model or self._registry.sole_name()
+        engine = self._registry.engine(name)
+        rows, vals = engine.validate(inputs)
+        now = self._clock.now()
+        deadline_s = (deadline_ms if deadline_ms is not None
+                      else self._default_deadline_s * 1000.0) / 1000.0
+        req = Request(name, vals, rows, now, now + deadline_s)
+        with self._cond:
+            try:
+                self._registry.queue(name).admit(req)
+            except MXNetError:
+                _telemetry.counter("serve.rejected", model=name).inc()
+                raise
+            depth = len(self._registry.queue(name))
+            self._cond.notify_all()
+        _telemetry.counter("serve.requests", model=name).inc()
+        _telemetry.gauge("serve.queue.depth", model=name).set(depth)
+        _telemetry.gauge("serve.queue.depth").set(self._depth_total())
+        return req.handle
+
+    def _depth_total(self):
+        return sum(len(e.queue) for e in self._registry.entries())
+
+    # ------------------------------------------------------------- dispatch
+    def _dispatch(self, name):
+        """Drain one dynamic batch for ``name`` and run it. Returns the
+        number of requests served (0 if the queue emptied under us)."""
+        with self._lock:
+            entry = self._registry.entry(name)
+            if entry is None:
+                return 0
+            engine = entry.engine
+            reqs, rows = entry.queue.drain(engine.ladder.max)
+            if not reqs:
+                return 0
+            self._registry.note_dispatch(name)
+            depth = len(entry.queue)
+        bucket = engine.ladder.bucket_for(rows)
+        wait_s = self._clock.now() - min(r.arrival for r in reqs)
+
+        # the flush break-even must cover the WHOLE dispatch cost the
+        # tail request pays, so t0 starts before batch assembly
+        t0 = self._clock.now()
+        values = {
+            nm: pad_rows(
+                np.concatenate([r.inputs[nm] for r in reqs], axis=0)
+                if len(reqs) > 1 else reqs[0].inputs[nm], bucket)
+            for nm in engine.data_names}
+        try:
+            outs = engine.forward(bucket, values)
+            import jax
+            for o in outs:
+                jax.block_until_ready(o.asjax())
+        except Exception as exc:    # fail the whole batch, keep serving
+            now = self._clock.now()
+            for r in reqs:
+                r.handle._complete(error=exc, now=now)
+            _telemetry.counter("serve.errors", model=name).inc()
+            _telemetry.flightrec.note("serve.dispatch.error", model=name,
+                                      bucket=bucket, error=repr(exc))
+            self.logger.exception("serve: dispatch failed for %r", name)
+            return len(reqs)
+        exec_s = self._clock.now() - t0
+        engine.note_exec(bucket, exec_s)
+
+        now = self._clock.now()
+        off = 0
+        misses = 0
+        lat_hist = _telemetry.histogram("serve.request.latency.seconds",
+                                        model=name)
+        for r in reqs:
+            r.handle._complete(outputs=slice_rows(outs, off, r.rows),
+                               bucket=bucket, now=now)
+            off += r.rows
+            lat_hist.observe(now - r.arrival)
+            if now > r.deadline:
+                misses += 1
+
+        _telemetry.histogram("serve.batch.exec.seconds",
+                             model=name).observe(exec_s)
+        _telemetry.counter("serve.responses", model=name).inc(len(reqs))
+        _telemetry.counter("serve.dispatches", model=name).inc()
+        rows_c = _telemetry.counter("serve.rows", model=name).inc(rows)
+        pad_c = _telemetry.counter("serve.padded_rows",
+                                   model=name).inc(bucket)
+        if misses:
+            _telemetry.counter("serve.deadline.miss",
+                               model=name).inc(misses)
+        _telemetry.gauge("serve.batch.occupancy",
+                         model=name).set(rows / bucket)
+        _telemetry.gauge("serve.padding.waste", model=name).set(
+            1.0 - rows_c.value / pad_c.value if pad_c.value else 0.0)
+        _telemetry.gauge("serve.queue.depth", model=name).set(depth)
+        _telemetry.gauge("serve.queue.depth").set(self._depth_total())
+        compiles = engine.compiles_since_warmup()
+        if self._warm_mark is not None:
+            _telemetry.gauge(
+                "serve.program_cache.compiles_since_warmup").set(
+                _progcache.compile_count() - self._warm_mark)
+        _telemetry.flightrec.note(
+            "serve.dispatch", model=name, bucket=bucket, rows=rows,
+            n_requests=len(reqs), occupancy=round(rows / bucket, 3),
+            wait_us=int(wait_s * 1e6), exec_us=int(exec_s * 1e6),
+            deadline_misses=misses, compiles_since_warmup=compiles)
+        return len(reqs)
+
+    # ----------------------------------------------------------- drive modes
+    def pump(self, max_dispatches=None):
+        """Deterministic drive: dispatch every model that is ready at
+        the scheduler clock's *now*, without waiting. Returns the number
+        of dispatches performed. The explicit alternative to ``start()``
+        for FakeClock tests — no thread, no sleeps."""
+        done = 0
+        while max_dispatches is None or done < max_dispatches:
+            with self._lock:
+                action, arg = self._registry.next_action(self._clock.now())
+            if action != "dispatch":
+                break
+            self._dispatch(arg)
+            done += 1
+        return done
+
+    def _loop(self):
+        while True:
+            with self._cond:
+                if not self._running:
+                    return
+                action, arg = self._registry.next_action(self._clock.now())
+                if action == "wait":
+                    # bounded by the earliest flush_at; an admission
+                    # notify re-evaluates sooner. The condvar waits real
+                    # time — production pairs the thread with the real
+                    # clock (FakeClock users drive pump() directly).
+                    self._cond.wait(timeout=arg)
+                    continue
+            self._dispatch(arg)
+
+    def start(self):
+        """Spawn the dispatch thread (idempotent)."""
+        with self._cond:
+            if self._running:
+                return self
+            self._running = True
+        self._thread = threading.Thread(target=self._loop,
+                                        name="mxnet-serve-dispatch",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self, drain=True):
+        """Stop the dispatch thread; ``drain`` serves remaining queued
+        requests before returning, else they fail with MXNetError."""
+        with self._cond:
+            self._running = False
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=30)
+            self._thread = None
+        if drain:
+            while any(len(e.queue) for e in self._registry.entries()):
+                for e in self._registry.entries():
+                    if len(e.queue):
+                        self._dispatch(e.engine.name)
+        else:
+            now = self._clock.now()
+            for e in self._registry.entries():
+                e.queue.fail_all(MXNetError("server stopped"), now=now)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    # ---------------------------------------------------------------- stats
+    def stats(self):
+        """Snapshot for dashboards/bench: per-model p50/p99 latency,
+        occupancy, padding waste, queue depth, counters, exec
+        estimates; plus the process compile delta since warmup."""
+        models = {}
+        for e in self._registry.entries():
+            name = e.engine.name
+
+            def c(metric):
+                m = _telemetry.get_metric(metric, model=name)
+                return m.value if m is not None else 0
+
+            h = _telemetry.get_metric("serve.request.latency.seconds",
+                                      model=name)
+            rows_v, pad_v = c("serve.rows"), c("serve.padded_rows")
+            models[name] = {
+                "requests": c("serve.requests"),
+                "responses": c("serve.responses"),
+                "dispatches": c("serve.dispatches"),
+                "rejected": c("serve.rejected"),
+                "errors": c("serve.errors"),
+                "deadline_misses": c("serve.deadline.miss"),
+                "queue_depth": len(e.queue),
+                "latency_ms": None if h is None or not h.count else {
+                    "p50": round((h.quantile(0.50) or 0) * 1e3, 3),
+                    "p99": round((h.quantile(0.99) or 0) * 1e3, 3),
+                    "mean": round(h.mean * 1e3, 3),
+                    "max": round((h.max or 0) * 1e3, 3)},
+                "batch_occupancy": round(rows_v / pad_v, 4)
+                if pad_v else None,
+                "padding_waste_pct": round(100 * (1 - rows_v / pad_v), 2)
+                if pad_v else None,
+                "ladder": e.engine.ladder.sizes,
+                "exec_est_ms": {b: round(s * 1e3, 3) for b, s in
+                                sorted(e.engine.exec_est.items())},
+                "programs_resident": e.engine.programs_resident(),
+            }
+        compiles = None
+        if self._warm_mark is not None:
+            compiles = _progcache.compile_count() - self._warm_mark
+        return {"models": models, "compiles_since_warmup": compiles}
+
+
+def serve(model, name="default", ladder=None, start=True, clock=None,
+          max_queue=None, default_deadline_ms=None, **register_kw):
+    """One-call front end: ``serve(model).submit({...})``.
+
+    ``model``: a bound+initialized Module, a ``Predictor``, or a path
+    to a ``.mxp`` artifact. Builds a single-model ``InferenceServer``,
+    warms the ladder, and (by default) starts the dispatch thread; use
+    ``start=False`` + ``pump()`` with a FakeClock for deterministic
+    scheduling tests.
+    """
+    from ..predict import Predictor
+    server = InferenceServer(clock=clock, max_queue=max_queue,
+                             default_deadline_ms=default_deadline_ms)
+    if isinstance(model, (str, Predictor)):
+        server.register(name, predictor=model, ladder=ladder,
+                        **register_kw)
+    else:
+        server.register(name, model=model, ladder=ladder, **register_kw)
+    if start:
+        server.start()
+    return server

@@ -49,7 +49,12 @@ def lines(spans=True, events=True, metrics=True):
             elif isinstance(m, _metrics.Histogram):
                 yield {"type": "histogram", "name": m.name,
                        "labels": labels, "count": m.count, "sum": m.sum,
-                       "min": m.min, "max": m.max, "mean": m.mean}
+                       "min": m.min, "max": m.max, "mean": m.mean,
+                       # cumulative buckets ride along so offline
+                       # consumers (tools/diagnose.py serving section)
+                       # can estimate p50/p99 like the live registry
+                       "buckets": {str(le): c
+                                   for le, c in m.cumulative()}}
 
 
 def render(**kwargs):

@@ -123,6 +123,25 @@ class Histogram(_Metric):
         """[(le, cumulative count)] — the Prometheus _bucket series."""
         return list(zip(self.buckets, self.bucket_counts))
 
+    def quantile(self, q):
+        """Estimated q-quantile (0<=q<=1) by linear interpolation over
+        the cumulative buckets — the same estimate Prometheus'
+        ``histogram_quantile`` computes server-side; the serving p50/p99
+        SLO readouts use it. Observations above the last bucket bound
+        clamp to the recorded max. None while empty."""
+        if not self.count:
+            return None
+        rank = q * self.count
+        prev_le, prev_cum = 0.0, 0
+        for le, cum in self.cumulative():
+            if cum >= rank:
+                if cum == prev_cum:
+                    return le
+                frac = (rank - prev_cum) / (cum - prev_cum)
+                return prev_le + frac * (le - prev_le)
+            prev_le, prev_cum = le, cum
+        return self.max
+
 
 def _get(cls, name, labels, **ctor):
     key = (name, tuple(sorted(labels.items())))

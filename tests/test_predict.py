@@ -121,3 +121,71 @@ def test_export_resnet50(tmp_path):
     expect = mod.predict(it).asnumpy()
     got = mx.Predictor(path).forward(data=x)[0].asnumpy()
     np.testing.assert_allclose(got, expect, rtol=1e-4, atol=1e-5)
+
+
+def test_manifest_records_input_dtypes_int_roundtrip(tmp_path):
+    """Satellite (ISSUE 8): the manifest records each input's dtype and
+    ``Predictor.forward`` respects it instead of hard-coding float32 —
+    an int32 embedding-id input must round-trip through the artifact."""
+    ids_sym = mx.sym.var("data")
+    emb = mx.sym.Embedding(ids_sym, input_dim=10, output_dim=4,
+                           name="embed")
+    weight = np.random.RandomState(0).rand(10, 4).astype(np.float32)
+    path = str(tmp_path / "embed.mxp")
+    mx.export_model(path, emb, {"embed_weight": weight}, {},
+                    {"data": (3, 5)}, data_dtypes={"data": np.int32})
+
+    pred = mx.Predictor(path)
+    assert pred.input_dtypes == {"data": np.dtype(np.int32)}
+    ids = np.random.RandomState(1).randint(0, 10, (3, 5))
+    out = pred.forward(data=ids)[0].asnumpy()
+    np.testing.assert_allclose(out, weight[ids], rtol=1e-6)
+    # a float array of ids still works (cast to the recorded dtype)
+    out2 = pred.forward(data=ids.astype(np.float64))[0].asnumpy()
+    np.testing.assert_allclose(out2, out)
+
+
+def test_manifest_bf16_input_dtype(tmp_path):
+    """bf16-exported inputs: the program's avals are bf16, so the old
+    float32 coercion would be rejected at call time; the recorded-dtype
+    cast must make float32 host arrays servable."""
+    import jax.numpy as jnp
+    net, mod = _trained_module()
+    arg_params, aux_params = mod.get_params()
+    path = str(tmp_path / "lenet_bf16.mxp")
+    mx.export_model(path, net, arg_params, aux_params,
+                    {"data": (8, 1, 28, 28)},
+                    data_dtypes={"data": jnp.bfloat16})
+    pred = mx.Predictor(path)
+    assert pred.input_dtypes["data"] == np.dtype(jnp.bfloat16)
+
+    x = np.random.rand(8, 1, 28, 28).astype(np.float32)
+    got = pred.forward(data=x)[0].asnumpy()
+    it = mx.io.NDArrayIter(x, None, 8)
+    expect = mod.predict(it).asnumpy()
+    # bf16 input quantization: close, not bitwise
+    np.testing.assert_allclose(got, expect, rtol=0.1, atol=0.05)
+
+
+def test_predictor_batch_forward_dynamic_rows(tmp_path):
+    """Satellite (ISSUE 8): ``batch_forward`` takes a dynamic leading
+    batch dim, windows it through the fixed exported batch with the
+    serving pad/slice helpers, and matches Module.predict."""
+    net, mod = _trained_module(batch=4)
+    arg_params, aux_params = mod.get_params()
+    path = str(tmp_path / "lenet_b4.mxp")
+    mx.export_model(path, net, arg_params, aux_params,
+                    {"data": (4, 1, 28, 28)})
+    pred = mx.Predictor(path)
+
+    x = np.random.rand(10, 1, 28, 28).astype(np.float32)
+    got = pred.batch_forward(data=x)[0].asnumpy()
+    assert got.shape[0] == 10
+    expect = mod.predict(mx.io.NDArrayIter(x, None, 4)).asnumpy()
+    np.testing.assert_allclose(got, expect, rtol=1e-5, atol=1e-6)
+    # full-window rows are the exported program's output verbatim
+    direct = pred.forward(data=x[:4])[0].asnumpy()
+    assert np.array_equal(got[:4], direct)
+    # fewer rows than the exported batch also work (one padded window)
+    small = pred.batch_forward(data=x[:2])[0].asnumpy()
+    np.testing.assert_allclose(small, expect[:2], rtol=1e-5, atol=1e-6)

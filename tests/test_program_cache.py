@@ -266,3 +266,113 @@ def test_lru_eviction_and_gauge():
         del os.environ["MXNET_PROGRAM_CACHE_SIZE"]
     gauges = mx.telemetry.snapshot()["gauges"]
     assert gauges.get("executor.jit_cache.programs_live") == 3
+
+
+def test_pin_exempts_from_eviction_and_compile_count():
+    """Serving warmup APIs (ISSUE 8): pinned entries survive LRU
+    pressure; compile_count() counts fresh insertions monotonically."""
+    mx.program_cache.clear()
+    c0 = mx.program_cache.compile_count()
+    for i in range(4):
+        mx.program_cache.put(("p", i), object())
+    assert mx.program_cache.compile_count() == c0 + 4
+    mx.program_cache.put(("p", 0), object())       # overwrite: no compile
+    assert mx.program_cache.compile_count() == c0 + 4
+    assert mx.program_cache.pin(("p", 0))
+    assert not mx.program_cache.pin(("ghost",))    # absent: not pinned
+    assert mx.program_cache.contains(("p", 0))
+    assert ("p", 0) in mx.program_cache.pinned()
+
+    import os
+    os.environ["MXNET_PROGRAM_CACHE_SIZE"] = "2"
+    try:
+        mx.program_cache.put(("p", 9), object())
+        # ("p", 0) is the LRU entry but pinned -> survives; unpinned
+        # oldest entries went instead
+        assert mx.program_cache.contains(("p", 0))
+        assert mx.program_cache.size() == 2
+        # fully-pinned cache overflows rather than break a pin
+        mx.program_cache.pin(("p", 9))
+        mx.program_cache.put(("p", 10), object())
+        mx.program_cache.pin(("p", 10))
+        mx.program_cache.put(("p", 11), object())
+        assert mx.program_cache.contains(("p", 0))
+        assert mx.program_cache.contains(("p", 9))
+        assert mx.program_cache.contains(("p", 10))
+    finally:
+        del os.environ["MXNET_PROGRAM_CACHE_SIZE"]
+    mx.program_cache.unpin(("p", 0))
+    assert ("p", 0) not in mx.program_cache.pinned()
+    mx.program_cache.clear()
+    assert not mx.program_cache.pinned()
+
+
+def test_bucketing_module_inference_cache_contract():
+    """ISSUE 8 satellite: BucketingModule in inference mode
+    (for_training=False) over the process-wide program cache — the
+    second bucket cycle runs entirely from cache (zero new compiles),
+    the contract the serving bucket ladder depends on."""
+    mx.program_cache.clear()
+    mx.telemetry.reset()
+    mx.telemetry.enable()
+    try:
+        rs = np.random.RandomState(0)
+        sym = _mlp()
+        buckets = [2, 4, 8]
+        bm = mx.mod.BucketingModule(
+            sym_gen=lambda key: (sym, ["data"], ["softmax_label"]),
+            default_bucket_key=max(buckets), context=mx.cpu())
+        bm.bind([("data", (8, 6))], [("softmax_label", (8,))],
+                for_training=False)
+        bm.init_params(mx.initializer.Xavier())
+        # warm_buckets binds every rung up front (serving warmup path)
+        bm.warm_buckets([(b, [("data", (b, 6))],
+                          [("softmax_label", (b,))]) for b in buckets])
+        assert sorted(bm.bucket_keys) == buckets
+
+        def cycle():
+            outs = {}
+            for b in buckets:
+                batch = mx.io.DataBatch(
+                    [mx.nd.array(np.ones((b, 6), np.float32))],
+                    [mx.nd.array(np.zeros((b,), np.float32))],
+                    bucket_key=b,
+                    provide_data=[("data", (b, 6))],
+                    provide_label=[("softmax_label", (b,))])
+                bm.forward(batch, is_train=False)
+                outs[b] = bm.get_outputs()[0].asnumpy()
+            return outs
+
+        first = cycle()
+        compiles_mark = mx.program_cache.compile_count()
+        _, miss_mark = _counters()
+        second = cycle()
+        assert mx.program_cache.compile_count() == compiles_mark, \
+            "second bucket cycle must not insert new programs"
+        _, miss2 = _counters()
+        assert miss2 == miss_mark, \
+            "second bucket cycle must be all cache hits"
+        for b in buckets:
+            np.testing.assert_array_equal(first[b], second[b])
+
+        # a FRESH BucketingModule over the same symbol/shapes also runs
+        # compile-free (the cache is process-wide, not per instance)
+        bm2 = mx.mod.BucketingModule(
+            sym_gen=lambda key: (sym, ["data"], ["softmax_label"]),
+            default_bucket_key=max(buckets), context=mx.cpu())
+        bm2.bind([("data", (8, 6))], [("softmax_label", (8,))],
+                 for_training=False)
+        bm2.init_params(mx.initializer.Xavier())
+        bm2.warm_buckets([(b, [("data", (b, 6))],
+                           [("softmax_label", (b,))]) for b in buckets])
+        cycle_mark = mx.program_cache.compile_count()
+        for b in buckets:
+            batch = mx.io.DataBatch(
+                [mx.nd.array(np.ones((b, 6), np.float32))], None,
+                bucket_key=b, provide_data=[("data", (b, 6))],
+                provide_label=[("softmax_label", (b,))])
+            bm2.forward(batch, is_train=False)
+            bm2.get_outputs()[0].asnumpy()
+        assert mx.program_cache.compile_count() == cycle_mark
+    finally:
+        mx.telemetry.disable()

@@ -640,3 +640,22 @@ def test_sentinel_module_fused_path_raise(tmp_path):
     finally:
         flightrec.configure(dump_dir=os.environ.get("MXNET_CRASH_DIR",
                                                     "."))
+
+
+def test_histogram_quantile_estimation():
+    """Histogram.quantile: bucket-interpolated percentile estimates
+    (the serving p50/p99 SLO readout) — exact at bucket bounds, clamped
+    to the recorded max above the last bound, None while empty."""
+    from mxnet_tpu.telemetry.metrics import Histogram
+    h = Histogram("t.q", (), buckets=(0.01, 0.1, 1.0))
+    assert h.quantile(0.5) is None
+    for v in (0.005, 0.005, 0.05, 0.05, 0.5, 0.5, 2.0, 3.0):
+        h.observe(v)
+    # 8 observations: ranks 1-2 in <=0.01, 3-4 in <=0.1, 5-6 in <=1.0,
+    # 7-8 above the last bound
+    assert h.quantile(0.25) == pytest.approx(0.01)
+    assert h.quantile(0.5) == pytest.approx(0.1)
+    assert h.quantile(1.0) == pytest.approx(3.0)    # clamps to max
+    q99 = h.quantile(0.99)
+    assert q99 == pytest.approx(3.0)                # beyond last bucket
+    assert 0.01 <= h.quantile(0.4) <= 0.1           # interpolated

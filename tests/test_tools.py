@@ -304,3 +304,47 @@ def test_bi_lstm_sort_learns():
             optimizer="adam", optimizer_params={"learning_rate": 0.01})
     acc = mod.score(val, "acc")[0][1]
     assert acc > 0.8, f"bi-lstm sort failed to learn: {acc}"
+
+
+def test_diagnose_serving_section_from_live_jsonl(tmp_path):
+    """ISSUE 8 satellite: a real serving session's jsonl log renders a
+    'serving' section — p50/p99 from the exported latency-histogram
+    buckets, occupancy/padding-waste from the counters, the queue-depth
+    gauge, and the compiles-since-warmup steady-state flag."""
+    import mxnet_tpu as mx
+    from mxnet_tpu.serve import FakeClock
+
+    mx.telemetry.reset()
+    mx.telemetry.enable()
+    try:
+        data = mx.sym.var("data")
+        fc = mx.sym.FullyConnected(data=data, num_hidden=4, name="dg1")
+        sym = mx.sym.SoftmaxOutput(fc, name="softmax")
+        mod = mx.mod.Module(sym, context=mx.cpu())
+        mod.bind([("data", (4, 6))], [("softmax_label", (4,))],
+                 for_training=False)
+        mod.init_params(mx.initializer.Xavier())
+        clock = FakeClock()
+        server = mx.serve.serve(mod, ladder=[2, 4], start=False,
+                                clock=clock, default_deadline_ms=20)
+        for _ in range(3):
+            server.submit({"data": np.zeros((1, 6), np.float32)})
+        clock.advance(0.020)
+        assert server.pump() == 1
+        log = tmp_path / "serve.jsonl"
+        mx.telemetry.jsonl.dump(str(log))
+    finally:
+        mx.telemetry.disable()
+
+    cli = os.path.join(TOOLS, "diagnose.py")
+    r = subprocess.run([sys.executable, cli, str(log)],
+                       capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    out = r.stdout
+    assert "serving:" in out
+    assert "model default:" in out
+    assert "p99" in out and "reqs" in out
+    assert "75% occupancy" in out and "25.0% padding waste" in out
+    assert "queue depth 0" in out
+    assert "compiles since warmup: 0" in out
+    assert "WARNING: serving is compiling" not in out

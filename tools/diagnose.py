@@ -196,6 +196,110 @@ def _gauge_triples_from_series(gauges_by_series):
     return out
 
 
+def _hist_entries_from_series(hists_by_series):
+    """{'name{k="v"}': rec} -> [(name, labels_dict, rec)]."""
+    out = []
+    for series, rec in (hists_by_series or {}).items():
+        name, labelstr = _strip_labels(series)
+        labels = {}
+        for part in labelstr.split(","):
+            if "=" in part:
+                k, _, v = part.partition("=")
+                labels[k.strip()] = v.strip().strip('"')
+        out.append((name, labels, rec))
+    return out
+
+
+def _hist_quantile(rec, q):
+    """Estimated q-quantile from a histogram record's cumulative
+    ``buckets`` (the metrics.Histogram.quantile math, replayed offline
+    over a jsonl/crash snapshot). None without bucket data."""
+    count = rec.get("count") or 0
+    buckets = rec.get("buckets") or {}
+    if not count or not buckets:
+        return None
+    rank = q * count
+    prev_le, prev_cum = 0.0, 0
+    for le_s, cum in sorted(buckets.items(), key=lambda kv: float(kv[0])):
+        le = float(le_s)
+        if cum >= rank:
+            if cum == prev_cum:
+                return le
+            return prev_le + (rank - prev_cum) / (cum - prev_cum) * \
+                (le - prev_le)
+        prev_le, prev_cum = le, cum
+    return rec.get("max")
+
+
+def _serving_section(counters, gauge_triples, hist_entries):
+    """Serving health (mxnet_tpu/serve): per-model p50/p99 latency,
+    queue depth, batch occupancy, padding waste, deadline misses —
+    rendered only when serve.* series exist in the log."""
+    lat = {}                        # model -> latency histogram record
+    for name, labels, rec in hist_entries:
+        if name == "serve.request.latency.seconds":
+            lat[labels.get("model", "?")] = rec
+    gauges = {}
+    for name, labels, val in gauge_triples:
+        if name.startswith("serve."):
+            gauges[(name, labels.get("model"))] = val
+    ctr = {}
+    for series, val in (counters or {}).items():
+        name, labelstr = _strip_labels(series)
+        if not name.startswith("serve."):
+            continue
+        model = None
+        for part in labelstr.split(","):
+            if part.strip().startswith("model="):
+                model = part.partition("=")[2].strip().strip('"')
+        ctr[(name, model)] = ctr.get((name, model), 0) + val
+    if not (lat or gauges or ctr):
+        return []
+
+    models = sorted({m for (_, m) in list(ctr) + list(gauges)
+                     if m is not None} | set(lat))
+    lines = ["serving:"]
+    for m in models:
+        rec = lat.get(m)
+        if rec and rec.get("count"):
+            p50 = _hist_quantile(rec, 0.50)
+            p99 = _hist_quantile(rec, 0.99)
+            ltxt = (f"p50 {_fmt_us((p50 or 0) * 1e6)} / "
+                    f"p99 {_fmt_us((p99 or 0) * 1e6)}"
+                    if p50 is not None else
+                    f"mean {_fmt_us((rec.get('mean') or 0) * 1e6)}")
+            ltxt += f" over {rec['count']} reqs"
+        else:
+            ltxt = "no latency data"
+        rows = ctr.get(("serve.rows", m), 0)
+        padded = ctr.get(("serve.padded_rows", m), 0)
+        occ = f"{rows / padded:.0%} occupancy, " \
+              f"{100 * (1 - rows / padded):.1f}% padding waste" \
+            if padded else "no dispatches"
+        depth = gauges.get(("serve.queue.depth", m))
+        extras = []
+        if depth is not None:
+            extras.append(f"queue depth {depth:.0f}")
+        misses = ctr.get(("serve.deadline.miss", m), 0)
+        if misses:
+            extras.append(f"{misses:.0f} DEADLINE MISSES")
+        rejected = ctr.get(("serve.rejected", m), 0)
+        if rejected:
+            extras.append(f"{rejected:.0f} rejected")
+        errors = ctr.get(("serve.errors", m), 0)
+        if errors:
+            extras.append(f"{errors:.0f} dispatch ERRORS")
+        lines.append(f"  model {m}: {ltxt}; {occ}"
+                     + ("; " + ", ".join(extras) if extras else ""))
+    compiles = gauges.get(("serve.program_cache.compiles_since_warmup",
+                           None))
+    if compiles is not None:
+        flag = "" if not compiles else \
+            "  WARNING: serving is compiling in steady state"
+        lines.append(f"  compiles since warmup: {compiles:.0f}{flag}")
+    return lines
+
+
 def _anomaly_section(anoms):
     if not anoms:
         return ["anomalies: none recorded"]
@@ -258,6 +362,10 @@ def render_crash(report, top=10):
     out += _roofline_section(
         _gauge_triples_from_series(metrics.get("gauges") or {}),
         [r for r in ring if r.get("kind") == "span"], top=top)
+    out += _serving_section(
+        metrics.get("counters") or {},
+        _gauge_triples_from_series(metrics.get("gauges") or {}),
+        _hist_entries_from_series(metrics.get("histograms") or {}))
 
     # throughput from ring batch records
     batches = [r for r in ring if r.get("kind") == "module.fit.batch"
@@ -293,6 +401,7 @@ def render_crash(report, top=10):
 def render_jsonl(lines, top=10):
     """Telemetry jsonl lines -> health-report text."""
     events, spans, counters, gauges, hists = [], [], {}, {}, {}
+    hist_entries = []               # (name, labels, rec) — labels kept
     for line in lines:
         line = line.strip()
         if not line:
@@ -320,6 +429,8 @@ def render_jsonl(lines, top=10):
                 rec.get("value")
         elif t == "histogram":
             hists[rec.get("name", "?")] = rec
+            hist_entries.append((rec.get("name", "?"),
+                                 rec.get("labels") or {}, rec))
 
     out = ["=" * 64, "TELEMETRY HEALTH REPORT", "=" * 64]
 
@@ -369,6 +480,11 @@ def render_jsonl(lines, top=10):
         [(name, dict(labels), val)
          for (name, labels), val in gauges.items()],
         spans, top=top)
+    out += _serving_section(
+        counters,
+        [(name, dict(labels), val)
+         for (name, labels), val in gauges.items()],
+        hist_entries)
     out += _slowest_spans(spans, top)
 
     h = hists.get("module.fit.batch.seconds")
