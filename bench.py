@@ -302,6 +302,19 @@ def measure_serve_variant():
         return {"error": f"{type(e).__name__}: {e}"}
 
 
+def measure_ckpt_variant():
+    """The ``ckpt`` variant row: exposed training stall per snapshot,
+    async vs synchronous write, at the resnet20 bench point
+    (benchmarks/checkpoint_stall.py). The acceptance gate of the
+    async-checkpointing layer is exposed_ratio < 0.10. Runs on
+    whatever backend the process has; never sinks the run."""
+    try:
+        from benchmarks.checkpoint_stall import main as ckpt_lap
+        return ckpt_lap(quiet=True)
+    except Exception as e:          # the variant must never sink the run
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
 def run_cpu_fallback():
     """Reduced ours-only measurement on the CPU backend.
 
@@ -381,6 +394,7 @@ def run_cpu_fallback():
         "roofline": roofline_rows,
         "spmd": measure_spmd_variant(),
         "serve": measure_serve_variant(),
+        "ckpt": measure_ckpt_variant(),
         "note": "accelerator backend unavailable; ours-only fused-step "
                 "throughput on the XLA CPU backend at a CIFAR-scale "
                 "operating point — NOT comparable to the flax-paired "
@@ -591,6 +605,10 @@ def main():
     _log("serve variant (Poisson open-loop vs p99 SLO)")
     serve_variant = measure_serve_variant()
 
+    # ckpt variant: async-vs-sync exposed snapshot stall (ROADMAP 5)
+    _log("ckpt variant (checkpoint_stall paired lap)")
+    ckpt_variant = measure_ckpt_variant()
+
     # per-op MFU attribution + roofline from the registry cost metadata
     # (telemetry/mfu.py): coverage is attributed FLOPs over the XLA
     # compiled-program count — the honesty check on the per-op numbers
@@ -658,6 +676,7 @@ def main():
         "pallas_smoke": pallas_smoke,
         "spmd": spmd_variant,
         "serve": serve_variant,
+        "ckpt": ckpt_variant,
         "mfu_ours": mfu(ours_img_s, ours_flops),
         "mfu_flax": mfu(flax_img_s, flax_flops),
         "mfu_model_attributed": mfu(ours_img_s, attributed_flops),

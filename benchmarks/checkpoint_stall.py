@@ -1,0 +1,136 @@
+"""Exposed training stall per checkpoint: async vs synchronous write.
+
+The acceptance gate of the async-checkpointing tentpole (ISSUE 9): the
+stall a snapshot imposes on the training thread under the async manager
+must be < 10% of what the synchronous write costs, at the resnet20
+bench point (the same model/batch bench.py's cpu-fallback measures).
+
+Protocol — paired lap on whatever backend the process has:
+
+  * one warmup fit (compiles the fused program; both configurations
+    reuse it through the process-wide program cache);
+  * SYNC lap: ``CheckpointManager(async_write=False)`` saving every
+    batch — the training thread pays capture + device→host + pickle +
+    fsync + commit inline; ``ckpt.exposed_stall.seconds`` records it;
+  * ASYNC lap: ``async_write=True``, same cadence — the training
+    thread pays only the capture dispatch (+ any queue back-pressure);
+    the writer thread's cost lands in ``ckpt.snapshot.seconds``.
+
+Writes ``benchmarks/results/checkpoint_stall.json``; ``main(quiet=
+True)`` returns the dict for bench.py's ``ckpt`` row.
+
+Run: JAX_PLATFORMS=cpu python benchmarks/checkpoint_stall.py
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import shutil
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+BATCH = 32
+N_BATCHES = 6
+CLASSES = 10
+
+
+def _fit_once(mx, sym, imgs, labels, mgr=None):
+    it = mx.io.NDArrayIter(imgs, labels, batch_size=BATCH)
+    mod = mx.mod.Module(sym, context=mx.cpu())
+    mod.fit(it, num_epoch=1, initializer=mx.initializer.Xavier(),
+            optimizer_params={"learning_rate": 0.05, "momentum": 0.9},
+            checkpoint=mgr)
+    return mod
+
+
+def _hist(snap, name):
+    rec = snap["histograms"].get(name) or {}
+    return rec.get("mean"), rec.get("count", 0)
+
+
+def main(quiet=False):
+    import mxnet_tpu as mx
+    from mxnet_tpu.models import resnet
+
+    rng = np.random.RandomState(0)
+    imgs = rng.rand(N_BATCHES * BATCH, 3, 32, 32).astype(np.float32)
+    labels = (rng.rand(N_BATCHES * BATCH) * CLASSES).astype(np.float32)
+    sym = resnet.get_symbol(num_classes=CLASSES, num_layers=20,
+                            image_shape="3,32,32")
+
+    def log(msg):
+        if not quiet:
+            print(f"[checkpoint_stall] {msg}", file=sys.stderr,
+                  flush=True)
+
+    log("warmup (compile)")
+    _fit_once(mx, sym, imgs, labels)
+
+    root = tempfile.mkdtemp(prefix="ckpt_stall_")
+    try:
+        mx.telemetry.enable()
+        results = {}
+        for mode, async_write in (("sync", False), ("async", True)):
+            mx.telemetry.reset()
+            log(f"{mode} lap: snapshot every batch")
+            mgr = mx.checkpoint.CheckpointManager(
+                os.path.join(root, mode), keep_last=2,
+                async_write=async_write, every_n_batches=1)
+            try:
+                _fit_once(mx, sym, imgs, labels, mgr=mgr)
+                mgr.wait()
+            finally:
+                mgr.close()
+            snap = mx.telemetry.snapshot()
+            exposed_mean, n = _hist(snap, "ckpt.exposed_stall.seconds")
+            write_mean, _ = _hist(snap, "ckpt.snapshot.seconds")
+            results[mode] = {"exposed_stall_s_mean": exposed_mean,
+                             "write_s_mean": write_mean,
+                             "n_snapshots": n}
+        mx.telemetry.disable()
+        mx.telemetry.reset()
+
+        # committed checkpoint size (all ranks replicate params, so one
+        # directory is representative)
+        latest = mx.checkpoint.latest_checkpoint(
+            os.path.join(root, "async"))
+        nbytes = 0
+        if latest:
+            for f in os.listdir(latest[1]):
+                nbytes += os.path.getsize(os.path.join(latest[1], f))
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+    sync_exposed = results["sync"]["exposed_stall_s_mean"] or 0.0
+    async_exposed = results["async"]["exposed_stall_s_mean"] or 0.0
+    ratio = (async_exposed / sync_exposed) if sync_exposed else None
+    out = {
+        "model": "resnet20_cifar_b32",
+        "n_snapshots_per_lap": results["async"]["n_snapshots"],
+        "checkpoint_bytes": nbytes,
+        "sync_exposed_stall_s_mean": sync_exposed,
+        "async_exposed_stall_s_mean": async_exposed,
+        "async_write_s_mean": results["async"]["write_s_mean"],
+        "exposed_ratio": round(ratio, 4) if ratio is not None else None,
+        "gate": "async exposed stall < 10% of the synchronous write",
+        "gate_pass": bool(ratio is not None and ratio < 0.10),
+    }
+    if not quiet:
+        print(json.dumps(out, indent=2))
+        res_dir = os.path.join(os.path.dirname(
+            os.path.abspath(__file__)), "results")
+        os.makedirs(res_dir, exist_ok=True)
+        path = os.path.join(res_dir, "checkpoint_stall.json")
+        with open(path, "w") as f:
+            json.dump(out, f, indent=2)
+        print(f"wrote {path}", file=sys.stderr)
+    return out
+
+
+if __name__ == "__main__":
+    main()

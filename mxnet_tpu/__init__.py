@@ -41,6 +41,7 @@ from . import model
 from .model import FeedForward
 from . import module
 from . import module as mod
+from . import checkpoint  # async checkpointing + elastic recovery
 from . import rnn
 from . import visualization
 from . import visualization as viz

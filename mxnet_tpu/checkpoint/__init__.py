@@ -1,0 +1,37 @@
+"""Checkpointing & elastic recovery (ROADMAP item 5).
+
+Three layers compose the fault-tolerance story:
+
+* ``checkpoint.state`` — full-training-state capture/restore (params,
+  layout-independent optimizer state + update counts, rng chain, data
+  cursor) with a fast device-side capture phase and a slow host phase
+  for the writer thread;
+* ``checkpoint.manager`` — :class:`CheckpointManager`: async
+  snapshotting off the training thread, versioned atomically-committed
+  checkpoint directories, ``keep_last`` retention, the ``MXNET_CKPT_*``
+  env surface. ``Module.fit(checkpoint=..., resume=...)`` drives it;
+* ``checkpoint.recovery`` — :class:`DeadWorkerError` +
+  :func:`survivor_env`/:func:`reexec_survivor`: when the dist heartbeat
+  layer reports a dead peer, survivors save, raise instead of hanging,
+  and re-form the job over the remaining workers to resume from the
+  last committed checkpoint (tests/chaos_worker.py end-to-end).
+
+See docs/checkpoint.md for the on-disk format, the atomic-commit
+protocol, resume semantics (window boundaries under
+``steps_per_dispatch``), and the recovery flow.
+"""
+from . import state
+from . import manager
+from . import recovery
+from .state import capture, restore, to_host, FORMAT_VERSION
+from .manager import CheckpointManager, latest_checkpoint, restore_module
+from .recovery import (DeadWorkerError, recovery_generation, survivor_env,
+                       reexec_survivor)
+
+__all__ = [
+    "state", "manager", "recovery",
+    "capture", "restore", "to_host", "FORMAT_VERSION",
+    "CheckpointManager", "latest_checkpoint", "restore_module",
+    "DeadWorkerError", "recovery_generation", "survivor_env",
+    "reexec_survivor",
+]

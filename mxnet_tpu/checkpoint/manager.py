@@ -1,0 +1,312 @@
+"""CheckpointManager: async snapshots, atomic commits, retention.
+
+Design (the Orbax/torch-elastic shape, adapted to this framework's
+executor):
+
+* **Async by default** — ``save()`` captures device-side copies on the
+  training thread (cheap: async dispatches, see checkpoint/state.py)
+  and hands the snapshot to ONE background writer thread that does the
+  device→host transfer, serialization, fsync and commit. The training
+  step never waits for disk; the measured exposed stall is the capture
+  dispatch plus any back-pressure wait (the snapshot queue is bounded
+  at 2 so a slow disk can hold at most two full param copies in
+  flight). ``MXNET_CKPT_ASYNC=0`` (or ``async_write=False``) writes
+  inline — the A/B the checkpoint-stall benchmark measures.
+
+* **Atomic commit** — each checkpoint is a directory
+  ``ckpt-<seq>/{state.pkl, manifest.json}`` renamed into place from a
+  ``.tmp-`` staging dir after both files are fsynced; ``manifest.json``
+  is written last inside the staging dir, and the rename is the commit
+  point. A reader (``latest()``/``restore()``) only ever sees
+  directories that are complete; a crash mid-write leaves a ``.tmp-``
+  dir the next manager sweeps.
+
+* **Retention** — after every commit the oldest committed checkpoints
+  beyond ``keep_last`` are deleted.
+
+Telemetry: ``ckpt.exposed_stall.seconds`` (training-thread cost per
+save), ``ckpt.snapshot.seconds`` (background transfer+write+commit),
+counters ``ckpt.snapshots`` / ``ckpt.commits`` / ``ckpt.failures``,
+gauge ``ckpt.last_seq``, and flight-ring records
+``ckpt.snapshot`` / ``ckpt.commit`` / ``ckpt.fail`` / ``ckpt.restore``
+so crash dumps show the checkpoint cadence (tools/diagnose.py).
+
+Env surface (docs/env_var.md): ``MXNET_CKPT_DIR``,
+``MXNET_CKPT_KEEP_LAST``, ``MXNET_CKPT_ASYNC``, ``MXNET_CKPT_EVERY``,
+``MXNET_CKPT_ELASTIC``, ``MXNET_CKPT_DEAD_PATIENCE``.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import queue
+import re
+import shutil
+import threading
+import time
+
+from ..base import MXNetError
+from .. import telemetry as _telemetry
+from . import state as _state
+
+__all__ = ["CheckpointManager", "latest_checkpoint", "restore_module"]
+
+_CKPT_RE = re.compile(r"^ckpt-(\d{8})$")
+
+
+def _committed(directory):
+    """[(seq, path)] of complete checkpoints in ``directory``, oldest
+    first. A directory counts only when its manifest says complete —
+    the atomic-commit contract (rename-after-manifest) makes the
+    manifest's presence inside a ``ckpt-*`` name sufficient, but the
+    flag guards against foreign dirs that happen to match."""
+    out = []
+    try:
+        entries = os.listdir(directory)
+    except OSError:
+        return out
+    for name in entries:
+        m = _CKPT_RE.match(name)
+        if not m:
+            continue
+        path = os.path.join(directory, name)
+        manifest = os.path.join(path, "manifest.json")
+        try:
+            with open(manifest) as f:
+                if json.load(f).get("complete"):
+                    out.append((int(m.group(1)), path))
+        except (OSError, ValueError):
+            continue
+    out.sort()
+    return out
+
+
+def latest_checkpoint(directory):
+    """(seq, path) of the newest committed checkpoint, or None."""
+    committed = _committed(directory)
+    return committed[-1] if committed else None
+
+
+def restore_module(module, directory):
+    """Restore a bound module from the newest committed checkpoint in
+    ``directory``; returns the cursor dict or None when the directory
+    holds no committed checkpoint (a first run resuming over an empty
+    dir starts fresh)."""
+    latest = latest_checkpoint(directory)
+    if latest is None:
+        return None
+    seq, path = latest
+    with open(os.path.join(path, "state.pkl"), "rb") as f:
+        payload = _state.read_payload(f)
+    cursor = _state.restore(module, payload)
+    _telemetry.flightrec.note("ckpt.restore", seq=seq, **cursor)
+    logging.getLogger(__name__).info(
+        "resumed from checkpoint %s (epoch %d, batch %d)",
+        path, cursor["epoch"], cursor["nbatch"])
+    return cursor
+
+
+def _env_int(name, default):
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+class CheckpointManager:
+    """Versioned, atomically-committed training checkpoints.
+
+    Parameters (each defaulting from its ``MXNET_CKPT_*`` env var):
+
+    directory : str — checkpoint root (``MXNET_CKPT_DIR``; required
+        one way or the other).
+    keep_last : int — committed checkpoints retained
+        (``MXNET_CKPT_KEEP_LAST``, default 3).
+    async_write : bool — background writer on/off
+        (``MXNET_CKPT_ASYNC``, default on).
+    every_n_batches : int — ``Module.fit`` save cadence in retired
+        batches (``MXNET_CKPT_EVERY``; 0 = epoch-end saves only).
+    """
+
+    def __init__(self, directory=None, keep_last=None, async_write=None,
+                 every_n_batches=None, logger=None):
+        directory = directory or os.environ.get("MXNET_CKPT_DIR")
+        if not directory:
+            raise MXNetError("CheckpointManager needs a directory "
+                             "(argument or MXNET_CKPT_DIR)")
+        self.directory = directory
+        self.keep_last = _env_int("MXNET_CKPT_KEEP_LAST", 3) \
+            if keep_last is None else int(keep_last)
+        self.async_write = (os.environ.get("MXNET_CKPT_ASYNC", "1")
+                            not in ("0", "false", "no", "off")) \
+            if async_write is None else bool(async_write)
+        self.every_n_batches = _env_int("MXNET_CKPT_EVERY", 0) \
+            if every_n_batches is None else int(every_n_batches)
+        self.logger = logger or logging.getLogger(__name__)
+
+        os.makedirs(self.directory, exist_ok=True)
+        # sweep staging dirs a crashed writer left behind
+        for name in os.listdir(self.directory):
+            if name.startswith(".tmp-"):
+                shutil.rmtree(os.path.join(self.directory, name),
+                              ignore_errors=True)
+        committed = _committed(self.directory)
+        self._seq = committed[-1][0] + 1 if committed else 1
+
+        self._queue = queue.Queue(maxsize=2)
+        self._thread = None
+        self._error = None              # first writer failure, for wait()
+        self._ticks = 0                 # fit-loop cadence counter
+        self._closed = False
+
+    # ------------------------------------------------------------- saving
+    def tick(self, module, epoch, nbatch):
+        """Per-retired-batch cadence hook (called by ``Module.fit``);
+        ``nbatch`` is the NEXT batch index. Saves when
+        ``every_n_batches`` divides the tick count."""
+        self._ticks += 1
+        if self.every_n_batches and \
+                self._ticks % self.every_n_batches == 0:
+            self.save(module, epoch, nbatch)
+
+    def save(self, module, epoch=0, nbatch=0, block=False):
+        """Snapshot now; commit in the background (or inline when
+        ``async_write`` is off or ``block=True`` — block additionally
+        waits for every previously queued snapshot)."""
+        if self._closed:
+            raise MXNetError("CheckpointManager is closed")
+        t0 = time.perf_counter()
+        snap = _state.capture(module, epoch, nbatch)
+        seq = self._seq
+        self._seq += 1
+        if self.async_write:
+            self._ensure_writer()
+            self._queue.put((seq, snap))    # bounded: back-pressure
+        else:
+            self._write(seq, snap)
+        stall = time.perf_counter() - t0
+        _telemetry.counter("ckpt.snapshots").inc()
+        if _telemetry.enabled():
+            _telemetry.histogram("ckpt.exposed_stall.seconds").observe(
+                stall)
+        _telemetry.flightrec.note("ckpt.snapshot", seq=seq, epoch=epoch,
+                                  nbatch=nbatch,
+                                  exposed_us=int(stall * 1e6))
+        if block and self.async_write:
+            self.wait()
+        return seq
+
+    def _ensure_writer(self):
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._writer_loop, daemon=True,
+                name="mxnet-ckpt-writer")
+            self._thread.start()
+
+    def _writer_loop(self):
+        while True:
+            item = self._queue.get()
+            try:
+                if item is None:
+                    return
+                self._write(*item)
+            except Exception as exc:        # surface via wait(), not crash
+                if self._error is None:
+                    self._error = exc
+                _telemetry.counter("ckpt.failures").inc()
+                _telemetry.flightrec.note("ckpt.fail", seq=item[0],
+                                          error=f"{type(exc).__name__}: "
+                                                f"{exc}")
+                self.logger.warning("checkpoint %d failed: %s", item[0],
+                                    exc)
+            finally:
+                self._queue.task_done()
+
+    def _write(self, seq, snap):
+        t0 = time.perf_counter()
+        span = _telemetry.span("ckpt.snapshot",
+                               _hist="ckpt.snapshot.seconds", seq=seq) \
+            if _telemetry.enabled() else _telemetry.null_span
+        with span:
+            payload = _state.to_host(snap)
+            tmp = os.path.join(self.directory,
+                               f".tmp-ckpt-{seq:08d}-{os.getpid()}")
+            final = os.path.join(self.directory, f"ckpt-{seq:08d}")
+            os.makedirs(tmp, exist_ok=True)
+            state_path = os.path.join(tmp, "state.pkl")
+            with open(state_path, "wb") as f:
+                _state.write_payload(payload, f)
+                f.flush()
+                os.fsync(f.fileno())
+            manifest = {
+                "complete": True, "seq": seq,
+                "version": _state.FORMAT_VERSION,
+                "cursor": payload["cursor"],
+                "opt": {k: v for k, v in (payload.get("opt") or
+                                          {}).items() if k != "counts"},
+                "time": time.time(),
+                "n_params": len(payload["device"]["arg_params"]),
+            }
+            with open(os.path.join(tmp, "manifest.json"), "w") as f:
+                json.dump(manifest, f, indent=1)
+                f.flush()
+                os.fsync(f.fileno())
+            os.rename(tmp, final)           # the commit point
+            try:
+                dirfd = os.open(self.directory, os.O_RDONLY)
+                os.fsync(dirfd)
+                os.close(dirfd)
+            except OSError:
+                pass                        # platform without dir fsync
+        dur = time.perf_counter() - t0
+        _telemetry.counter("ckpt.commits").inc()
+        _telemetry.gauge("ckpt.last_seq").set(seq)
+        _telemetry.flightrec.note("ckpt.commit", seq=seq,
+                                  dur_us=int(dur * 1e6),
+                                  **payload["cursor"])
+        self._retain()
+
+    def _retain(self):
+        committed = _committed(self.directory)
+        for _seq, path in committed[:max(0, len(committed) -
+                                         self.keep_last)]:
+            shutil.rmtree(path, ignore_errors=True)
+
+    # ------------------------------------------------------------ reading
+    def list_committed(self):
+        return _committed(self.directory)
+
+    def latest(self):
+        return latest_checkpoint(self.directory)
+
+    def restore(self, module):
+        """Restore ``module`` from the newest committed checkpoint;
+        returns the cursor dict or None when the directory is empty."""
+        return restore_module(module, self.directory)
+
+    # ----------------------------------------------------------- lifecycle
+    def wait(self):
+        """Block until every queued snapshot is committed; raises the
+        first writer failure (once)."""
+        if self._thread is not None:
+            self._queue.join()
+        err, self._error = self._error, None
+        if err is not None:
+            raise err
+
+    def close(self):
+        """Drain pending writes and stop the writer. Idempotent."""
+        if self._closed:
+            return
+        self._closed = True
+        if self._thread is not None:
+            self._queue.put(None)
+            self._thread.join(timeout=120)
+            self._thread = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -1,0 +1,141 @@
+"""Elastic recovery: dead worker -> save -> re-form -> resume.
+
+The failure model (BENCH_r05, SURVEY §5.3): at pod scale a worker
+dying mid-epoch is routine. Pre-recovery behavior was a hang — the
+survivors' next collective waits forever for a peer that will never
+arrive (or, with gloo, dies with "Connection closed by peer" and takes
+the whole job down). The recovery story composed here:
+
+1. **Detect** — the dist kvstore's heartbeat layer
+   (``KVStore.on_dead_node``) flags the death, or the survivor's own
+   collective fails fast and ``Module.fit`` confirms against the
+   liveness layer. Either way fit saves what it safely can and raises
+   :class:`DeadWorkerError` (``clean=True`` when detected at a batch
+   boundary — state consistent, an emergency checkpoint was cut;
+   ``clean=False`` when a collective already failed mid-batch — resume
+   MUST come from the last *committed* checkpoint, since survivors may
+   have partially applied the broken batch).
+
+2. **Re-form** — the surviving processes re-exec themselves
+   (:func:`reexec_survivor`) with a deterministically remapped cluster:
+   survivors keep their relative order (new rank = index among
+   survivors), worker 0 of the new ordering hosts the coordination
+   service on a generation-bumped port. Re-exec rather than in-process
+   re-init is deliberate: the XLA distributed backend in a running
+   process is bound to the dead topology (device client, gloo
+   connections, coordination service), and tearing it down under a
+   half-failed collective is exactly the kind of "clean shutdown of a
+   broken thing" that hangs. A fresh process over the survivor env is
+   the torch-elastic/agent-restart shape, minus the agent.
+
+3. **Resume** — the re-exec'd survivors run the same training script;
+   ``Module.fit(resume=...)`` restores the last committed checkpoint
+   (params, optimizer state + counts, rng chain, cursor) and continues
+   from the cursor. tests/chaos_worker.py is the canonical composition.
+
+Everything here is pure env/process plumbing — deterministic given
+(dead set, prior env) on every survivor, with no cross-worker
+coordination needed beyond already agreeing on who died.
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+from ..base import MXNetError
+from .. import telemetry as _telemetry
+
+__all__ = ["DeadWorkerError", "recovery_generation", "survivor_env",
+           "reexec_survivor"]
+
+
+class DeadWorkerError(MXNetError):
+    """A training peer died mid-run; raised by ``Module.fit`` instead
+    of hanging in the next collective. ``dead_ranks`` names the dead
+    workers (input to :func:`survivor_env`); ``clean`` says whether the
+    module's state was consistent at detection (batch boundary) — when
+    False, resume only from the last committed checkpoint."""
+
+    def __init__(self, dead_ranks, clean=True):
+        self.dead_ranks = sorted(int(r) for r in dead_ranks)
+        self.clean = bool(clean)
+        state = "at a batch boundary (state consistent)" if clean \
+            else "mid-batch (resume from the last committed checkpoint)"
+        super().__init__(
+            f"dist worker(s) {self.dead_ranks} died; detected {state}")
+
+
+def recovery_generation(env=None):
+    """How many re-forms this process lineage has been through (0 on a
+    first launch; bumped by :func:`survivor_env` on every re-exec)."""
+    env = os.environ if env is None else env
+    try:
+        return int(env.get("MXNET_RECOVERY_GENERATION", "0") or 0)
+    except ValueError:
+        return 0
+
+
+def survivor_env(dead_ranks, env=None):
+    """The re-formed cluster's env for THIS surviving process.
+
+    Deterministic on every survivor from (dead set, prior env) alone:
+
+    * ``DMLC_NUM_WORKER`` — the survivor count;
+    * ``DMLC_WORKER_ID`` — this rank's index among the sorted
+      survivors (relative order preserved, so survivor data shards
+      stay stable when keyed off a launch-time identity);
+    * ``DMLC_PS_ROOT_PORT`` — the ORIGINAL port plus the new
+      generation, so the re-formed coordination service can never
+      collide with the old job's socket (survivor 0 may be a re-exec'd
+      process whose predecessor owned the old port);
+    * ``MXNET_RECOVERY_GENERATION`` / ``MXNET_RECOVERY_BASE_PORT`` /
+      ``MXNET_RECOVERY_DEAD_RANKS`` — lineage bookkeeping.
+
+    Multi-host note: ``DMLC_PS_ROOT_URI`` is left as-is; if the dead
+    worker hosted the coordinator, the launcher must point survivors at
+    a surviving host's address (single-host jobs — 127.0.0.1 — need
+    nothing).
+    """
+    base = dict(os.environ if env is None else env)
+    n = int(base.get("DMLC_NUM_WORKER", "1"))
+    rank = int(base.get("DMLC_WORKER_ID", "0"))
+    dead = sorted({int(r) for r in dead_ranks})
+    if not dead:
+        raise MXNetError("survivor_env() needs a non-empty dead set")
+    if any(r < 0 or r >= n for r in dead):
+        raise MXNetError(f"dead ranks {dead} outside the {n}-worker job")
+    if rank in dead:
+        raise MXNetError(f"rank {rank} is in the dead set {dead}; a "
+                         "dead worker has no survivor env")
+    survivors = [r for r in range(n) if r not in dead]
+    gen = recovery_generation(base) + 1
+    port = int(base.get("DMLC_PS_ROOT_PORT", "9091"))
+    root = int(base.get("MXNET_RECOVERY_BASE_PORT", str(port)))
+    base.update({
+        "DMLC_NUM_WORKER": str(len(survivors)),
+        "DMLC_WORKER_ID": str(survivors.index(rank)),
+        "DMLC_PS_ROOT_PORT": str(root + gen),
+        "MXNET_RECOVERY_BASE_PORT": str(root),
+        "MXNET_RECOVERY_GENERATION": str(gen),
+        "MXNET_RECOVERY_DEAD_RANKS": ",".join(str(r) for r in dead),
+    })
+    return base
+
+
+def reexec_survivor(dead_ranks, argv=None):
+    """Replace this process with a fresh one joined to the re-formed
+    cluster (``os.execve`` of the same interpreter + argv under
+    :func:`survivor_env`). Does not return. The caller should close its
+    kvstore (``kv.close(abort=True)``) and checkpoint manager first so
+    pending commits land and no threads hold locks across exec."""
+    env = survivor_env(dead_ranks)
+    _telemetry.counter("recovery.reexec").inc()
+    _telemetry.flightrec.note(
+        "recovery.reexec", dead=sorted(int(r) for r in dead_ranks),
+        generation=env["MXNET_RECOVERY_GENERATION"],
+        new_rank=env["DMLC_WORKER_ID"],
+        new_nworker=env["DMLC_NUM_WORKER"])
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os.execve(sys.executable, [sys.executable] + list(argv or sys.argv),
+              env)

@@ -20,8 +20,10 @@ parity, for the update_on_kvstore path, and for multi-host grad sync.
 """
 from __future__ import annotations
 
+import logging
 import os
 import pickle
+import threading
 
 import numpy as np
 import jax
@@ -166,6 +168,7 @@ class KVStore:
         self._store = {}
         self._updater = None
         self._optimizer = None
+        self._closed = False
 
     # ---------------------------------------------------------------- meta
     @property
@@ -275,8 +278,25 @@ class KVStore:
     def _flush_pending(self):
         """Apply deferred pushes (dist bucket scheduler); no-op here."""
 
-    def close(self):
-        """Release background resources (dist heartbeats); no-op here."""
+    def close(self, abort=False):
+        """Release background resources (dist heartbeats). Idempotent on
+        every store kind; ``abort=True`` (dist) additionally drops any
+        staged-but-undispatched gradients instead of flushing them —
+        the right teardown when a peer is dead and a flush would fail
+        against the broken collective."""
+        self._closed = True
+
+    # ------------------------------------------------------ failure surface
+    def get_dead_nodes(self, timeout_ms=2000):
+        """Ranks currently considered dead (single-process: none)."""
+        return []
+
+    def on_dead_node(self, callback, period=None):
+        """Register a dead-worker callback. The dist store arms a
+        watcher thread that fires ``callback(dead_ranks)`` once on the
+        first detection; a single-process store has no peers to lose,
+        so this is a documented no-op returning False."""
+        return False
 
     # ------------------------------------------------------------ optimizer
     def set_optimizer(self, optimizer):
@@ -351,6 +371,9 @@ class KVStoreDistSync(KVStore):
         self._sum_jit_shapes = set()     # (dtype, padded-len) size classes
         self._hb_stop = None
         self._hb_thread = None
+        self._watch_stop = None          # dead-node watcher (on_dead_node)
+        self._watch_thread = None
+        self._closed = False
         self._sched = BucketScheduler(
             self._allreduce_flat, self._apply_reduced,
             lambda: int(os.environ.get("MXNET_KVSTORE_BUCKET_BYTES",
@@ -395,22 +418,38 @@ class KVStoreDistSync(KVStore):
         self._hb_stop = stop
         self._hb_thread = thread
 
-    def close(self):
-        """Flush pending pushes and stop/join the heartbeat thread so a
-        discarded store can't leak threads across a test suite (or keep
-        beating for a rank that logically left the job)."""
-        self._flush_pending()
-        if self._hb_stop is not None:
-            self._hb_stop.set()
-            if self._hb_thread is not None:
-                self._hb_thread.join(timeout=5)
-            self._hb_stop = None
-            self._hb_thread = None
+    def close(self, abort=False):
+        """Flush pending pushes and stop/join the heartbeat and
+        dead-node watcher threads so a discarded store can't leak
+        threads across a test suite (or keep beating for a rank that
+        logically left the job). Idempotent: a second close is a no-op,
+        so teardown paths (fit cleanup, recovery, __del__, user code)
+        can all call it without coordination. ``abort=True`` drops any
+        staged-but-undispatched gradients instead of flushing — the
+        recovery teardown, where a flush would re-enter the collective
+        a dead peer already broke."""
+        if self._closed:
+            return
+        self._closed = True
+        if abort:
+            self._sched.drop_pending()
+        else:
+            self._flush_pending()
+        for stop, thread in ((self._watch_stop, self._watch_thread),
+                             (self._hb_stop, self._hb_thread)):
+            if stop is not None:
+                stop.set()
+                if thread is not None and \
+                        thread is not threading.current_thread():
+                    thread.join(timeout=5)
+        self._watch_stop = self._watch_thread = None
+        self._hb_stop = self._hb_thread = None
 
     def __del__(self):
         try:
-            if self._hb_stop is not None:
-                self._hb_stop.set()
+            for stop in (self._hb_stop, self._watch_stop):
+                if stop is not None:
+                    stop.set()
         except Exception:
             pass        # interpreter teardown
 
@@ -602,39 +641,99 @@ class KVStoreDistSync(KVStore):
             multihost_utils.sync_global_devices("kvstore_barrier")
 
     # ------------------------------------------------------ failure surface
-    def get_num_dead_node(self, node_id=0, timeout_ms=2000):
-        """Count dead workers (reference: kvstore_dist.h:159-168
-        GetDeadNodes over ps-lite heartbeats). One-sided: queries the
-        coordination service's liveness tracking (``get_live_nodes``
-        where the client has it, else this store's own heartbeat keys) —
-        any single rank can call this at any time, no peer cooperation
-        needed. ``timeout_ms`` bounds the per-rank key wait in the
-        heartbeat fallback; the native path applies the service's own
-        heartbeat timeout."""
+    def get_dead_nodes(self, timeout_ms=2000):
+        """Ranks currently considered dead (reference:
+        kvstore_dist.h:159-168 GetDeadNodes over ps-lite heartbeats).
+        One-sided: queries the coordination service's liveness tracking
+        (``get_live_nodes`` where the client has it, else this store's
+        own heartbeat keys) — any single rank can call this at any
+        time, no peer cooperation needed. ``timeout_ms`` bounds the
+        per-rank key wait in the heartbeat fallback; the native path
+        applies the service's own heartbeat timeout. Returns a sorted
+        rank list, the input the elastic-recovery rank remapping
+        (checkpoint/recovery.survivor_env) needs — a bare count can't
+        say WHO to exclude from the re-formed job."""
         if self._nproc <= 1:
-            return 0
+            return []
         client = _coordination_client()
         if client is None:
-            return 0
+            return []
+        me = self.rank
         if hasattr(client, "get_live_nodes"):
-            live = client.get_live_nodes(list(range(self._nproc)))
-            return self._nproc - len(live)
+            live = set(client.get_live_nodes(list(range(self._nproc))))
+            return sorted(r for r in range(self._nproc)
+                          if r not in live and r != me)
         # heartbeat fallback: a rank whose beat is missing or older than
         # PS_HEARTBEAT_TIMEOUT counts as dead (its last value stays in
         # the KV store, so a crashed peer reads back instantly as stale)
         import time as _time
         horizon = float(os.environ.get("PS_HEARTBEAT_TIMEOUT", "100"))
         wait_ms = max(100, int(timeout_ms) // self._nproc)
-        dead = 0
+        dead = []
         for r in range(self._nproc):
+            if r == me:
+                continue    # a running rank can never observe itself dead
             try:
                 ts = float(client.blocking_key_value_get(
                     f"{self._HB_PREFIX}{r}", wait_ms))
                 if _time.time() - ts > horizon:
-                    dead += 1
+                    dead.append(r)
             except Exception:
-                dead += 1           # never wrote a beat: not alive yet
+                dead.append(r)      # never wrote a beat: not alive yet
         return dead
+
+    def get_num_dead_node(self, node_id=0, timeout_ms=2000):
+        """Count of dead workers (the reference-shaped polling API;
+        ``get_dead_nodes`` adds the rank identities)."""
+        return len(self.get_dead_nodes(timeout_ms=timeout_ms))
+
+    def on_dead_node(self, callback, period=None):
+        """Arm a watcher thread that calls ``callback(dead_ranks)`` ONCE
+        when the liveness layer first reports a dead peer — the push
+        seam the elastic-recovery path hangs off (polling
+        ``get_num_dead_node`` from the training loop would either lag
+        detection by a batch or tax every batch with a liveness RPC).
+
+        The callback runs on the watcher thread: implementations should
+        only record the event (set a flag, bump a counter) and let the
+        training thread act at its next safe boundary. The watcher
+        exits after firing (re-arm by calling again); ``close()`` stops
+        an unfired watcher. Returns True when armed, False when there
+        is nothing to watch (single process)."""
+        if self._nproc <= 1 or self._closed:
+            return False
+        if self._watch_stop is not None:
+            self._watch_stop.set()          # replace a previous watcher
+        horizon = float(os.environ.get("PS_HEARTBEAT_TIMEOUT", "100"))
+        period = max(0.2, horizon / 5.0) if period is None else \
+            float(period)
+        stop = threading.Event()
+
+        def watch():
+            while not stop.wait(period):
+                try:
+                    dead = self.get_dead_nodes()
+                except Exception:
+                    continue        # a flaky liveness query isn't a death
+                if dead:
+                    _telemetry.counter("recovery.events").inc()
+                    _telemetry.flightrec.note("recovery.dead_node",
+                                              ranks=list(dead))
+                    if _telemetry.enabled():
+                        _telemetry.record_event("dead_node",
+                                                ranks=list(dead))
+                    try:
+                        callback(list(dead))
+                    except Exception:
+                        logging.getLogger(__name__).exception(
+                            "on_dead_node callback failed")
+                    return
+        thread = threading.Thread(target=watch, daemon=True,
+                                  name="mxnet-kvstore-deadwatch")
+        thread.start()
+        self._watch_stop = stop
+        self._watch_thread = thread
+        return True
 
 
 def create(name="local"):

@@ -166,6 +166,21 @@ class BucketScheduler:
         """Dispatched-but-unapplied bucket count (diagnostics)."""
         return len(self._inflight)
 
+    def drop_pending(self):
+        """Discard everything staged or in flight WITHOUT applying it —
+        the abort teardown (kvstore.close(abort=True)) for a store whose
+        collective is already broken by a dead peer: a flush would
+        re-enter the failed all-reduce, and the gradients of the batch
+        being abandoned are no longer wanted anyway. Returns the number
+        of entries dropped."""
+        n = len(self._pending) + sum(len(b.entries)
+                                     for b in self._inflight)
+        self._pending = []
+        self._inflight = []
+        self._staged.clear()
+        self._window += 1
+        return n
+
     def flush(self):
         """Dispatch what remains pending, then apply every in-flight
         bucket's reduced values in dispatch order."""

@@ -119,12 +119,18 @@ class BaseModule:
         return 1
 
     def _fit_epoch(self, epoch, train_data, eval_metric, batch_end_callback,
-                   monitor):
+                   monitor, skip=0):
         K = self._scan_window_size()
         if K > 1 and monitor is None:
             return self._fit_epoch_scan(epoch, train_data, eval_metric,
-                                        batch_end_callback, K)
+                                        batch_end_callback, K, skip=skip)
         for nbatch, batch in enumerate(train_data):
+            if nbatch < skip:
+                # resume fast-forward: these batches already trained
+                # before the kill; consuming them keeps the data stream
+                # (and any restored shuffle rng) aligned with the
+                # uninterrupted run
+                continue
             if monitor is not None:
                 monitor.tic()
             batch_span = _telemetry.span(
@@ -157,20 +163,31 @@ class BaseModule:
                       BatchEndParam(epoch=epoch, nbatch=nbatch,
                                     eval_metric=eval_metric,
                                     locals=locals()))
+            self._ckpt_tick(epoch, nbatch)
 
     def _fit_epoch_scan(self, epoch, train_data, eval_metric,
-                        batch_end_callback, K):
+                        batch_end_callback, K, skip=0):
         """Windowed epoch: K batches per device dispatch via the scan-
         fused program. Metrics, telemetry and callbacks still advance
         per logical batch — the per-step counts/outputs come back
         stacked from the one dispatch. Partial tail windows (and any
-        window the scan can't take) fall back to single fused steps."""
+        window the scan can't take) fall back to single fused steps.
+        Checkpoints are cut at window boundaries only (a snapshot
+        mid-window has no consistent cursor — the K steps retire as one
+        dispatch), so a resume ``skip`` is normally a multiple of K;
+        a residue (checkpoint cut at a tail single) fast-forwards
+        through split singles."""
         from ..io import StackedDataBatch
         nbatch = 0
+        to_skip = int(skip)
         batch_size = getattr(train_data, "batch_size", 0)
 
         def run_single(batch):
-            nonlocal nbatch
+            nonlocal nbatch, to_skip
+            if to_skip > 0:
+                to_skip -= 1
+                nbatch += 1
+                return
             t0 = time.perf_counter_ns()
             batch_span = _telemetry.span(
                 "module.fit.batch", _hist="module.fit.batch.seconds",
@@ -187,10 +204,24 @@ class BaseModule:
                       BatchEndParam(epoch=epoch, nbatch=nbatch,
                                     eval_metric=eval_metric,
                                     locals=locals()))
+            self._ckpt_tick(epoch, nbatch)
             nbatch += 1
 
         def run_window(window, steps):
-            nonlocal nbatch
+            nonlocal nbatch, to_skip
+            if to_skip >= steps:
+                to_skip -= steps
+                nbatch += steps
+                return
+            if to_skip > 0:
+                # cursor inside this window: fast-forward the remainder
+                # as split singles (resume replays them through the
+                # single fused step — same numerics, docs/checkpoint.md)
+                singles = window.split() if hasattr(window, "split") \
+                    else list(window)
+                for b in singles:
+                    run_single(b)
+                return
             t0 = time.perf_counter_ns()
             win_span = _telemetry.span(
                 "module.fit.window", _hist="module.fit.window.seconds",
@@ -209,6 +240,9 @@ class BaseModule:
                                         eval_metric=eval_metric,
                                         locals=locals()))
                 nbatch += 1
+            # checkpoint/dead-node boundary once per retired window —
+            # the only consistent cursor under scan dispatch
+            self._ckpt_tick(epoch, nbatch - 1)
 
         pending = []
         for batch in train_data:
@@ -253,6 +287,149 @@ class BaseModule:
                 "module.fit.batch", epoch=epoch, nbatch=nbatch,
                 dur_us=dur_us, batch_size=batch_size)
 
+    # --------------------------------------------- checkpointing / recovery
+    def _ckpt_tick(self, epoch, nbatch):
+        """Batch-boundary hook of both fit loops: checkpoint cadence +
+        the safe point to act on a dead-peer flag. ``nbatch`` is the
+        batch that just retired, so the saved cursor is
+        ``(epoch, nbatch + 1)`` — the next batch a resume runs."""
+        mgr = getattr(self, "_ckpt_manager", None)
+        if mgr is not None:
+            mgr.tick(self, epoch, nbatch + 1)
+        dead = getattr(self, "_dead_nodes_pending", None)
+        if dead:
+            from ..checkpoint import DeadWorkerError
+            self._dead_handled = True   # the wedged watchdog stands down
+            if mgr is not None:
+                # boundary detection: state is consistent — cut an
+                # emergency checkpoint before abandoning the job so
+                # resume loses zero batches
+                try:
+                    mgr.save(self, epoch, nbatch + 1, block=True)
+                except Exception:
+                    self.logger.exception(
+                        "emergency checkpoint failed; resume will use "
+                        "the last committed one")
+            raise DeadWorkerError(dead, clean=True)
+
+    def _arm_recovery(self, elastic):
+        """Subscribe to the kvstore heartbeat layer's dead-node seam
+        (elastic mode): the watcher thread only sets a flag, the
+        training thread raises at its next batch boundary. A survivor
+        can also be WEDGED — blocked inside a collective the dead peer
+        will never join (gloo usually fails fast on the broken
+        connection, but a collective already in flight at the death can
+        hang) — in which case no batch boundary ever comes. With
+        ``MXNET_CKPT_HANG_ACTION=reexec`` a grace watchdog handles that
+        terminal state the way an elastic agent would: if the training
+        thread hasn't acted on the flag within
+        ``MXNET_CKPT_HANG_GRACE`` seconds, the process re-execs itself
+        over the survivor cluster directly (resume comes from the last
+        COMMITTED checkpoint; the wedged step is abandoned)."""
+        import threading
+        self._dead_nodes_pending = None
+        self._dead_handled = False
+        self._ckpt_elastic = bool(elastic)
+        if not self._ckpt_elastic:
+            return
+        kv = getattr(self, "_kvstore", None)
+        if kv is None or not hasattr(kv, "on_dead_node") or \
+                kv.num_workers <= 1:
+            return
+
+        def flag(ranks):
+            self._dead_nodes_pending = ranks
+            if os.environ.get("MXNET_CKPT_HANG_ACTION", "none") == \
+                    "reexec":
+                grace = float(os.environ.get("MXNET_CKPT_HANG_GRACE",
+                                             "60"))
+                threading.Thread(target=self._wedged_watchdog,
+                                 args=(ranks, grace), daemon=True,
+                                 name="mxnet-wedged-watchdog").start()
+
+        kv.on_dead_node(flag)
+
+    def _wedged_watchdog(self, dead_ranks, grace):
+        """Last-resort escape for a survivor stuck inside a broken
+        collective: after ``grace`` seconds with the dead-peer flag
+        unhandled, assume the training thread is wedged in C++ (no
+        Python-level interrupt can reach it) and re-exec this process
+        over the survivor cluster. State is dirty by definition —
+        resume uses the last committed checkpoint."""
+        time.sleep(grace)
+        if getattr(self, "_dead_handled", False):
+            return                  # the training thread got there
+        from ..checkpoint import reexec_survivor
+        self._dead_handled = True
+        _telemetry.counter("recovery.wedged").inc()
+        _telemetry.flightrec.note("recovery.wedged",
+                                  ranks=list(dead_ranks),
+                                  grace_s=grace)
+        self.logger.error(
+            "dead worker(s) %s flagged %.0fs ago and the training "
+            "thread never reached a batch boundary — assuming it is "
+            "wedged in a broken collective; re-execing over the "
+            "survivor cluster", list(dead_ranks), grace)
+        mgr = getattr(self, "_ckpt_manager", None)
+        if mgr is not None:
+            try:
+                mgr.close()         # land any queued commits first
+            except Exception:
+                pass
+        kv = getattr(self, "_kvstore", None)
+        if kv is not None:
+            try:
+                kv.close(abort=True)
+            except Exception:
+                pass
+        reexec_survivor(dead_ranks)
+
+    def _maybe_dead_worker(self, exc):
+        """Convert a mid-batch failure into DeadWorkerError when a peer
+        is in fact dead (elastic mode): the survivor's collective fails
+        fast on the broken connection, but heartbeat staleness needs a
+        horizon — poll the liveness layer briefly before deciding the
+        failure was something else."""
+        from ..checkpoint import DeadWorkerError
+        if isinstance(exc, DeadWorkerError):
+            return
+        if not getattr(self, "_ckpt_elastic", False):
+            return
+        kv = getattr(self, "_kvstore", None)
+        if kv is None or kv.num_workers <= 1 or \
+                not hasattr(kv, "get_dead_nodes"):
+            return
+        dead = getattr(self, "_dead_nodes_pending", None)
+        flagged = bool(dead)
+        if not dead:
+            horizon = float(os.environ.get("PS_HEARTBEAT_TIMEOUT", "100"))
+            patience = float(os.environ.get("MXNET_CKPT_DEAD_PATIENCE",
+                                            "") or min(horizon + 5, 30))
+            deadline = time.time() + patience
+            prev = None
+            while time.time() < deadline:
+                try:
+                    seen = kv.get_dead_nodes()
+                except Exception:
+                    seen = []
+                # require two consecutive agreeing observations: a
+                # transient coordination-service blip must not get
+                # promoted into a cluster re-form
+                if seen and seen == prev:
+                    dead = seen
+                    break
+                prev = seen or None
+                time.sleep(0.5)
+        if dead:
+            self._dead_handled = True   # the wedged watchdog stands down
+            if not flagged:
+                # the watcher thread counts flag-path detections; this
+                # is the collective-failure path it hasn't seen yet
+                _telemetry.counter("recovery.events").inc()
+            _telemetry.flightrec.note("recovery.dead_worker",
+                                      ranks=list(dead), clean=False)
+            raise DeadWorkerError(dead, clean=False) from exc
+
     def fit(self, train_data, eval_data=None, eval_metric="acc",
             epoch_end_callback=None, batch_end_callback=None, kvstore="local",
             optimizer="sgd", optimizer_params=(("learning_rate", 0.01),),
@@ -261,7 +438,8 @@ class BaseModule:
             allow_missing=False, force_rebind=False, force_init=False,
             begin_epoch=0, num_epoch=None, validation_metric=None,
             monitor=None, steps_per_dispatch=None, zero_stage=None,
-            spmd=None, mesh=None):
+            spmd=None, mesh=None, checkpoint=None, resume=None,
+            elastic=None):
         """The training loop (reference base_module.py:368-507 contract).
 
         ``steps_per_dispatch`` (default ``MXNET_STEPS_PER_DISPATCH``,
@@ -286,8 +464,33 @@ class BaseModule:
         ``kvstore=None``; a local store is dropped automatically).
         Numerically equivalent to the kvstore path
         (docs/performance.md).
+
+        ``checkpoint`` (default: a manager over ``MXNET_CKPT_DIR`` when
+        that env var is set, else off): a
+        ``checkpoint.CheckpointManager`` — or a directory string to
+        build one — that snapshots full training state asynchronously
+        at its ``every_n_batches`` cadence plus every epoch end, into
+        versioned atomically-committed checkpoint directories
+        (docs/checkpoint.md).
+
+        ``resume`` (default off): True (use ``checkpoint``'s directory)
+        or a checkpoint-directory string — restore the newest committed
+        checkpoint (params, optimizer state + update counts, rng chain)
+        and continue from its cursor: earlier epochs are skipped and
+        the cursor epoch fast-forwards past already-trained batches, so
+        the resumed run continues bit-for-bit where the killed one
+        stopped. Under ``steps_per_dispatch`` K the cursor lies on a
+        window boundary (checkpoints are cut between windows).
+
+        ``elastic`` (default ``MXNET_CKPT_ELASTIC``): with a dist
+        kvstore, subscribe to the heartbeat layer's dead-node seam and
+        raise ``checkpoint.DeadWorkerError`` (after an emergency save
+        at the next batch boundary) instead of hanging in a collective
+        against a dead peer — the caller re-forms the job over the
+        survivors (``checkpoint.reexec_survivor``) and resumes.
         """
         from ..initializer import Uniform
+        from ..checkpoint import CheckpointManager, DeadWorkerError
         if num_epoch is None:
             raise ValueError("fit() needs num_epoch")
         if steps_per_dispatch is None:
@@ -300,10 +503,45 @@ class BaseModule:
             self._spmd = bool(spmd)
         if mesh is not None:
             self._mesh_config = mesh
+
+        # checkpointing arrangement: explicit kwarg > MXNET_CKPT_DIR env
+        # (the env path only engages on modules with an executor group —
+        # full-state capture needs one; an explicit kwarg raises loudly)
+        mgr, mgr_owned = None, False
+        if checkpoint is None and os.environ.get("MXNET_CKPT_DIR") \
+                and hasattr(self, "_exec_group"):
+            checkpoint = os.environ["MXNET_CKPT_DIR"]
+        if checkpoint is not None:
+            if isinstance(checkpoint, CheckpointManager):
+                mgr = checkpoint
+            else:
+                mgr = CheckpointManager(str(checkpoint))
+                mgr_owned = True
+        self._ckpt_manager = mgr
+        if elastic is None:
+            elastic = os.environ.get("MXNET_CKPT_ELASTIC", "").lower() \
+                in ("1", "true", "yes", "on")
+
         self._prepare_fit(train_data, initializer or Uniform(0.01),
                           arg_params, aux_params, allow_missing,
                           force_rebind, force_init, kvstore, optimizer,
                           optimizer_params, monitor)
+        self._arm_recovery(elastic)
+
+        # exact resume: restore the newest committed checkpoint into the
+        # freshly prepared module, then continue from its cursor
+        skip_batches = 0
+        if resume:
+            from ..checkpoint import restore_module
+            if resume is True and mgr is None:
+                raise ValueError("fit(resume=True) needs a checkpoint "
+                                 "manager (checkpoint=... or "
+                                 "MXNET_CKPT_DIR)")
+            resume_dir = mgr.directory if resume is True else str(resume)
+            cursor = restore_module(self, resume_dir)
+            if cursor is not None and int(cursor["epoch"]) >= begin_epoch:
+                begin_epoch = int(cursor["epoch"])
+                skip_batches = int(cursor["nbatch"])
 
         eval_metric = metric_mod.create(eval_metric)
         validation_metric = validation_metric or eval_metric
@@ -325,26 +563,38 @@ class BaseModule:
                              validation_metric, epoch_end_callback,
                              batch_end_callback, eval_end_callback,
                              eval_batch_end_callback, begin_epoch,
-                             num_epoch, monitor)
+                             num_epoch, monitor,
+                             skip_batches=skip_batches)
+            if mgr is not None:
+                mgr.wait()          # the last checkpoint must be durable
+        except DeadWorkerError:
+            raise                   # recovery path: dump written already
         except Exception as exc:
+            # a dead peer shows up as a failed collective mid-batch:
+            # convert to the recovery signal before post-mortem
+            self._maybe_dead_worker(exc)
             # leave a post-mortem on disk: ring timeline + metrics +
             # memory watermarks (telemetry.flightrec crash report)
             _telemetry.flightrec.on_crash(exc, where="module.fit")
             raise
+        finally:
+            if mgr_owned:
+                mgr.close()
 
     def _fit_epochs(self, train_data, eval_data, eval_metric,
                     validation_metric, epoch_end_callback,
                     batch_end_callback, eval_end_callback,
                     eval_batch_end_callback, begin_epoch, num_epoch,
-                    monitor):
+                    monitor, skip_batches=0):
         for epoch in range(begin_epoch, num_epoch):
             start = time.time()
             eval_metric.reset()
+            skip = skip_batches if epoch == begin_epoch else 0
             with _telemetry.span("module.fit.epoch",
                                  _hist="module.fit.epoch.seconds",
                                  epoch=epoch):
                 self._fit_epoch(epoch, train_data, eval_metric,
-                                batch_end_callback, monitor)
+                                batch_end_callback, monitor, skip=skip)
 
             name_values = eval_metric.get_name_value()
             for name, val in name_values:
@@ -363,6 +613,12 @@ class BaseModule:
             if epoch_end_callback is not None:
                 for cb in _as_list(epoch_end_callback):
                     cb(epoch, self.symbol, arg_now, aux_now)
+
+            mgr = getattr(self, "_ckpt_manager", None)
+            if mgr is not None:
+                # epoch-boundary checkpoint: cursor = start of the next
+                # epoch (async; the writer thread owns the disk work)
+                mgr.save(self, epoch + 1, 0)
 
             if eval_data:
                 for name, val in self.score(

@@ -542,6 +542,21 @@ class DataParallelExecutorGroup:
                     st, self.executor.arg_dict[nm].shape)
                 for nm, st in self._fused_states.items()}
 
+    # ------------------------------------------------------- rng transport
+    def rng_chain(self):
+        """Host copy of the device-chained rng key (None when the fused
+        path never armed). Part of the exact-resume state: the dropout
+        stream of step N+1 is a pure function of this key."""
+        key = getattr(self, "_fused_key", None)
+        return None if key is None else np.asarray(key)
+
+    def set_rng_chain(self, key):
+        """Reinstate a checkpointed device rng chain and re-tag the
+        generation so the restored chain is not immediately re-drawn."""
+        from .. import random as _random
+        self._fused_key = jnp.asarray(np.asarray(key))
+        self._fused_rng_gen = _random.generation()
+
     def fused_step(self, data_batch, lrs, wds):
         """Run one fused train step; swap new params/state/outputs in
         (gradients are emitted and written back only under

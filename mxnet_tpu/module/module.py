@@ -552,6 +552,28 @@ class Module(BaseModule):
         self._exec_group.update_metric(eval_metric, labels)
 
     # ------------------------------------------------------ optimizer states
+    def _opt_counts(self):
+        """Name-keyed update counts + the global count — the half of the
+        optimizer's state that is NOT per-param arrays (Adam bias
+        correction, lr schedules). Without these a restored run replays
+        update 1's bias correction and warmup lr over trained weights."""
+        o = self._optimizer
+        return {
+            "num_update": int(o.num_update),
+            "index_update_count": {
+                self._param_names[i]: int(c)
+                for i, c in o._index_update_count.items()
+                if 0 <= i < len(self._param_names)},
+        }
+
+    def _restore_opt_counts(self, counts):
+        o = self._optimizer
+        o.num_update = int(counts.get("num_update", o.num_update))
+        idx = {nm: i for i, nm in enumerate(self._param_names)}
+        for nm, c in (counts.get("index_update_count") or {}).items():
+            if nm in idx:
+                o._index_update_count[idx[nm]] = int(c)
+
     def save_optimizer_states(self, fname):
         assert self.optimizer_initialized
         if self._update_on_kvstore:
@@ -569,8 +591,10 @@ class Module(BaseModule):
             states = {"__fused__": self._exec_group.export_fused_states()}
         else:
             states = {k: host(v) for k, v in self._updater.states.items()}
+        payload = {"__format__": 2, "states": states,
+                   **self._opt_counts()}
         with open(fname, "wb") as fout:
-            pickle.dump(states, fout)
+            pickle.dump(payload, fout)
 
     def load_optimizer_states(self, fname):
         assert self.optimizer_initialized
@@ -579,6 +603,9 @@ class Module(BaseModule):
             return
         with open(fname, "rb") as fin:
             states = pickle.load(fin)
+        if isinstance(states, dict) and states.get("__format__") == 2:
+            self._restore_opt_counts(states)
+            states = states["states"]
         import jax
         if "__fused__" in states and self._fused_armed:
             self._exec_group.import_fused_states(states["__fused__"])

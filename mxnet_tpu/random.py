@@ -10,6 +10,7 @@ Seeding is deterministic and device-independent.
 from __future__ import annotations
 
 import jax
+import numpy as np
 
 # lazy: materializing a key initializes the XLA backend, which must not
 # happen at import time (jax.distributed.initialize comes after import)
@@ -36,3 +37,27 @@ def next_key():
         _STATE["key"] = jax.random.PRNGKey(0)
     _STATE["key"], sub = jax.random.split(_STATE["key"])
     return sub
+
+
+def get_state():
+    """Picklable snapshot of the host rng chain, for exact-resume
+    checkpoints (mxnet_tpu/checkpoint): the raw key material (or None
+    when never seeded/drawn) plus the generation tag. Restoring it with
+    :func:`set_state` reproduces the same subkey sequence from this
+    point — the dropout/augmentation streams of a resumed run continue
+    exactly where the killed run stopped."""
+    key = _STATE["key"]
+    return {"key": None if key is None else np.asarray(key),
+            "generation": int(_STATE["generation"])}
+
+
+def set_state(state):
+    """Restore a :func:`get_state` snapshot. Always bumps the generation
+    so device-chained consumers (the fused train step keeps its rng on
+    device between steps) re-draw from the restored chain rather than
+    continuing a stale one — restorers that also reinstate the device
+    chain (checkpoint resume) re-record the generation afterwards."""
+    key = state.get("key")
+    _STATE["key"] = None if key is None else \
+        jax.numpy.asarray(np.asarray(key))
+    _STATE["generation"] += 1

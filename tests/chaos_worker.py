@@ -1,0 +1,142 @@
+"""Chaos worker: dist training that survives a mid-epoch peer kill.
+
+The end-to-end composition of the recovery story (ISSUE 9 tentpole c):
+N workers train over dist_sync with async checkpointing + elastic mode;
+one worker ``os._exit``s mid-epoch (no shutdown, no goodbye — the
+heartbeat layer and the survivors' broken collectives are the only
+signals). Survivors must:
+
+  save (their managers' last committed checkpoint is already on disk;
+  a boundary detection also cuts an emergency one)
+  -> raise ``DeadWorkerError`` instead of hanging
+  -> re-exec themselves over the survivor cluster
+     (``checkpoint.reexec_survivor``: n-1 workers, remapped ranks,
+     generation-bumped coordinator port)
+  -> resume from the last committed checkpoint and train to completion.
+
+Identity contract: ``CHAOS_STABLE_ID`` (set once by the launcher) keys
+each worker's data shard and checkpoint directory, so both survive the
+rank remapping — after the re-form, old rank 2 may be new rank 1 but
+still trains its own shard from its own checkpoints.
+
+Markers on stdout (the test greps these): ``CHAOS_START``,
+``CHAOS_DEAD_SEEN`` (detection), ``CHAOS_DONE`` (final metrics).
+Exit codes: 0 success, 17 the planned kill, anything else a bug.
+"""
+import hashlib
+import os
+import sys
+import time
+import traceback
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import mxnet_tpu as mx  # noqa: E402
+
+
+def _net():
+    data = mx.sym.var("data")
+    h = mx.sym.Activation(mx.sym.FullyConnected(data, num_hidden=16,
+                                                name="fc1"),
+                          act_type="relu")
+    return mx.sym.SoftmaxOutput(mx.sym.FullyConnected(h, num_hidden=2,
+                                                      name="fc2"),
+                                name="softmax")
+
+
+def main():
+    stable_id = int(os.environ["CHAOS_STABLE_ID"])
+    kill_id = int(os.environ.get("CHAOS_KILL_STABLE_ID", "-1"))
+    kill_at = os.environ.get("CHAOS_KILL_AT", "")   # "epoch:batch"
+    num_epoch = int(os.environ.get("CHAOS_EPOCHS", "4"))
+    gen = mx.checkpoint.recovery_generation()
+
+    kv = mx.kv.create("dist_sync")
+    rank, nworker = kv.rank, kv.num_workers
+    print(f"CHAOS_START stable={stable_id} rank={rank} "
+          f"nworker={nworker} gen={gen}", flush=True)
+
+    # per-worker shard of the planted-signal task, keyed by the STABLE
+    # id: the shard follows the worker through re-forms
+    rng = np.random.RandomState(100 + stable_id)
+    n = 256
+    X = rng.rand(n, 16).astype("f")
+    y = (X[:, 3] > 0.5).astype("f")
+    X[:, 0] = y * 3.0
+    it = mx.io.NDArrayIter(X, y, batch_size=32, shuffle=False)
+
+    mod = mx.mod.Module(_net(), context=mx.cpu())
+    mgr = mx.checkpoint.CheckpointManager(
+        os.environ["MXNET_CKPT_DIR"], every_n_batches=2)
+
+    kill_tuple = None
+    if gen == 0 and kill_at:
+        ep, nb = kill_at.split(":")
+        kill_tuple = (int(ep), int(nb))
+    pause_s = float(os.environ.get("CHAOS_PAUSE_S", "0"))
+
+    def cb(p):
+        if kill_tuple is not None and (p.epoch, p.nbatch) == kill_tuple:
+            if stable_id == kill_id:
+                print(f"CHAOS_KILL stable={stable_id} at "
+                      f"epoch={p.epoch} nbatch={p.nbatch}", flush=True)
+                os._exit(17)    # die without any shutdown: pure chaos
+            # survivors idle past the heartbeat horizon so detection
+            # lands BEFORE their next collective — the clean boundary
+            # path. (A post-death collective is a gloo coin flip:
+            # usually a fast error the patience path converts, but it
+            # can hang — wedged watchdog — or hard-abort the process,
+            # which nothing in-process can survive.)
+            if pause_s:
+                time.sleep(pause_s)
+
+    def epoch_cb(epoch, sym, arg, aux):
+        pass
+
+    try:
+        mod.fit(it, num_epoch=num_epoch, kvstore=kv,
+                initializer=mx.initializer.Xavier(rnd_type="uniform",
+                                                  magnitude=2),
+                optimizer="sgd",
+                optimizer_params={"learning_rate": 0.05,
+                                  "momentum": 0.9},
+                batch_end_callback=cb, epoch_end_callback=epoch_cb,
+                checkpoint=mgr, resume=(gen > 0), elastic=True)
+    except mx.checkpoint.DeadWorkerError as e:
+        print(f"CHAOS_DEAD_SEEN stable={stable_id} rank={rank} "
+              f"dead={e.dead_ranks} clean={e.clean}", flush=True)
+        mgr.close()                 # last commits must land before exec
+        kv.close(abort=True)        # drop grads staged at the dead peer
+        mx.checkpoint.reexec_survivor(e.dead_ranks)
+        raise AssertionError("reexec_survivor returned")  # unreachable
+
+    args, _ = mod.get_params()
+    digest = hashlib.sha1()
+    for nm in sorted(args):
+        digest.update(np.ascontiguousarray(
+            np.round(args[nm].asnumpy().astype(np.float64), 5)).tobytes())
+    acc = mod.score(it, "acc")[0][1]
+    mgr.close()
+    kv.close()
+    print(f"CHAOS_DONE stable={stable_id} rank={rank} gen={gen} "
+          f"nworker={nworker} acc={acc:.3f} "
+          f"params={digest.hexdigest()[:16]}", flush=True)
+    assert acc > 0.8, f"stable {stable_id} failed to learn: {acc}"
+
+
+if __name__ == "__main__":
+    try:
+        main()
+    except Exception:
+        # surface the failure on stdout so the test's wedge/failure
+        # diagnostics capture it even when stderr is lost
+        print(f"CHAOS_ERROR stable={os.environ.get('CHAOS_STABLE_ID')}",
+              flush=True)
+        traceback.print_exc()
+        raise

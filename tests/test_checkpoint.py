@@ -1,0 +1,511 @@
+"""Async checkpointing + exact resume (mxnet_tpu/checkpoint, ISSUE 9).
+
+The fast (tier-1) half of the recovery story: single-process
+kill/resume must be EXACT — params, optimizer state, update counts
+(Adam bias correction / lr schedules), the rng chain feeding dropout,
+and the epoch/batch cursor all bit-for-bit against an uninterrupted
+run — plus the manager's atomic-commit/retention contracts, the
+layout-independent optimizer-state transport (satellite: fused/ZeRO
+paths round-trip through ``save_checkpoint``), the kvstore close/
+dead-node seam, and the recovery env remapping. The multi-process
+chaos gate lives in tests/test_chaos.py (@slow).
+"""
+import json
+import os
+import pickle
+
+import numpy as np
+import pytest
+
+import jax
+
+import mxnet_tpu as mx
+
+BATCH = 4
+N_BATCHES = 10
+CLASSES = 3
+FEATS = 6
+
+
+def _mlp(dropout=0.3):
+    data = mx.sym.var("data")
+    fc = mx.sym.FullyConnected(data=data, num_hidden=8, name="fc1")
+    act = mx.sym.Activation(fc, act_type="relu")
+    if dropout:
+        act = mx.sym.Dropout(act, p=dropout)
+    fc2 = mx.sym.FullyConnected(act, num_hidden=CLASSES, name="fc2")
+    return mx.sym.SoftmaxOutput(fc2, name="softmax")
+
+
+def _data():
+    rs = np.random.RandomState(0)
+    X = rs.rand(N_BATCHES * BATCH, FEATS).astype(np.float32)
+    y = rs.randint(0, CLASSES, (N_BATCHES * BATCH,)).astype(np.float32)
+    return X, y
+
+
+def _init_args():
+    rs = np.random.RandomState(1)
+    return {
+        "fc1_weight": mx.nd.array(rs.randn(8, FEATS).astype(np.float32)
+                                  * 0.1),
+        "fc1_bias": mx.nd.array(np.zeros(8, np.float32)),
+        "fc2_weight": mx.nd.array(rs.randn(CLASSES, 8).astype(np.float32)
+                                  * 0.1),
+        "fc2_bias": mx.nd.array(np.zeros(CLASSES, np.float32)),
+    }
+
+
+class _Kill(Exception):
+    """Simulated SIGKILL at a batch boundary (the module object is
+    abandoned exactly as a dead process abandons its memory)."""
+
+
+def _run(kill_at=None, ckpt=None, resume=None, num_epoch=2, K=1,
+         optimizer="adam", dropout=0.3, every=2, zero_stage=None,
+         n_dev=1, seed=7):
+    """One training run; returns (params, accs[(epoch, nbatch, acc)],
+    module). ``kill_at`` raises out of fit at that (epoch, nbatch)'s
+    batch_end_callback — before the boundary's checkpoint tick, like a
+    real mid-run kill."""
+    X, y = _data()
+    mx.random.seed(seed)
+    it = mx.io.NDArrayIter(X, y, batch_size=BATCH)
+    ctx = mx.cpu() if n_dev == 1 else [mx.cpu(i) for i in range(n_dev)]
+    mod = mx.mod.Module(_mlp(dropout), context=ctx)
+    sched = mx.lr_scheduler.FactorScheduler(step=5, factor=0.5)
+    accs = []
+
+    def cb(p):
+        accs.append((p.epoch, p.nbatch, p.eval_metric.get()[1]))
+        if kill_at is not None and (p.epoch, p.nbatch) == kill_at:
+            raise _Kill()
+
+    mgr = mx.checkpoint.CheckpointManager(ckpt, every_n_batches=every) \
+        if isinstance(ckpt, str) else ckpt
+    opt_params = {"learning_rate": 0.05, "lr_scheduler": sched} \
+        if optimizer == "adam" else \
+        {"learning_rate": 0.05, "momentum": 0.9, "lr_scheduler": sched}
+    try:
+        mod.fit(it, num_epoch=num_epoch, steps_per_dispatch=K,
+                batch_end_callback=cb, zero_stage=zero_stage,
+                arg_params={k: v.copy() for k, v in _init_args().items()},
+                optimizer=optimizer, optimizer_params=opt_params,
+                checkpoint=mgr, resume=resume)
+    except _Kill:
+        pass
+    finally:
+        if mgr is not None:
+            mgr.close()
+    args, _ = mod.get_params()
+    return {k: v.asnumpy() for k, v in args.items()}, accs, mod
+
+
+def _fused_states_np(mod):
+    return {k: [np.asarray(l) for l in jax.tree.leaves(v)]
+            for k, v in mod._exec_group.export_fused_states().items()}
+
+
+def _assert_states_equal(sa, sb):
+    assert sa.keys() == sb.keys()
+    for k in sa:
+        for x, z in zip(sa[k], sb[k]):
+            np.testing.assert_array_equal(x, z, err_msg=k)
+
+
+# ------------------------------------------------------------ exact resume
+def test_kill_resume_bit_for_bit(tmp_path):
+    """The tier-1 acceptance gate: kill mid-epoch-1, resume in a fresh
+    module, and end bit-for-bit where the uninterrupted run ends —
+    params, Adam state, update counts (bias correction + FactorScheduler
+    continuity), with dropout active (rng chain restore)."""
+    d = str(tmp_path / "ck")
+    pa, aa, ma = _run()
+    pb, ab, mb = _run(kill_at=(1, 3), ckpt=d)
+    # the killed run stopped early
+    assert ab[-1][:2] == (1, 3)
+    pc, ac, mc = _run(ckpt=d, resume=True, seed=999)  # seed overridden
+    for k in pa:
+        np.testing.assert_array_equal(pa[k], pc[k], err_msg=k)
+    _assert_states_equal(_fused_states_np(ma), _fused_states_np(mc))
+    assert mc._optimizer.num_update == ma._optimizer.num_update
+    # resumed run fast-forwarded: its first trained batch is the cursor,
+    # not batch 0 of epoch 0
+    assert ac[0][0] >= 1
+
+
+def test_resume_skips_exactly_to_cursor(tmp_path):
+    """The resumed run's first callback lands on the checkpoint cursor
+    (already-trained batches are consumed silently)."""
+    d = str(tmp_path / "ck")
+    _run(kill_at=(0, 5), ckpt=d, every=2)
+    # ticks at batches 0..4 -> commits at cursors 2 and 4
+    latest = mx.checkpoint.latest_checkpoint(d)
+    assert latest is not None
+    with open(os.path.join(latest[1], "manifest.json")) as f:
+        cursor = json.load(f)["cursor"]
+    assert (cursor["epoch"], cursor["nbatch"]) == (0, 4)
+    _, ac, _ = _run(ckpt=d, resume=True)
+    assert ac[0][:2] == (0, 4)
+
+
+def test_scan_kill_resume_identical_loss_curve(tmp_path):
+    """Satellite: K=4 scan run killed at batch N resumes with a loss
+    curve identical to the unkilled run's, and bit-identical final
+    params. The kill lands inside epoch 1's first window, so the
+    resume cursor is the epoch boundary and every resumed batch's
+    metric value is comparable 1:1 (the metric accumulator itself is
+    epoch-scoped, not checkpointed — docs/checkpoint.md)."""
+    d = str(tmp_path / "ck")
+    pa, aa, _ = _run(K=4, every=1)
+    _run(K=4, kill_at=(1, 1), ckpt=d, every=1)
+    pc, ac, _ = _run(K=4, ckpt=d, resume=True, seed=999)
+    for k in pa:
+        np.testing.assert_array_equal(pa[k], pc[k], err_msg=k)
+    # every batch the resumed run trained reports the same metric value
+    # as the same batch of the uninterrupted run
+    by_idx = {(e, n): v for e, n, v in aa}
+    assert ac and ac[0][:2] == (1, 0)
+    for e, n, v in ac:
+        assert v == by_idx[(e, n)], (e, n)
+
+
+def test_scan_resume_from_mid_epoch_window_boundary(tmp_path):
+    """A kill past a mid-epoch window tick resumes AT that window
+    boundary (cursor (1, 4)), replays the remaining windows, and still
+    ends bit-identical."""
+    d = str(tmp_path / "ck")
+    pa, _, _ = _run(K=4, every=1)
+    _run(K=4, kill_at=(1, 7), ckpt=d, every=1)
+    latest = mx.checkpoint.latest_checkpoint(d)
+    with open(os.path.join(latest[1], "manifest.json")) as f:
+        cursor = json.load(f)["cursor"]
+    assert (cursor["epoch"], cursor["nbatch"]) == (1, 4)
+    pc, ac, _ = _run(K=4, ckpt=d, resume=True, seed=999)
+    assert ac[0][:2] == (1, 4)
+    for k in pa:
+        np.testing.assert_array_equal(pa[k], pc[k], err_msg=k)
+
+
+def test_resume_mid_window_cursor_under_larger_K(tmp_path):
+    """A cursor cut under K=1 need not be window-aligned for a K=4
+    resume: the first partial window fast-forwards through split
+    singles. Numerics match the uninterrupted K=1 run to fp tolerance
+    (scan ≡ singles, the test_scan_fit contract)."""
+    d = str(tmp_path / "ck")
+    pa, _, _ = _run(K=1, optimizer="sgd")
+    _run(K=1, optimizer="sgd", kill_at=(0, 5), ckpt=d, every=3)
+    latest = mx.checkpoint.latest_checkpoint(d)
+    with open(os.path.join(latest[1], "manifest.json")) as f:
+        assert json.load(f)["cursor"]["nbatch"] == 3   # not a K=4 edge
+    pc, _, _ = _run(K=4, optimizer="sgd", ckpt=d, resume=True)
+    for k in pa:
+        np.testing.assert_allclose(pa[k], pc[k], rtol=2e-5, atol=2e-6,
+                                   err_msg=k)
+
+
+def test_restore_into_staged_arrangement(tmp_path):
+    """A fused-run checkpoint restores into a module running the staged
+    (monitor-installed) path: canonical by-name states project onto the
+    per-index updater."""
+    d = str(tmp_path / "ck")
+    _run(kill_at=(1, 3), ckpt=d, optimizer="sgd")
+    X, y = _data()
+    mx.random.seed(7)
+    it = mx.io.NDArrayIter(X, y, batch_size=BATCH)
+    mod = mx.mod.Module(_mlp(0.0), context=mx.cpu())
+    mon = mx.Monitor(interval=10**9, pattern="$^")  # forces staged path
+    mod.fit(it, num_epoch=2, monitor=mon, optimizer="sgd",
+            arg_params={k: v.copy() for k, v in _init_args().items()},
+            optimizer_params={"learning_rate": 0.05, "momentum": 0.9},
+            checkpoint=None, resume=d)
+    assert not mod._fused_armed
+    # momentum state landed in the updater, param-shaped
+    states = mod._updater.states
+    assert any(st is not None for st in states.values())
+
+
+# ------------------------------------------------- manager contracts
+def _tiny_module():
+    X, y = _data()
+    it = mx.io.NDArrayIter(X, y, batch_size=BATCH)
+    mod = mx.mod.Module(_mlp(0.0), context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(arg_params=_init_args(), aux_params={})
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.05),))
+    return mod
+
+
+def test_manager_atomic_commit_retention(tmp_path):
+    d = str(tmp_path / "ck")
+    mod = _tiny_module()
+    mgr = mx.checkpoint.CheckpointManager(d, keep_last=2,
+                                          async_write=False)
+    for i in range(5):
+        mgr.save(mod, epoch=0, nbatch=i)
+    mgr.close()
+    committed = mx.checkpoint.manager._committed(d)
+    assert [s for s, _ in committed] == [4, 5]      # keep_last=2
+    assert mx.checkpoint.latest_checkpoint(d)[0] == 5
+    # no staging leftovers after clean commits
+    assert not [n for n in os.listdir(d) if n.startswith(".tmp-")]
+    # an incomplete dir is invisible to readers and a fresh manager
+    # numbers past the committed history
+    os.makedirs(os.path.join(d, "ckpt-00000099"))
+    assert mx.checkpoint.latest_checkpoint(d)[0] == 5
+    mgr2 = mx.checkpoint.CheckpointManager(d, async_write=False)
+    assert mgr2._seq == 6
+    mgr2.close()
+
+
+def test_manager_async_commits_and_wait(tmp_path):
+    d = str(tmp_path / "ck")
+    mod = _tiny_module()
+    with mx.checkpoint.CheckpointManager(d, async_write=True) as mgr:
+        mgr.save(mod, 0, 1)
+        mgr.save(mod, 0, 2)
+        mgr.wait()
+        assert len(mgr.list_committed()) == 2
+    # restore round-trips through the async-written files
+    cursor = mx.checkpoint.restore_module(_tiny_module(), d)
+    assert cursor == {"epoch": 0, "nbatch": 2}
+
+
+def test_restore_empty_dir_returns_none(tmp_path):
+    assert mx.checkpoint.restore_module(_tiny_module(),
+                                        str(tmp_path / "none")) is None
+
+
+def test_checkpoint_env_surface(tmp_path, monkeypatch):
+    """MXNET_CKPT_DIR alone turns checkpointing on in fit; EVERY and
+    KEEP_LAST configure cadence/retention."""
+    d = str(tmp_path / "envck")
+    monkeypatch.setenv("MXNET_CKPT_DIR", d)
+    monkeypatch.setenv("MXNET_CKPT_EVERY", "2")
+    monkeypatch.setenv("MXNET_CKPT_KEEP_LAST", "2")
+    _run(num_epoch=1, ckpt=None)
+    committed = mx.checkpoint.manager._committed(d)
+    assert len(committed) == 2                       # retention applied
+    latest = mx.checkpoint.latest_checkpoint(d)
+    with open(os.path.join(latest[1], "manifest.json")) as f:
+        cursor = json.load(f)["cursor"]
+    assert (cursor["epoch"], cursor["nbatch"]) == (1, 0)  # epoch-end save
+
+
+def test_checkpoint_telemetry(tmp_path):
+    d = str(tmp_path / "ck")
+    mx.telemetry.enable()
+    try:
+        mx.telemetry.clear()
+        mx.telemetry.flightrec.clear()
+        _run(num_epoch=1, ckpt=d, every=2)
+        snap = mx.telemetry.snapshot()
+        assert snap["counters"].get("ckpt.snapshots", 0) >= 3
+        assert snap["counters"].get("ckpt.commits", 0) >= 3
+        assert "ckpt.exposed_stall.seconds" in snap["histograms"]
+        assert "ckpt.snapshot.seconds" in snap["histograms"]
+        kinds = {r["kind"] for r in mx.telemetry.flightrec.get_records()}
+        assert "ckpt.snapshot" in kinds and "ckpt.commit" in kinds
+    finally:
+        mx.telemetry.disable()
+        mx.telemetry.clear()
+
+
+# -------------------------------------- optimizer-state layout transport
+@pytest.mark.parametrize("optimizer", ["sgd", "adam"])
+def test_save_checkpoint_states_across_layouts(tmp_path, optimizer):
+    """Satellite: ``Module.save_checkpoint(save_optimizer_states=True)``
+    under the fused plan restores SGD-momentum and Adam state
+    bit-for-bit — into a fused module AND into a ZeRO-sharded one
+    (the layout-independent transport), with update counts intact."""
+    prefix = str(tmp_path / "ck")
+    pa, _, ma = _run(num_epoch=1, optimizer=optimizer, dropout=0.0)
+    ma.save_checkpoint(prefix, 1, save_optimizer_states=True)
+    sa = _fused_states_np(ma)
+
+    def fresh(zero_stage=None, n_dev=1):
+        X, y = _data()
+        it = mx.io.NDArrayIter(X, y, batch_size=BATCH)
+        mod = mx.mod.Module.load(prefix, 1, load_optimizer_states=True,
+                                 context=mx.cpu() if n_dev == 1 else
+                                 [mx.cpu(i) for i in range(n_dev)])
+        mod.bind(data_shapes=it.provide_data,
+                 label_shapes=it.provide_label)
+        mod.init_params(arg_params=mod._arg_params,
+                        aux_params=mod._aux_params)
+        if zero_stage:
+            mod._zero_stage = zero_stage
+        mod.init_optimizer(optimizer=optimizer,
+                           optimizer_params=(("learning_rate", 0.05),)
+                           if optimizer == "adam" else
+                           (("learning_rate", 0.05), ("momentum", 0.9)))
+        return mod
+
+    # fused replicated
+    mb = fresh()
+    assert mb._fused_armed
+    _assert_states_equal(sa, _fused_states_np(mb))
+    assert mb._optimizer.num_update == ma._optimizer.num_update
+    assert dict(mb._optimizer._index_update_count) == \
+        dict(ma._optimizer._index_update_count)
+    # ZeRO-1 sharded layout on a 2-device mesh
+    mz = fresh(zero_stage=1, n_dev=2)
+    assert mz._exec_group._state_layout is not None
+    _assert_states_equal(sa, _fused_states_np(mz))
+
+
+def test_legacy_states_file_still_loads(tmp_path):
+    """Pre-format-2 ``.states`` pickles (bare states dict) load without
+    counts — backward compatibility for old checkpoints."""
+    _, _, ma = _run(num_epoch=1, optimizer="sgd", dropout=0.0)
+    legacy = str(tmp_path / "legacy.states")
+    with open(legacy, "wb") as f:
+        pickle.dump({"__fused__": ma._exec_group.export_fused_states()},
+                    f)
+    _, _, mb = _run(num_epoch=1, optimizer="sgd", dropout=0.0)
+    mb.load_optimizer_states(legacy)
+    _assert_states_equal(_fused_states_np(ma), _fused_states_np(mb))
+
+
+# ------------------------------------------------------------ rng chain
+def test_random_state_roundtrip():
+    mx.random.seed(42)
+    st = mx.random.get_state()
+    seq_a = [np.asarray(mx.random.next_key()) for _ in range(3)]
+    mx.random.seed(7)                      # diverge
+    mx.random.set_state(st)
+    seq_b = [np.asarray(mx.random.next_key()) for _ in range(3)]
+    for a, b in zip(seq_a, seq_b):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_set_state_bumps_generation():
+    g0 = mx.random.generation()
+    mx.random.set_state(mx.random.get_state())
+    assert mx.random.generation() == g0 + 1
+
+
+# ---------------------------------------------------- kvstore seam bits
+def test_kvstore_close_idempotent():
+    kv = mx.kv.create("local")
+    kv.close()
+    kv.close()                              # second close: no-op
+    kv2 = mx.kv.create("device")
+    kv2.close(abort=True)
+    kv2.close()
+    assert kv.get_dead_nodes() == []
+    assert kv.on_dead_node(lambda dead: None) is False  # no peers
+
+
+def test_scheduler_drop_pending():
+    from mxnet_tpu.kvstore_sched import BucketScheduler
+    applied = []
+    sched = BucketScheduler(lambda flat: flat,
+                            lambda k, ctx, red: applied.append(k),
+                            lambda: 1 << 30)
+    sched.stage("w0", None, np.zeros(8, np.float32), 0)
+    assert sched.drop_pending() == 1
+    sched.flush()
+    assert applied == []                    # dropped, never applied
+
+
+# --------------------------------------------------- recovery plumbing
+def test_survivor_env_remapping():
+    base = {"DMLC_NUM_WORKER": "4", "DMLC_WORKER_ID": "2",
+            "DMLC_PS_ROOT_URI": "127.0.0.1",
+            "DMLC_PS_ROOT_PORT": "9300"}
+    env = mx.checkpoint.survivor_env([1], env=base)
+    assert env["DMLC_NUM_WORKER"] == "3"
+    assert env["DMLC_WORKER_ID"] == "1"     # survivors [0,2,3] -> idx 1
+    assert env["DMLC_PS_ROOT_PORT"] == "9301"
+    assert env["MXNET_RECOVERY_GENERATION"] == "1"
+    assert env["MXNET_RECOVERY_DEAD_RANKS"] == "1"
+    # a second failure bumps the generation off the ORIGINAL base port
+    env2 = mx.checkpoint.survivor_env([2], env=env)
+    assert env2["DMLC_NUM_WORKER"] == "2"
+    assert env2["DMLC_WORKER_ID"] == "1"    # old rank 2 -> 1 -> stays 1
+    assert env2["DMLC_PS_ROOT_PORT"] == "9302"
+    assert env2["MXNET_RECOVERY_GENERATION"] == "2"
+
+
+def test_survivor_env_rejects_bad_sets():
+    base = {"DMLC_NUM_WORKER": "2", "DMLC_WORKER_ID": "0",
+            "DMLC_PS_ROOT_PORT": "9300"}
+    with pytest.raises(mx.MXNetError):
+        mx.checkpoint.survivor_env([], env=base)
+    with pytest.raises(mx.MXNetError):
+        mx.checkpoint.survivor_env([5], env=base)
+    with pytest.raises(mx.MXNetError):     # the dead have no survivor env
+        mx.checkpoint.survivor_env([0], env=base)
+
+
+def test_dead_worker_error_shape():
+    e = mx.checkpoint.DeadWorkerError([3, 1], clean=False)
+    assert e.dead_ranks == [1, 3] and e.clean is False
+    assert "committed checkpoint" in str(e)
+
+
+# ------------------------------------------------------------- diagnose
+def _diagnose():
+    import importlib.util
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "diagnose_ckpt_test", os.path.join(root, "tools", "diagnose.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_diagnose_checkpoint_section_jsonl(tmp_path):
+    """A checkpointed fit's live jsonl log renders the checkpoint
+    section: snapshot/commit counts and the stall/write costs."""
+    diagnose = _diagnose()
+    d = str(tmp_path / "ck")
+    mx.telemetry.enable()
+    try:
+        mx.telemetry.clear()
+        mx.telemetry.metrics.reset()
+        _run(num_epoch=1, ckpt=d, every=2)
+        log = tmp_path / "ckpt.jsonl"
+        mx.telemetry.jsonl.dump(str(log))
+    finally:
+        mx.telemetry.disable()
+        mx.telemetry.clear()
+        mx.telemetry.metrics.reset()
+    out = diagnose.render_file(str(log))
+    assert "checkpoint / recovery:" in out
+    assert "committed" in out
+    assert "exposed stall" in out
+    assert "background write" in out
+
+
+def test_diagnose_recovery_timeline_crash_path():
+    """A crash report whose ring carries ckpt.commit + recovery records
+    renders the recovery timeline (the post-mortem a dead-worker event
+    leaves behind)."""
+    diagnose = _diagnose()
+    report = {
+        "type": "crash_report", "time": "t", "pid": 1,
+        "where": "module.fit",
+        "exception": {"type": "DeadWorkerError", "message": "worker 2"},
+        "metrics": {"counters": {"ckpt.snapshots": 4, "ckpt.commits": 4,
+                                 "recovery.events": 1},
+                    "gauges": {"ckpt.last_seq": 4.0},
+                    "histograms": {}},
+        "ring": [
+            {"kind": "ckpt.commit", "ts_us": 1000, "seq": 3, "epoch": 1,
+             "nbatch": 2},
+            {"kind": "ckpt.commit", "ts_us": 5000000, "seq": 4,
+             "epoch": 1, "nbatch": 4},
+            {"kind": "recovery.dead_node", "ts_us": 6000000,
+             "ranks": [2]},
+            {"kind": "recovery.reexec", "ts_us": 7000000, "dead": [2],
+             "generation": "1", "new_rank": "0", "new_nworker": "2"},
+        ],
+    }
+    out = diagnose.render_crash(report)
+    assert "checkpoint / recovery:" in out
+    assert "RECOVERY: 1 dead-node detection(s)" in out
+    assert "recovery.dead_node" in out and "recovery.reexec" in out
+    assert "last commit: seq 4 at epoch 1, batch 4" in out

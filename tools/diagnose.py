@@ -300,6 +300,72 @@ def _serving_section(counters, gauge_triples, hist_entries):
     return lines
 
 
+def _checkpoint_section(counters, gauge_triples, hist_entries, records):
+    """Checkpoint / recovery health (mxnet_tpu/checkpoint): snapshot
+    cadence + commit count, exposed stall vs background write cost,
+    last committed sequence, and a dead-node/recovery event timeline —
+    rendered only when ckpt.*/recovery.* series or records exist."""
+    ctr = {_strip_labels(k)[0]: v for k, v in (counters or {}).items()}
+    snaps = ctr.get("ckpt.snapshots", 0)
+    commits = ctr.get("ckpt.commits", 0)
+    failures = ctr.get("ckpt.failures", 0)
+    rec_events = ctr.get("recovery.events", 0)
+    hists = {name: rec for name, _labels, rec in hist_entries}
+    stall = hists.get("ckpt.exposed_stall.seconds")
+    write = hists.get("ckpt.snapshot.seconds")
+    last_seq = None
+    for name, _labels, val in gauge_triples:
+        if name == "ckpt.last_seq":
+            last_seq = val
+    ckpt_records = [r for r in (records or [])
+                    if str(r.get("kind", "")).startswith(("ckpt.",
+                                                          "recovery."))
+                    or r.get("kind") == "dead_node"]
+    if not (snaps or commits or rec_events or stall or ckpt_records):
+        return []
+
+    lines = ["checkpoint / recovery:"]
+    head = (f"  snapshots: {snaps:.0f} taken, {commits:.0f} committed"
+            + (f", {failures:.0f} FAILED" if failures else ""))
+    if last_seq is not None:
+        head += f"; last committed seq {last_seq:.0f}"
+    lines.append(head)
+    if stall and stall.get("count"):
+        lines.append(
+            f"  exposed stall: mean "
+            f"{_fmt_us((stall.get('mean') or 0) * 1e6)} / max "
+            f"{_fmt_us((stall.get('max') or 0) * 1e6)} per snapshot "
+            f"(training-thread cost)")
+    if write and write.get("count"):
+        lines.append(
+            f"  background write: mean "
+            f"{_fmt_us((write.get('mean') or 0) * 1e6)} per snapshot "
+            f"(writer thread: D2H + serialize + fsync + commit)")
+    if rec_events:
+        lines.append(f"  RECOVERY: {rec_events:.0f} dead-node "
+                     f"detection(s)")
+    timeline = [r for r in ckpt_records
+                if str(r.get("kind", "")).startswith("recovery.")
+                or r.get("kind") == "dead_node"]
+    for r in timeline[:6]:
+        desc = {k: v for k, v in r.items()
+                if k not in ("kind", "ts_us")}
+        lines.append(f"    {r.get('kind', '?')} {desc}")
+    commits_r = [r for r in ckpt_records if r.get("kind") ==
+                 "ckpt.commit"]
+    if commits_r:
+        spread = (commits_r[-1].get("ts_us", 0) -
+                  commits_r[0].get("ts_us", 0)) / 1e6
+        if len(commits_r) > 1 and spread > 0:
+            lines.append(f"  cadence: {len(commits_r)} commits in ring, "
+                         f"~every {spread / (len(commits_r) - 1):.1f}s")
+        last = commits_r[-1]
+        lines.append(f"  last commit: seq {last.get('seq', '?')} at "
+                     f"epoch {last.get('epoch', '?')}, batch "
+                     f"{last.get('nbatch', '?')}")
+    return lines
+
+
 def _anomaly_section(anoms):
     if not anoms:
         return ["anomalies: none recorded"]
@@ -366,6 +432,11 @@ def render_crash(report, top=10):
         metrics.get("counters") or {},
         _gauge_triples_from_series(metrics.get("gauges") or {}),
         _hist_entries_from_series(metrics.get("histograms") or {}))
+    out += _checkpoint_section(
+        metrics.get("counters") or {},
+        _gauge_triples_from_series(metrics.get("gauges") or {}),
+        _hist_entries_from_series(metrics.get("histograms") or {}),
+        ring)
 
     # throughput from ring batch records
     batches = [r for r in ring if r.get("kind") == "module.fit.batch"
@@ -485,6 +556,12 @@ def render_jsonl(lines, top=10):
         [(name, dict(labels), val)
          for (name, labels), val in gauges.items()],
         hist_entries)
+    out += _checkpoint_section(
+        counters,
+        [(name, dict(labels), val)
+         for (name, labels), val in gauges.items()],
+        hist_entries,
+        events)
     out += _slowest_spans(spans, top)
 
     h = hists.get("module.fit.batch.seconds")
