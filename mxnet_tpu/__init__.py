@@ -9,6 +9,7 @@ metrics/RNN cells. Import as ``import mxnet_tpu as mx``.
 from . import base
 from .base import MXNetError
 from . import telemetry  # pure-stdlib; every layer records into it
+from . import faults  # deterministic fault-injection plane + retry/breaker
 from .context import Context, cpu, gpu, tpu, current_context, num_gpus
 from . import ops  # populates the op registry (must precede nd/sym autogen)
 from . import ndarray

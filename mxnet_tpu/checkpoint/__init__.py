@@ -24,7 +24,8 @@ from . import state
 from . import manager
 from . import recovery
 from .state import capture, restore, to_host, FORMAT_VERSION
-from .manager import CheckpointManager, latest_checkpoint, restore_module
+from .manager import (CheckpointManager, latest_checkpoint,
+                      restore_module, read_committed_payload)
 from .recovery import (DeadWorkerError, recovery_generation, survivor_env,
                        reexec_survivor)
 
@@ -32,6 +33,7 @@ __all__ = [
     "state", "manager", "recovery",
     "capture", "restore", "to_host", "FORMAT_VERSION",
     "CheckpointManager", "latest_checkpoint", "restore_module",
+    "read_committed_payload",
     "DeadWorkerError", "recovery_generation", "survivor_env",
     "reexec_survivor",
 ]

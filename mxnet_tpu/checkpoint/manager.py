@@ -24,6 +24,18 @@ executor):
 * **Retention** — after every commit the oldest committed checkpoints
   beyond ``keep_last`` are deleted.
 
+* **Failure policy** (docs/faults.md) — each commit retries under the
+  shared ``faults.retry`` policy (``MXNET_RETRY_CKPT``: exponential
+  backoff, deadline budget) with the staging dir swept per attempt; a
+  seq that exhausts its retries is *quarantined* (``quarantined`` list,
+  ``ckpt.quarantined``/``ckpt.failures`` counters, ``ckpt.quarantine``
+  ring record, deferred ``wait()`` raise) and the writer thread keeps
+  serving the queue. Reads are damage-tolerant: ``restore_module``
+  falls back commit-by-commit past unreadable checkpoints
+  (``ckpt.damaged``) and never loads a partial state. The
+  ``ckpt.write`` / ``ckpt.d2h`` fault-injection points make both paths
+  deterministically testable (tests/test_faults.py).
+
 Telemetry: ``ckpt.exposed_stall.seconds`` (training-thread cost per
 save), ``ckpt.snapshot.seconds`` (background transfer+write+commit),
 counters ``ckpt.snapshots`` / ``ckpt.commits`` / ``ckpt.failures``,
@@ -37,6 +49,7 @@ Env surface (docs/env_var.md): ``MXNET_CKPT_DIR``,
 """
 from __future__ import annotations
 
+import hashlib
 import json
 import logging
 import os
@@ -47,10 +60,12 @@ import threading
 import time
 
 from ..base import MXNetError
+from .. import faults as _faults
 from .. import telemetry as _telemetry
 from . import state as _state
 
-__all__ = ["CheckpointManager", "latest_checkpoint", "restore_module"]
+__all__ = ["CheckpointManager", "latest_checkpoint", "restore_module",
+           "read_committed_payload"]
 
 _CKPT_RE = re.compile(r"^ckpt-(\d{8})$")
 
@@ -88,17 +103,50 @@ def latest_checkpoint(directory):
     return committed[-1] if committed else None
 
 
+def read_committed_payload(directory, kind=None):
+    """(seq, path, payload) of the newest committed checkpoint whose
+    payload actually READS BACK (and, when ``kind`` is given, matches
+    it), or None.
+
+    The damage-tolerance half of the atomic-commit contract: a commit
+    can rename cleanly and still be unreadable later (torn disk,
+    truncation, bit rot). Reading falls back commit-by-commit — newest
+    first — past any directory whose pickle fails to load, recording
+    each fallback (``ckpt.damaged`` counter + flight-ring record +
+    warning) and NEVER surfacing a partially-read state.
+    """
+    log_ = logging.getLogger(__name__)
+    for seq, path in reversed(_committed(directory)):
+        try:
+            with open(os.path.join(path, "manifest.json")) as f:
+                sha = json.load(f).get("sha256")
+            with open(os.path.join(path, "state.pkl"), "rb") as f:
+                payload = _state.loads_payload(f.read(), sha256=sha)
+        except Exception as exc:
+            _telemetry.counter("ckpt.damaged").inc()
+            _telemetry.flightrec.note(
+                "ckpt.damaged", seq=seq,
+                error=f"{type(exc).__name__}: {exc}")
+            log_.warning(
+                "checkpoint %s is damaged (%s: %s); falling back to "
+                "the previous commit", path, type(exc).__name__, exc)
+            continue
+        if kind is not None and payload.get("kind", "train") != kind:
+            continue
+        return seq, path, payload
+    return None
+
+
 def restore_module(module, directory):
-    """Restore a bound module from the newest committed checkpoint in
-    ``directory``; returns the cursor dict or None when the directory
-    holds no committed checkpoint (a first run resuming over an empty
-    dir starts fresh)."""
-    latest = latest_checkpoint(directory)
-    if latest is None:
+    """Restore a bound module from the newest *readable* committed
+    checkpoint in ``directory``; returns the cursor dict or None when
+    no committed checkpoint survives (a first run resuming over an
+    empty — or wholly damaged — dir starts fresh, with a warning for
+    the damaged case)."""
+    found = read_committed_payload(directory, kind="train")
+    if found is None:
         return None
-    seq, path = latest
-    with open(os.path.join(path, "state.pkl"), "rb") as f:
-        payload = _state.read_payload(f)
+    seq, path, payload = found
     cursor = _state.restore(module, payload)
     _telemetry.flightrec.note("ckpt.restore", seq=seq, **cursor)
     logging.getLogger(__name__).info(
@@ -127,10 +175,12 @@ class CheckpointManager:
         (``MXNET_CKPT_ASYNC``, default on).
     every_n_batches : int — ``Module.fit`` save cadence in retired
         batches (``MXNET_CKPT_EVERY``; 0 = epoch-end saves only).
+    retry_policy : faults.RetryPolicy — per-commit retry behavior
+        (default from ``MXNET_RETRY_CKPT``; see docs/faults.md).
     """
 
     def __init__(self, directory=None, keep_last=None, async_write=None,
-                 every_n_batches=None, logger=None):
+                 every_n_batches=None, logger=None, retry_policy=None):
         directory = directory or os.environ.get("MXNET_CKPT_DIR")
         if not directory:
             raise MXNetError("CheckpointManager needs a directory "
@@ -159,6 +209,15 @@ class CheckpointManager:
         self._error = None              # first writer failure, for wait()
         self._ticks = 0                 # fit-loop cadence counter
         self._closed = False
+        # commit-failure policy: each write retries per MXNET_RETRY_CKPT
+        # (transient full-disk/EIO survive); an exhausted seq is
+        # QUARANTINED — recorded here, writer stays alive — instead of
+        # killing the writer thread and silently backing up the queue
+        self._retry_policy = retry_policy if retry_policy is not None \
+            else _faults.RetryPolicy.from_env(
+                "CKPT", attempts=3, base_s=0.05, max_s=1.0,
+                deadline_s=30.0)
+        self.quarantined = []           # seqs abandoned after retries
 
     # ------------------------------------------------------------- saving
     def tick(self, module, epoch, nbatch):
@@ -197,6 +256,30 @@ class CheckpointManager:
             self.wait()
         return seq
 
+    def save_payload(self, payload, block=False):
+        """Queue one arbitrary host-side payload dict for an atomic
+        commit through the same writer/retry/quarantine machinery —
+        the serve warm-restart path (serve/warm.py). The payload should
+        carry ``version`` (:data:`state.FORMAT_VERSION`) so readers
+        accept it, and a ``kind`` distinguishing it from training state
+        (``restore_module`` skips non-train kinds)."""
+        if self._closed:
+            raise MXNetError("CheckpointManager is closed")
+        seq = self._seq
+        self._seq += 1
+        item = (seq, {"__host_payload__": payload})
+        if self.async_write:
+            self._ensure_writer()
+            self._queue.put(item)
+        else:
+            self._write(*item)
+        _telemetry.counter("ckpt.snapshots").inc()
+        _telemetry.flightrec.note("ckpt.snapshot", seq=seq,
+                                  payload=payload.get("kind", "payload"))
+        if block and self.async_write:
+            self.wait()
+        return seq
+
     def _ensure_writer(self):
         if self._thread is None or not self._thread.is_alive():
             self._thread = threading.Thread(
@@ -211,15 +294,27 @@ class CheckpointManager:
                 if item is None:
                     return
                 self._write(*item)
-            except Exception as exc:        # surface via wait(), not crash
-                if self._error is None:
-                    self._error = exc
-                _telemetry.counter("ckpt.failures").inc()
-                _telemetry.flightrec.note("ckpt.fail", seq=item[0],
-                                          error=f"{type(exc).__name__}: "
-                                                f"{exc}")
-                self.logger.warning("checkpoint %d failed: %s", item[0],
-                                    exc)
+            except Exception as exc:
+                # quarantine the seq: the writer thread SURVIVES (the
+                # next queued snapshot still commits), the failure is
+                # loud — counter + ring record + wait()'s deferred
+                # raise — and nothing partial is left on disk (_write
+                # sweeps its staging dir per attempt)
+                try:
+                    seq = item[0]
+                    if self._error is None:
+                        self._error = exc
+                    self.quarantined.append(seq)
+                    _telemetry.counter("ckpt.failures").inc()
+                    _telemetry.counter("ckpt.quarantined").inc()
+                    _telemetry.flightrec.note(
+                        "ckpt.quarantine", seq=seq,
+                        error=f"{type(exc).__name__}: {exc}")
+                    self.logger.warning(
+                        "checkpoint %d failed after retries, "
+                        "quarantined: %s", seq, exc)
+                except Exception:       # bookkeeping must never kill
+                    pass                # the writer thread either
             finally:
                 self._queue.task_done()
 
@@ -229,43 +324,77 @@ class CheckpointManager:
                                _hist="ckpt.snapshot.seconds", seq=seq) \
             if _telemetry.enabled() else _telemetry.null_span
         with span:
+            payload = _faults.retry_call(
+                lambda: self._commit_once(seq, snap),
+                self._retry_policy, site="ckpt.write",
+                logger=self.logger)
+        dur = time.perf_counter() - t0
+        cursor = payload.get("cursor") or {}
+        _telemetry.counter("ckpt.commits").inc()
+        _telemetry.gauge("ckpt.last_seq").set(seq)
+        _telemetry.flightrec.note("ckpt.commit", seq=seq,
+                                  dur_us=int(dur * 1e6), **cursor)
+        self._retain()
+
+    def _commit_once(self, seq, snap):
+        """One commit attempt: D2H (already-host payloads skip it),
+        serialize, fsync, rename. Every failure path removes the
+        staging dir before re-raising, so a retried or quarantined seq
+        never leaves a partial ``.tmp-`` dir for the init sweep."""
+        if isinstance(snap, dict) and "__host_payload__" in snap:
+            payload = snap["__host_payload__"]
+        else:
             payload = _state.to_host(snap)
-            tmp = os.path.join(self.directory,
-                               f".tmp-ckpt-{seq:08d}-{os.getpid()}")
-            final = os.path.join(self.directory, f"ckpt-{seq:08d}")
+        tmp = os.path.join(self.directory,
+                           f".tmp-ckpt-{seq:08d}-{os.getpid()}")
+        final = os.path.join(self.directory, f"ckpt-{seq:08d}")
+        try:
+            _faults.point("ckpt.write", seq=seq)
             os.makedirs(tmp, exist_ok=True)
             state_path = os.path.join(tmp, "state.pkl")
+            buf = _state.dumps_payload(payload)
             with open(state_path, "wb") as f:
-                _state.write_payload(payload, f)
+                f.write(buf)
                 f.flush()
                 os.fsync(f.fileno())
             manifest = {
                 "complete": True, "seq": seq,
-                "version": _state.FORMAT_VERSION,
-                "cursor": payload["cursor"],
+                "version": payload.get("version",
+                                       _state.FORMAT_VERSION),
+                "kind": payload.get("kind", "train"),
+                "sha256": hashlib.sha256(buf).hexdigest(),
+                "cursor": payload.get("cursor") or {},
                 "opt": {k: v for k, v in (payload.get("opt") or
                                           {}).items() if k != "counts"},
                 "time": time.time(),
-                "n_params": len(payload["device"]["arg_params"]),
+                "n_params": len((payload.get("device") or
+                                 {}).get("arg_params") or ()),
             }
             with open(os.path.join(tmp, "manifest.json"), "w") as f:
                 json.dump(manifest, f, indent=1)
                 f.flush()
                 os.fsync(f.fileno())
+            if os.path.exists(final):
+                # an UNCOMMITTED leftover squatting on this seq (e.g. a
+                # damaged dir that lost its manifest) is garbage this
+                # commit supersedes; a COMMITTED one must never be
+                # silently replaced
+                if any(s == seq for s, _ in _committed(self.directory)):
+                    raise MXNetError(
+                        f"checkpoint seq {seq} already committed at "
+                        f"{final}; refusing to overwrite")
+                shutil.rmtree(final, ignore_errors=True)
             os.rename(tmp, final)           # the commit point
-            try:
-                dirfd = os.open(self.directory, os.O_RDONLY)
-                os.fsync(dirfd)
-                os.close(dirfd)
-            except OSError:
-                pass                        # platform without dir fsync
-        dur = time.perf_counter() - t0
-        _telemetry.counter("ckpt.commits").inc()
-        _telemetry.gauge("ckpt.last_seq").set(seq)
-        _telemetry.flightrec.note("ckpt.commit", seq=seq,
-                                  dur_us=int(dur * 1e6),
-                                  **payload["cursor"])
-        self._retain()
+        except BaseException:
+            shutil.rmtree(tmp, ignore_errors=True)
+            raise
+        try:
+            dirfd = os.open(self.directory, os.O_RDONLY)
+            os.fsync(dirfd)
+            os.close(dirfd)
+        except OSError:
+            pass                            # platform without dir fsync
+        return payload
 
     def _retain(self):
         committed = _committed(self.directory)

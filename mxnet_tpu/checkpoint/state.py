@@ -41,6 +41,7 @@ import jax.numpy as jnp
 
 from ..base import MXNetError
 from ..ndarray import NDArray
+from .. import faults as _faults
 from .. import random as _mxrandom
 
 __all__ = ["FORMAT_VERSION", "capture", "to_host", "restore",
@@ -168,6 +169,7 @@ def to_host(snapshot):
     canonical param shape here — device-side transform on the writer
     thread, over copies the training thread no longer touches. Returns
     the pure-numpy payload ``write_payload`` pickles."""
+    _faults.point("ckpt.d2h")
     payload = {k: v for k, v in snapshot.items()
                if k != "_state_layout"}
     device = dict(snapshot["device"])
@@ -185,14 +187,39 @@ def write_payload(payload, fobj):
     pickle.dump(payload, fobj, protocol=pickle.HIGHEST_PROTOCOL)
 
 
-def read_payload(fobj):
-    payload = pickle.load(fobj)
+def dumps_payload(payload):
+    """Serialized payload bytes — the writer hashes these into the
+    manifest (``sha256``) so a read can tell torn/bit-rotted state from
+    intact state: a flipped byte mid-pickle often still *unpickles*,
+    just into silently wrong arrays."""
+    return pickle.dumps(payload, protocol=pickle.HIGHEST_PROTOCOL)
+
+
+def _check_version(payload):
     version = payload.get("version")
     if version != FORMAT_VERSION:
         raise MXNetError(
             f"checkpoint format version {version!r} is not supported "
             f"by this build (expected {FORMAT_VERSION})")
     return payload
+
+
+def loads_payload(data, sha256=None):
+    """Inverse of :func:`dumps_payload`; verifies the manifest checksum
+    first when one is recorded (pre-checksum checkpoints skip it)."""
+    if sha256 is not None:
+        import hashlib
+        got = hashlib.sha256(data).hexdigest()
+        if got != sha256:
+            raise MXNetError(
+                f"checkpoint state.pkl checksum mismatch "
+                f"(manifest {sha256[:12]}…, file {got[:12]}…): "
+                "damaged on disk")
+    return _check_version(pickle.loads(data))
+
+
+def read_payload(fobj):
+    return _check_version(pickle.load(fobj))
 
 
 def _to_staged_state(v):

@@ -1,0 +1,36 @@
+"""Deterministic fault-injection plane + shared degradation policies.
+
+Three pieces, one robustness story (docs/faults.md):
+
+* ``faults.plane`` — named injection points threaded through the
+  framework's failure seams (checkpoint commit, snapshot D2H, kvstore
+  collective, IO decode, serving dispatch/admission), armed by
+  ``MXNET_FAULTS`` or ``faults.scope(...)`` with seeded/scripted
+  triggers, compiled down to one branch when unarmed. This is what lets
+  tier-1 prove every degradation path deterministically — the FakeClock
+  of failures.
+* ``faults.retry`` — the shared :class:`RetryPolicy` / ``retry_call``
+  (exponential backoff + jitter + deadline budget, ``MXNET_RETRY_*``
+  env, telemetry counters) applied at the seams where a transient
+  failure should be survived: checkpoint writes, collective dispatch.
+* ``faults.breaker`` — :class:`CircuitBreaker` (consecutive failures
+  -> open -> half-open probe), the serving registry's per-model
+  degradation primitive.
+
+Pure stdlib + telemetry at import time, so every layer can import it
+without ordering constraints (the same rule telemetry follows).
+"""
+from __future__ import annotations
+
+from .plane import (InjectedFault, point, configure, scope, clear,
+                    enabled, fired, calls, parse_spec, KNOWN_POINTS)
+from .retry import RetryPolicy, retry_call
+from .breaker import CircuitBreaker, CircuitOpenError
+from . import plane
+from . import retry
+from . import breaker
+
+__all__ = ["InjectedFault", "point", "configure", "scope", "clear",
+           "enabled", "fired", "calls", "parse_spec", "KNOWN_POINTS",
+           "RetryPolicy", "retry_call", "CircuitBreaker",
+           "CircuitOpenError", "plane", "retry", "breaker"]

@@ -21,6 +21,7 @@ import numpy as np
 
 from .base import MXNetError
 from .ndarray import NDArray, array
+from . import faults as _faults
 from . import telemetry as _telemetry
 
 __all__ = ["DataDesc", "DataBatch", "StackedDataBatch", "DataIter",
@@ -206,13 +207,40 @@ class PrefetchingIter(DataIter):
     reference: io.py:285 (python) mirroring the C++ PrefetcherIter
     (src/io/iter_prefetcher.h): a producer thread stays one batch ahead so
     host decode overlaps device compute.
+
+    Decode-failure policy (docs/faults.md): ``on_decode_error``
+    (default ``MXNET_IO_ON_DECODE_ERROR``, else ``"raise"``) decides
+    what a failing batch fetch does. ``"raise"`` propagates to the
+    consumer (the pre-existing behavior); ``"skip"`` records the
+    failure (``io.decode.skipped`` counter, ``io.decode.skip`` ring
+    record, ``skipped_batches`` attribute) and moves on to the next
+    batch — at pod scale one corrupt record must not kill an epoch.
+    A run of more than ``MXNET_IO_DECODE_MAX_SKIP`` (default 100)
+    *consecutive* failures is a broken pipeline, not bad records, and
+    raises regardless. The ``io.decode`` injection point sits after
+    each fetch so tier-1 drives both paths deterministically.
     """
 
     def __init__(self, iters, rename_data=None, rename_label=None,
-                 device=None):
+                 device=None, on_decode_error=None, max_decode_skip=None):
         super().__init__()
         if not isinstance(iters, list):
             iters = [iters]
+        self._on_decode_error = (
+            on_decode_error if on_decode_error is not None
+            else os.environ.get("MXNET_IO_ON_DECODE_ERROR", "raise"))
+        if self._on_decode_error not in ("raise", "skip"):
+            raise MXNetError(
+                f"on_decode_error={self._on_decode_error!r} "
+                "(want 'raise' or 'skip')")
+        try:
+            self._max_decode_skip = int(
+                max_decode_skip if max_decode_skip is not None
+                else os.environ.get("MXNET_IO_DECODE_MAX_SKIP", "") or 100)
+        except ValueError:
+            self._max_decode_skip = 100
+        self.skipped_batches = 0        # cumulative skip bookkeeping
+        self._consecutive_skips = 0
         self.n_iter = len(iters)
         assert self.n_iter > 0
         self.iters = iters
@@ -311,12 +339,43 @@ class PrefetchingIter(DataIter):
                                 pads=[b.pad or 0 for b in window],
                                 index=window[0].index)
 
+    def _next_batches(self):
+        """One batch per inner iter, through the decode-failure policy:
+        the ``io.decode`` injection point fires after the fetch (the
+        batch is consumed either way, so a skip is a true skip, not a
+        silent retry of the same cursor), and a failure under the
+        ``skip`` policy records and moves on. StopIteration always
+        propagates — end-of-epoch is not a failure."""
+        while True:
+            try:
+                batches = [i.next() for i in self.iters]
+                _faults.point("io.decode")
+                self._consecutive_skips = 0
+                return batches
+            except StopIteration:
+                raise
+            except Exception as exc:
+                if self._on_decode_error != "skip":
+                    raise
+                self._consecutive_skips += 1
+                self.skipped_batches += 1
+                _telemetry.counter("io.decode.skipped").inc()
+                _telemetry.flightrec.note(
+                    "io.decode.skip", skipped=self.skipped_batches,
+                    error=f"{type(exc).__name__}: {exc}")
+                if self._consecutive_skips > self._max_decode_skip:
+                    raise MXNetError(
+                        f"{self._consecutive_skips} consecutive decode "
+                        "failures exceed MXNET_IO_DECODE_MAX_SKIP="
+                        f"{self._max_decode_skip}: the pipeline is "
+                        "broken, not the records") from exc
+
     def _producer(self):
         while not self._stop.is_set():
             try:
                 k = self._stack_k
                 if k <= 1:
-                    batches = [i.next() for i in self.iters]
+                    batches = self._next_batches()
                     if self._device is not None:
                         batches = [self._to_device(b) for b in batches]
                     self._queue.put(batches)
@@ -324,8 +383,7 @@ class PrefetchingIter(DataIter):
                 window, exhausted = [], False
                 for _ in range(k):
                     try:
-                        window.append(
-                            self._merge([i.next() for i in self.iters]))
+                        window.append(self._merge(self._next_batches()))
                     except StopIteration:
                         exhausted = True
                         break
@@ -358,7 +416,9 @@ class PrefetchingIter(DataIter):
         self._thread.start()
 
     def __del__(self):
-        self._stop.set()
+        stop = getattr(self, "_stop", None)     # ctor may have raised
+        if stop is not None:                    # before creating it
+            stop.set()
 
     def reset(self):
         self._stop.set()
@@ -371,6 +431,7 @@ class PrefetchingIter(DataIter):
             self._thread.join(timeout=1.0)
         for i in self.iters:
             i.reset()
+        self._consecutive_skips = 0
         self._queue = _queue.Queue(maxsize=2)
         self._start()
 

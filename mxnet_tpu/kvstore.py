@@ -31,6 +31,7 @@ import jax.numpy as jnp
 
 from .base import MXNetError
 from .ndarray import NDArray, zeros
+from . import faults as _faults
 from . import optimizer as opt
 from . import telemetry as _telemetry
 from .kvstore_sched import BucketScheduler
@@ -500,7 +501,49 @@ class KVStoreDistSync(KVStore):
         return chunk * self._local
 
     def _allreduce_flat(self, flat):
-        """All-reduce one 1-D buffer across all devices of all processes.
+        """All-reduce one 1-D buffer, retrying transient failures.
+
+        The dispatch is wrapped in the shared retry policy
+        (``MXNET_RETRY_COLLECTIVE``, docs/faults.md): a TRANSIENT
+        collective error (flaky DCN link, coordination-service blip, an
+        injected ``kvstore.collective`` fault) retries with backoff and
+        is invisible to the caller; a failure with an actually-dead
+        peer converts to :class:`checkpoint.DeadWorkerError` IMMEDIATELY
+        (the liveness layer decides — burning the backoff budget
+        against a peer that will never answer just delays recovery); a
+        persistent failure with every peer alive re-raises the original
+        error after the policy gives up (a real bug, not a death).
+        Retry is safe here because a failed dispatch applied nothing:
+        every worker that failed re-enters the same collective in the
+        same order (policies must match across workers — env-configured,
+        docs/faults.md). Failures surfacing later, at the flush-side
+        ``block_until_ready``, go through ``Module.fit``'s existing
+        dead-worker conversion instead.
+        """
+        def give_up(exc):
+            from .checkpoint.recovery import DeadWorkerError
+            if isinstance(exc, DeadWorkerError):
+                return exc
+            try:
+                dead = self.get_dead_nodes()
+            except Exception:
+                dead = []
+            if dead:
+                _telemetry.flightrec.note("recovery.dead_worker",
+                                          ranks=list(dead), clean=False,
+                                          where="kvstore.collective")
+                return DeadWorkerError(dead, clean=False)
+            return None
+
+        return _faults.retry_call(
+            lambda: self._allreduce_flat_once(flat),
+            _faults.RetryPolicy.from_env("COLLECTIVE", attempts=3,
+                                         base_s=0.02, max_s=0.5),
+            site="kvstore.collective", give_up=give_up,
+            logger=logging.getLogger(__name__))
+
+    def _allreduce_flat_once(self, flat):
+        """One all-reduce attempt across all devices of all processes.
 
         Layout: pad to the power-of-two size class (multiple of the
         local device count L), view as (1, L, chunk) sharded
@@ -511,6 +554,7 @@ class KVStoreDistSync(KVStore):
         local-device reduction path is identical, only the proc axis is
         trivial.
         """
+        _faults.point("kvstore.collective")
         from jax.experimental import multihost_utils
         self._ensure_mesh()
         if _telemetry.enabled():

@@ -139,6 +139,7 @@ class Predictor:
         from jax import export as jexport
         from .ndarray import load as nd_load
 
+        self._path = path       # serve/warm.py re-registers from it
         with zipfile.ZipFile(path) as z:
             self._manifest = json.loads(z.read(_MANIFEST))
             if self._manifest["format_version"] != _FORMAT_VERSION:

@@ -33,16 +33,21 @@ Config: ``MXNET_SERVE_BUCKETS`` (default bucket ladder),
 """
 from __future__ import annotations
 
+from ..faults import CircuitOpenError
 from .clock import MonotonicClock, FakeClock
 from .batching import (BucketLadder, QueueFullError, ResponseHandle,
-                       bucket_for, default_ladder, pad_rows, slice_rows)
+                       ShedError, bucket_for, default_ladder, pad_rows,
+                       slice_rows)
 from .engine import BucketEngine, PredictorEngine
 from .registry import ModelRegistry
 from .server import InferenceServer, serve
+from .warm import restore_server, save_server, server_payload
 from .loadgen import PoissonLoadGen, run_scripted
 
 __all__ = ["MonotonicClock", "FakeClock", "BucketLadder",
-           "QueueFullError", "ResponseHandle", "bucket_for",
+           "QueueFullError", "ShedError", "CircuitOpenError",
+           "ResponseHandle", "bucket_for",
            "default_ladder", "pad_rows", "slice_rows", "BucketEngine",
            "PredictorEngine", "ModelRegistry", "InferenceServer",
-           "serve", "PoissonLoadGen", "run_scripted"]
+           "serve", "restore_server", "save_server", "server_payload",
+           "PoissonLoadGen", "run_scripted"]

@@ -31,15 +31,34 @@ import numpy as np
 
 from ..base import MXNetError
 
-__all__ = ["QueueFullError", "BucketLadder", "default_ladder",
-           "bucket_for", "pad_rows", "slice_rows", "Request",
-           "ResponseHandle", "AdmissionQueue"]
+__all__ = ["QueueFullError", "ShedError", "BucketLadder",
+           "default_ladder", "bucket_for", "pad_rows", "slice_rows",
+           "Request", "ResponseHandle", "AdmissionQueue"]
 
 _req_ids = itertools.count()
 
 
 class QueueFullError(MXNetError):
-    """Admission rejected: the model's queue is at MXNET_SERVE_MAX_QUEUE."""
+    """Admission rejected: the model's queue is at MXNET_SERVE_MAX_QUEUE.
+
+    ``retry_after_ms`` (set by the server at raise time) is the
+    backpressure hint: the estimated time to drain the current queue,
+    from the scheduler's exec-time EMA and the queue depth — a client
+    that retries sooner will very likely be rejected again.
+    """
+
+    retry_after_ms = None
+
+
+class ShedError(MXNetError):
+    """An ADMITTED request was dropped by load shedding: queue depth
+    crossed the watermark and this request could no longer meet its
+    deadline even if dispatched immediately (already doomed — serving
+    it would only waste a bucket slot another request could use).
+    Counted under ``serve.shed``, distinct from ``serve.rejected``
+    (admission-time rejections)."""
+
+    retry_after_ms = None
 
 
 def default_ladder():
@@ -250,6 +269,23 @@ class AdmissionQueue:
         for a larger bucket — the pad-vs-wait break-even."""
         d = self.oldest_deadline()
         return None if d is None else d - exec_est
+
+    def shed_doomed(self, now, exec_est_fn):
+        """Remove and return every queued request that cannot meet its
+        deadline even if dispatched right now (``deadline < now +
+        exec_est_fn(rows)``) — the load-shedding pass the server runs
+        when depth crosses the shed watermark. Shedding the doomed
+        first protects requests that can still make their SLO: the
+        deadline-class ordering the ISSUE names."""
+        doomed, keep = [], collections.deque()
+        for r in self._q:
+            if r.deadline < now + exec_est_fn(r.rows):
+                doomed.append(r)
+                self.rows_pending -= r.rows
+            else:
+                keep.append(r)
+        self._q = keep
+        return doomed
 
     def drain(self, max_rows):
         """Pop FIFO-prefix requests whose rows fit in ``max_rows``."""

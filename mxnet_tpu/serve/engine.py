@@ -162,6 +162,7 @@ class BucketEngine(_EngineBase):
         self.data_names = tuple(nm for nm, _ in data_shapes)
         self.example_shapes = {nm: tuple(s) for nm, s in data_shapes}
         self._symbol = symbol
+        self._compute_dtype = compute_dtype     # for warm-restart payloads
         self._label_names = [nm for nm in (label_names or [])
                              if nm in symbol.list_arguments()]
         self._label_shape_cache = {}
@@ -268,6 +269,10 @@ class PredictorEngine(_EngineBase):
 
     def __init__(self, name, predictor, ladder=None):
         from ..predict import Predictor
+        # keep the artifact path (when there is one) so warm restarts
+        # can re-register this engine from disk (serve/warm.py)
+        self._path = predictor if isinstance(predictor, str) \
+            else getattr(predictor, "_path", None)
         if isinstance(predictor, str):
             predictor = Predictor(predictor)
         self._pred = predictor

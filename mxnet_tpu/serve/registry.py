@@ -27,26 +27,38 @@ from __future__ import annotations
 import threading
 
 from ..base import MXNetError
+from ..faults import CircuitBreaker
 from .batching import AdmissionQueue
 
 __all__ = ["ModelRegistry"]
 
 
 class _Entry:
-    __slots__ = ("engine", "queue", "last_dispatch_seq")
+    __slots__ = ("engine", "queue", "breaker", "last_dispatch_seq")
 
-    def __init__(self, engine, max_queue):
+    def __init__(self, engine, max_queue, breaker_threshold,
+                 breaker_cooldown_s):
         self.engine = engine
         self.queue = AdmissionQueue(engine.name, max_queue)
+        # per-model circuit breaker: consecutive dispatch failures open
+        # it, a half-open probe after the cooldown decides recovery
+        # (docs/faults.md; state drives both admission and next_action)
+        self.breaker = CircuitBreaker(
+            threshold=breaker_threshold, cooldown_s=breaker_cooldown_s,
+            site=f"serve:{engine.name}", labels={"model": engine.name},
+            metric_prefix="serve.breaker")
         self.last_dispatch_seq = 0
 
 
 class ModelRegistry:
-    """name -> (engine, admission queue, fairness serial)."""
+    """name -> (engine, admission queue, breaker, fairness serial)."""
 
-    def __init__(self, max_queue):
+    def __init__(self, max_queue, breaker_threshold=5,
+                 breaker_cooldown_s=1.0):
         self._entries = {}
         self._max_queue = max_queue
+        self._breaker_threshold = breaker_threshold
+        self._breaker_cooldown_s = breaker_cooldown_s
         self._seq = 0
         self._lock = threading.Lock()   # registration only; the server
                                         # lock serializes scheduling
@@ -56,7 +68,9 @@ class ModelRegistry:
             if engine.name in self._entries:
                 raise MXNetError(
                     f"model {engine.name!r} already registered")
-            self._entries[engine.name] = _Entry(engine, self._max_queue)
+            self._entries[engine.name] = _Entry(
+                engine, self._max_queue, self._breaker_threshold,
+                self._breaker_cooldown_s)
         return engine
 
     def remove(self, name):
@@ -113,15 +127,24 @@ class ModelRegistry:
     def next_action(self, now):
         """('dispatch', name) | ('wait', seconds|None), mutating nothing.
 
-        Ready = bucket full or past flush_at; ties break to the least
-        recently dispatched model. With work queued but nothing ready,
-        the wait is until the earliest flush_at; with no work at all the
-        wait is unbounded (None — sleep until a submit signals).
+        Ready = bucket full or past flush_at, AND the model's circuit
+        breaker permits a dispatch at ``now``; ties break to the least
+        recently dispatched model. A model whose breaker is open with
+        queued work contributes its probe instant (cooldown expiry) to
+        the wait bound instead. With work queued but nothing ready, the
+        wait is until the earliest flush_at/probe; with no work at all
+        the wait is unbounded (None — sleep until a submit signals).
         """
         ready, soonest = [], None
         for name, entry in self._entries.items():
             q = entry.queue
             if not len(q):
+                continue
+            if not entry.breaker.can_dispatch(now):
+                probe_in = entry.breaker.retry_after(now)
+                if probe_in > 0:
+                    soonest = now + probe_in if soonest is None \
+                        else min(soonest, now + probe_in)
                 continue
             if q.rows_pending >= entry.engine.ladder.max:
                 ready.append((entry.last_dispatch_seq, name))

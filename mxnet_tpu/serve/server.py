@@ -47,10 +47,12 @@ import threading
 
 import numpy as np
 
+from .. import faults as _faults
 from .. import program_cache as _progcache
 from .. import telemetry as _telemetry
 from ..base import MXNetError
-from .batching import Request, pad_rows, slice_rows
+from ..faults import CircuitOpenError
+from .batching import Request, ShedError, pad_rows, slice_rows
 from .clock import MonotonicClock
 from .engine import BucketEngine, PredictorEngine
 from .registry import ModelRegistry
@@ -67,11 +69,31 @@ def _env_int(name, default):
         return default
 
 
+def _env_float(name, default):
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
 class InferenceServer:
-    """Continuous-batching server over a multi-tenant model registry."""
+    """Continuous-batching server over a multi-tenant model registry.
+
+    Degradation policy (docs/faults.md): a per-model circuit breaker
+    (``breaker_threshold`` consecutive dispatch failures open it,
+    half-open probe after ``breaker_cooldown_ms``) rejects admission
+    fast while open, and when queue depth crosses
+    ``shed_watermark`` (fraction of ``max_queue``, or an absolute
+    count when >= 1) admission first *sheds* already-doomed queued
+    requests — those that cannot meet their deadline even if dispatched
+    immediately — before deciding; a full queue rejects with a
+    ``retry_after_ms`` backpressure hint derived from the exec-time EMA
+    and queue depth.
+    """
 
     def __init__(self, clock=None, max_queue=None, default_deadline_ms=None,
-                 logger=None):
+                 logger=None, breaker_threshold=None,
+                 breaker_cooldown_ms=None, shed_watermark=None):
         self._clock = clock if clock is not None else MonotonicClock()
         self._max_queue = max_queue if max_queue is not None else \
             _env_int("MXNET_SERVE_MAX_QUEUE", 1024)
@@ -79,7 +101,19 @@ class InferenceServer:
             default_deadline_ms if default_deadline_ms is not None
             else _env_int("MXNET_SERVE_DEADLINE_MS", 100)) / 1000.0
         self.logger = logger or log
-        self._registry = ModelRegistry(self._max_queue)
+        threshold = breaker_threshold if breaker_threshold is not None \
+            else _env_int("MXNET_SERVE_BREAKER_THRESHOLD", 5)
+        cooldown_s = (breaker_cooldown_ms if breaker_cooldown_ms
+                      is not None else
+                      _env_int("MXNET_SERVE_BREAKER_COOLDOWN_MS",
+                               1000)) / 1000.0
+        watermark = shed_watermark if shed_watermark is not None else \
+            _env_float("MXNET_SERVE_SHED_WATERMARK", 0.75)
+        self._shed_depth = int(watermark) if watermark >= 1 else \
+            max(1, int(watermark * self._max_queue))
+        self._registry = ModelRegistry(self._max_queue,
+                                       breaker_threshold=threshold,
+                                       breaker_cooldown_s=cooldown_s)
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
         self._thread = None
@@ -174,22 +208,70 @@ class InferenceServer:
         name = model or self._registry.sole_name()
         engine = self._registry.engine(name)
         rows, vals = engine.validate(inputs)
+        _faults.point("serve.admit", model=name)
         now = self._clock.now()
         deadline_s = (deadline_ms if deadline_ms is not None
                       else self._default_deadline_s * 1000.0) / 1000.0
         req = Request(name, vals, rows, now, now + deadline_s)
         with self._cond:
-            try:
-                self._registry.queue(name).admit(req)
-            except MXNetError:
+            entry = self._registry.entry(name)
+            if not entry.breaker.admit_allowed(now):
+                # breaker open: reject fast instead of queueing work
+                # onto a model that is structurally failing
                 _telemetry.counter("serve.rejected", model=name).inc()
+                raise CircuitOpenError(name,
+                                       entry.breaker.retry_after(now))
+            if len(entry.queue) >= self._shed_depth:
+                self._shed_doomed(entry, now)
+            try:
+                entry.queue.admit(req)
+            except MXNetError as exc:
+                _telemetry.counter("serve.rejected", model=name).inc()
+                exc.retry_after_ms = self._retry_after_ms(entry)
                 raise
-            depth = len(self._registry.queue(name))
+            depth = len(entry.queue)
             self._cond.notify_all()
         _telemetry.counter("serve.requests", model=name).inc()
         _telemetry.gauge("serve.queue.depth", model=name).set(depth)
         _telemetry.gauge("serve.queue.depth").set(self._depth_total())
         return req.handle
+
+    def _retry_after_ms(self, entry):
+        """Backpressure estimate: time to drain the model's queue at
+        the measured exec-time EMA of its largest bucket (>= 1ms so a
+        zero estimate — e.g. a FakeClock warmup — still signals
+        'later, not now')."""
+        ladder = entry.engine.ladder
+        est = entry.engine.exec_estimate(ladder.max)
+        dispatches = max(1, -(-entry.queue.rows_pending // ladder.max))
+        return max(1, int(dispatches * est * 1000))
+
+    def _shed_doomed(self, entry, now):
+        """Load-shedding pass (caller holds the lock): complete every
+        already-doomed queued request with ``ShedError`` so the slots
+        go to requests that can still meet their SLO. ``serve.shed``
+        counts these, distinct from ``serve.rejected``."""
+        name = entry.engine.name
+        ladder = entry.engine.ladder
+
+        def est(rows):
+            bucket = ladder.bucket_for(min(rows, ladder.max)) or ladder.max
+            return entry.engine.exec_estimate(bucket)
+
+        doomed = entry.queue.shed_doomed(now, est)
+        if not doomed:
+            return
+        retry_after = self._retry_after_ms(entry)
+        _telemetry.counter("serve.shed", model=name).inc(len(doomed))
+        _telemetry.flightrec.note("serve.shed", model=name,
+                                  n=len(doomed),
+                                  retry_after_ms=retry_after)
+        for r in doomed:
+            err = ShedError(
+                f"model {name!r}: request {r.id} shed at queue depth "
+                f"watermark — deadline unreachable before dispatch")
+            err.retry_after_ms = retry_after
+            r.handle._complete(error=err, now=now)
 
     def _depth_total(self):
         return sum(len(e.queue) for e in self._registry.entries())
@@ -203,8 +285,13 @@ class InferenceServer:
             if entry is None:
                 return 0
             engine = entry.engine
+            # the breaker gates every attempt: open = no dispatch,
+            # open-past-cooldown = this drain becomes the half-open probe
+            if not entry.breaker.acquire(self._clock.now()):
+                return 0
             reqs, rows = entry.queue.drain(engine.ladder.max)
             if not reqs:
+                entry.breaker.release()     # probe unused, nothing queued
                 return 0
             self._registry.note_dispatch(name)
             depth = len(entry.queue)
@@ -220,19 +307,23 @@ class InferenceServer:
                 if len(reqs) > 1 else reqs[0].inputs[nm], bucket)
             for nm in engine.data_names}
         try:
+            _faults.point("serve.dispatch", model=name, bucket=bucket)
             outs = engine.forward(bucket, values)
             import jax
             for o in outs:
                 jax.block_until_ready(o.asjax())
         except Exception as exc:    # fail the whole batch, keep serving
             now = self._clock.now()
+            entry.breaker.record_failure(now)
             for r in reqs:
                 r.handle._complete(error=exc, now=now)
             _telemetry.counter("serve.errors", model=name).inc()
             _telemetry.flightrec.note("serve.dispatch.error", model=name,
-                                      bucket=bucket, error=repr(exc))
+                                      bucket=bucket, error=repr(exc),
+                                      breaker=entry.breaker.state)
             self.logger.exception("serve: dispatch failed for %r", name)
             return len(reqs)
+        entry.breaker.record_success(self._clock.now())
         exec_s = self._clock.now() - t0
         engine.note_exec(bucket, exec_s)
 
@@ -345,6 +436,18 @@ class InferenceServer:
     def __exit__(self, *exc):
         self.stop()
 
+    # --------------------------------------------------------- warm restart
+    def checkpoint_to(self, manager, block=True):
+        """Persist the registry/ladder configuration (symbols, params,
+        shapes, ladders) through a ``CheckpointManager`` so a restarted
+        process can rebuild this server with ``serve.restore_server``
+        and serve again with zero compiles beyond warmup — the serving
+        half of the elastic-recovery story (docs/serving.md).
+        ``manager`` is a ``CheckpointManager`` or a directory string.
+        Returns the committed seq."""
+        from .warm import save_server
+        return save_server(self, manager, block=block)
+
     # ---------------------------------------------------------------- stats
     def stats(self):
         """Snapshot for dashboards/bench: per-model p50/p99 latency,
@@ -366,7 +469,9 @@ class InferenceServer:
                 "responses": c("serve.responses"),
                 "dispatches": c("serve.dispatches"),
                 "rejected": c("serve.rejected"),
+                "shed": c("serve.shed"),
                 "errors": c("serve.errors"),
+                "breaker": e.breaker.state,
                 "deadline_misses": c("serve.deadline.miss"),
                 "queue_depth": len(e.queue),
                 "latency_ms": None if h is None or not h.count else {
@@ -390,7 +495,8 @@ class InferenceServer:
 
 
 def serve(model, name="default", ladder=None, start=True, clock=None,
-          max_queue=None, default_deadline_ms=None, **register_kw):
+          max_queue=None, default_deadline_ms=None, breaker_threshold=None,
+          breaker_cooldown_ms=None, shed_watermark=None, **register_kw):
     """One-call front end: ``serve(model).submit({...})``.
 
     ``model``: a bound+initialized Module, a ``Predictor``, or a path
@@ -401,7 +507,10 @@ def serve(model, name="default", ladder=None, start=True, clock=None,
     """
     from ..predict import Predictor
     server = InferenceServer(clock=clock, max_queue=max_queue,
-                             default_deadline_ms=default_deadline_ms)
+                             default_deadline_ms=default_deadline_ms,
+                             breaker_threshold=breaker_threshold,
+                             breaker_cooldown_ms=breaker_cooldown_ms,
+                             shed_watermark=shed_watermark)
     if isinstance(model, (str, Predictor)):
         server.register(name, predictor=model, ladder=ladder,
                         **register_kw)

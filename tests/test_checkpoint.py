@@ -509,3 +509,82 @@ def test_diagnose_recovery_timeline_crash_path():
     assert "RECOVERY: 1 dead-node detection(s)" in out
     assert "recovery.dead_node" in out and "recovery.reexec" in out
     assert "last commit: seq 4 at epoch 1, batch 4" in out
+
+
+# ------------------------------------------------- resume-from-damage matrix
+def _damage_truncate_pickle(path):
+    with open(os.path.join(path, "state.pkl"), "r+b") as f:
+        f.truncate(12)
+
+
+def _damage_corrupt_pickle(path):
+    p = os.path.join(path, "state.pkl")
+    size = os.path.getsize(p)
+    with open(p, "r+b") as f:
+        f.seek(size // 2)
+        f.write(b"\xde\xad\xbe\xef" * 8)
+
+
+def _damage_missing_state(path):
+    os.remove(os.path.join(path, "state.pkl"))
+
+
+def _damage_missing_manifest(path):
+    # an interrupted retention delete / fs repair can leave a ckpt-*
+    # dir without its manifest: it must simply not count as committed
+    os.remove(os.path.join(path, "manifest.json"))
+
+
+@pytest.mark.parametrize("damage", [
+    _damage_truncate_pickle, _damage_corrupt_pickle,
+    _damage_missing_state, _damage_missing_manifest,
+], ids=["truncated", "corrupt", "no-state", "no-manifest"])
+def test_resume_falls_back_past_damaged_newest(tmp_path, damage):
+    """The damage matrix (ISSUE 10 satellite): whatever happened to the
+    newest checkpoint dir — truncated pickle, corrupt bytes, missing
+    state.pkl, missing manifest — fit(resume=...) falls back to the
+    previous commit with a warning, never crashes, and never loads a
+    partial state."""
+    d = str(tmp_path / "ck")
+    _run(kill_at=(0, 5), ckpt=d, every=2)   # commits at cursors 2 and 4
+    committed = mx.checkpoint.CheckpointManager(d).list_committed()
+    assert len(committed) == 2
+    damage(committed[-1][1])
+    _, ac, _ = _run(ckpt=d, resume=True)
+    # resumed from the PREVIOUS commit (cursor 2), not the damaged one
+    assert ac[0][:2] == (0, 2)
+
+
+def test_resume_all_damaged_starts_fresh(tmp_path):
+    """Every commit unreadable -> resume warns and trains from scratch
+    (cursor None), exactly like an empty directory — never a crash."""
+    d = str(tmp_path / "ck")
+    _run(kill_at=(0, 5), ckpt=d, every=2)
+    mgr = mx.checkpoint.CheckpointManager(d)
+    for _seq, path in mgr.list_committed():
+        _damage_truncate_pickle(path)
+    from mxnet_tpu.telemetry import metrics as _metrics
+    before = _metrics.get_metric("ckpt.damaged")
+    before = before.value if before else 0
+    _, ac, _ = _run(ckpt=d, resume=True)
+    assert ac[0][:2] == (0, 0)              # fresh start
+    assert _metrics.get_metric("ckpt.damaged").value >= before + 2
+
+
+def test_quarantined_seq_numbering_continues(tmp_path):
+    """A quarantined seq stays burned: later commits use later seqs, so
+    a half-written seq can never be confused with a committed one."""
+    from mxnet_tpu import faults
+    d = str(tmp_path / "ck")
+    pol = faults.RetryPolicy(attempts=2, base_s=0, jitter=0)
+    mgr = mx.checkpoint.CheckpointManager(d, retry_policy=pol)
+    _, _, mod = _run(num_epoch=1)
+    with faults.scope("ckpt.write:always"):
+        bad = mgr.save(mod, 0, 1)
+        with pytest.raises(Exception):
+            mgr.wait()          # inside the scope: the writer thread
+                                # must see the armed plane
+    good = mgr.save(mod, 0, 2, block=True)
+    assert good == bad + 1
+    assert [s for s, _ in mgr.list_committed()] == [good]
+    mgr.close()

@@ -366,6 +366,92 @@ def _checkpoint_section(counters, gauge_triples, hist_entries, records):
     return lines
 
 
+_BREAKER_STATES = {0: "closed", 1: "half-open", 2: "OPEN"}
+
+_FAULT_RECORD_KINDS = ("fault.injected", "retry.attempt", "retry.giveup",
+                       "serve.shed", "serve.breaker.transition",
+                       "io.decode.skip", "ckpt.quarantine", "ckpt.damaged")
+
+
+def _faults_section(counters, gauge_triples, records):
+    """Fault-plane / degradation health (mxnet_tpu/faults, docs/
+    faults.md): injections fired per point, retry totals per site,
+    circuit-breaker states and transitions, shed counts, decode skips,
+    quarantined/damaged checkpoints — rendered only when any of it
+    happened."""
+    def _by_label(metric, label):
+        out = {}
+        for series, val in (counters or {}).items():
+            name, labelstr = _strip_labels(series)
+            if name != metric:
+                continue
+            key = "?"
+            for part in labelstr.split(","):
+                if part.strip().startswith(f"{label}="):
+                    key = part.partition("=")[2].strip().strip('"')
+            out[key] = out.get(key, 0) + val
+        return out
+
+    injected = _by_label("faults.injected", "point")
+    attempts = _by_label("retry.attempts", "site")
+    retries = _by_label("retry.retries", "site")
+    giveups = _by_label("retry.giveups", "site")
+    shed = _by_label("serve.shed", "model")
+    transitions = _by_label("serve.breaker.transitions", "to")
+    breaker_state = {}
+    for name, labels, val in gauge_triples:
+        if name == "serve.breaker.state":
+            breaker_state[labels.get("model", "?")] = val
+    flat = {_strip_labels(k)[0]: v for k, v in (counters or {}).items()}
+    skipped = flat.get("io.decode.skipped", 0)
+    quarantined = flat.get("ckpt.quarantined", 0)
+    damaged = flat.get("ckpt.damaged", 0)
+    fault_records = [r for r in (records or [])
+                     if r.get("kind") in _FAULT_RECORD_KINDS]
+    open_breakers = {m: v for m, v in breaker_state.items() if v}
+
+    if not (injected or retries or giveups or shed or transitions or
+            skipped or quarantined or damaged or fault_records or
+            open_breakers):
+        return []
+
+    lines = ["faults / degradation:"]
+    if injected:
+        total = int(sum(injected.values()))
+        lines.append(
+            f"  injections fired: {total} "
+            f"({', '.join(f'{p} x{int(n)}' for p, n in sorted(injected.items()))})")
+    for site in sorted(set(retries) | set(giveups)):
+        lines.append(
+            f"  retries [{site}]: {int(retries.get(site, 0))} retried "
+            f"over {int(attempts.get(site, 0))} attempts"
+            + (f", {int(giveups[site])} GAVE UP"
+               if giveups.get(site) else ""))
+    for m in sorted(breaker_state):
+        state = _BREAKER_STATES.get(int(breaker_state[m]),
+                                    breaker_state[m])
+        if breaker_state[m] or transitions:
+            lines.append(f"  breaker [{m}]: {state}"
+                         + (f" ({int(transitions.get('open', 0))} trips)"
+                            if transitions.get("open") else ""))
+    for m, n in sorted(shed.items()):
+        lines.append(f"  load shed [{m}]: {int(n)} request(s) "
+                     "(doomed-deadline shedding)")
+    if skipped:
+        lines.append(f"  decode skips: {int(skipped)} batch(es) "
+                     "skipped-with-record")
+    if quarantined:
+        lines.append(f"  checkpoint: {int(quarantined)} seq(s) "
+                     "QUARANTINED after retries")
+    if damaged:
+        lines.append(f"  checkpoint: {int(damaged)} damaged commit(s) "
+                     "skipped at restore")
+    for r in fault_records[-5:]:
+        desc = {k: v for k, v in r.items() if k not in ("kind", "ts_us")}
+        lines.append(f"    {r.get('kind', '?')} {desc}")
+    return lines
+
+
 def _anomaly_section(anoms):
     if not anoms:
         return ["anomalies: none recorded"]
@@ -436,6 +522,10 @@ def render_crash(report, top=10):
         metrics.get("counters") or {},
         _gauge_triples_from_series(metrics.get("gauges") or {}),
         _hist_entries_from_series(metrics.get("histograms") or {}),
+        ring)
+    out += _faults_section(
+        metrics.get("counters") or {},
+        _gauge_triples_from_series(metrics.get("gauges") or {}),
         ring)
 
     # throughput from ring batch records
@@ -561,6 +651,11 @@ def render_jsonl(lines, top=10):
         [(name, dict(labels), val)
          for (name, labels), val in gauges.items()],
         hist_entries,
+        events)
+    out += _faults_section(
+        counters,
+        [(name, dict(labels), val)
+         for (name, labels), val in gauges.items()],
         events)
     out += _slowest_spans(spans, top)
 
