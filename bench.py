@@ -302,6 +302,88 @@ def measure_serve_variant():
         return {"error": f"{type(e).__name__}: {e}"}
 
 
+def measure_quant_serve_variant():
+    """The ``quant`` serve variant row: req/s at the p99 SLO through the
+    continuous-batching server, int8 ladder vs the float ladder, same
+    model/load — the int8 inference tier's capacity multiplier
+    (ROADMAP 4). The int8 engine binds the quantized graph
+    (``compute_dtype="int8"`` → ops/quant.py rewrite), so its rungs pin
+    quantized programs; the ``compiles_since_warmup == 0`` contract is
+    asserted per side. Runs on whatever backend the process has (the
+    dequant-fused Pallas kernel is autotuned on TPU, interpret-gated
+    off it). Never sinks the run."""
+    import numpy as np
+    import mxnet_tpu as mx
+
+    SLO_MS = 100
+
+    def one_side(compute_dtype, tag):
+        data = mx.sym.var("data")
+        fc = mx.sym.FullyConnected(data=data, num_hidden=256, name="qv1")
+        act = mx.sym.Activation(fc, act_type="relu")
+        fc2 = mx.sym.FullyConnected(act, num_hidden=64, name="qv2")
+        sym = mx.sym.SoftmaxOutput(fc2, name="softmax")
+        mod = mx.mod.Module(sym)
+        mod.bind([("data", (8, 64))], [("softmax_label", (8,))],
+                 for_training=False)
+        mod.init_params(mx.initializer.Xavier())
+        server = mx.serve.serve(mod, name=tag, ladder=[1, 2, 4, 8],
+                                default_deadline_ms=SLO_MS,
+                                compute_dtype=compute_dtype)
+        gen = mx.serve.PoissonLoadGen(
+            server,
+            lambda i, rng: {"data": rng.rand(1 + i % 3, 64)
+                            .astype(np.float32)},
+            model=tag, rate=150.0, n_requests=200, seed=0)
+        try:
+            out = gen.run(slo_ms=SLO_MS)
+        finally:
+            server.stop()
+        stats = server.stats()
+        out["compiles_since_warmup"] = stats["compiles_since_warmup"]
+        out["quantized"] = stats["models"][tag]["quantized"]
+        return out
+
+    try:
+        base = one_side(None, "qbase")
+        int8 = one_side("int8", "qint8")
+        row = {"float": base, "int8": int8}
+        if base.get("req_per_sec") and int8.get("req_per_sec"):
+            row["int8_speedup"] = round(
+                int8["req_per_sec"] / base["req_per_sec"], 3)
+        return row
+    except Exception as e:          # the variant must never sink the run
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
+def measure_remat_memory_variant():
+    """Residual-byte delta per remat policy at the resnet20 bench point
+    (benchmarks/remat_memory.py): the roofline-side record of what
+    ``MXNET_REMAT_POLICY`` frees and which batch bucket that admits.
+    Never sinks the run."""
+    try:
+        from benchmarks.remat_memory import main as remat_lap
+        return remat_lap(quiet=True)
+    except Exception as e:          # the variant must never sink the run
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
+def kernel_tier_selection_table():
+    """The kernel-tier audit for the BENCH payload: per-op selection
+    decisions (variant, reason, measured ms) + cache stats, so the r06
+    measurement lands with the selection evidence attached."""
+    try:
+        from mxnet_tpu import kernel_tier
+        rows = [{k: d.get(k) for k in ("op", "variant", "reason",
+                                       "xla_ms", "pallas_ms", "source",
+                                       "is_train")}
+                for d in kernel_tier.decisions()]
+        return {"mode": os.environ.get("MXNET_KERNEL_TIER", "auto"),
+                "decisions": rows, "cache": kernel_tier.cache_info()}
+    except Exception as e:
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
 def measure_ckpt_variant():
     """The ``ckpt`` variant row: exposed training stall per snapshot,
     async vs synchronous write, at the resnet20 bench point
@@ -394,7 +476,10 @@ def run_cpu_fallback():
         "roofline": roofline_rows,
         "spmd": measure_spmd_variant(),
         "serve": measure_serve_variant(),
+        "quant": measure_quant_serve_variant(),
         "ckpt": measure_ckpt_variant(),
+        "remat_memory": measure_remat_memory_variant(),
+        "kernel_tier_selection": kernel_tier_selection_table(),
         "note": "accelerator backend unavailable; ours-only fused-step "
                 "throughput on the XLA CPU backend at a CIFAR-scale "
                 "operating point — NOT comparable to the flax-paired "
@@ -605,9 +690,18 @@ def main():
     _log("serve variant (Poisson open-loop vs p99 SLO)")
     serve_variant = measure_serve_variant()
 
+    # quant variant: the same serve protocol, int8 ladder vs float —
+    # the low-precision tier's capacity multiplier (ROADMAP 4)
+    _log("quant variant (int8 vs float serve ladder)")
+    quant_variant = measure_quant_serve_variant()
+
     # ckpt variant: async-vs-sync exposed snapshot stall (ROADMAP 5)
     _log("ckpt variant (checkpoint_stall paired lap)")
     ckpt_variant = measure_ckpt_variant()
+
+    # remat variant: per-policy residual bytes + admitted batch bucket
+    _log("remat variant (residual bytes per policy)")
+    remat_variant = measure_remat_memory_variant()
 
     # per-op MFU attribution + roofline from the registry cost metadata
     # (telemetry/mfu.py): coverage is attributed FLOPs over the XLA
@@ -676,7 +770,10 @@ def main():
         "pallas_smoke": pallas_smoke,
         "spmd": spmd_variant,
         "serve": serve_variant,
+        "quant": quant_variant,
         "ckpt": ckpt_variant,
+        "remat_memory": remat_variant,
+        "kernel_tier_selection": kernel_tier_selection_table(),
         "mfu_ours": mfu(ours_img_s, ours_flops),
         "mfu_flax": mfu(flax_img_s, flax_flops),
         "mfu_model_attributed": mfu(ours_img_s, attributed_flops),

@@ -219,11 +219,143 @@ def smoke_adam(shape=None):
             "xla_ms": round(t_xla * 1e3, 2)}
 
 
+def smoke_layernorm(N=None, C=None):
+    """Mosaic-compile the fused LayerNorm fwd + hand-bwd kernels at a
+    transformer-block shape and gate value+grad against the LayerNorm
+    XLA composition."""
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.ops.registry import get_op
+    from mxnet_tpu.ops.pallas_kernels import fused_layernorm
+
+    on_tpu = jax.default_backend() == "tpu"
+    N = N or (4096 if on_tpu else 64)
+    C = C or (1024 if on_tpu else 128)
+    ln = get_op("LayerNorm")
+    attrs = ln.normalize_attrs({})
+    rng = np.random.RandomState(5)
+    x = jnp.asarray(rng.randn(N, C).astype(np.float32))
+    g = jnp.asarray(rng.rand(C).astype(np.float32) + 0.5)
+    b = jnp.asarray(rng.randn(C).astype(np.float32))
+
+    def loss(fn):
+        return lambda xx: (fn(xx) ** 2).sum()
+
+    pal_f = jax.jit(lambda xx: fused_layernorm(xx, g, b)[0])
+    xla_f = jax.jit(lambda xx: ln.forward(attrs, [xx, g, b], [],
+                                          True, None)[0][0])
+    err = float(jnp.max(jnp.abs(pal_f(x) - xla_f(x))))
+    pal_g = jax.jit(jax.grad(loss(pal_f)))
+    xla_g = jax.jit(jax.grad(loss(xla_f)))
+    gerr = float(jnp.max(jnp.abs(pal_g(x) - xla_g(x))))
+    ok = bool(err < 2e-4 and gerr < 2e-2)
+    t_pal = _time_median(lambda: _force(pal_f(x)))
+    t_xla = _time_median(lambda: _force(xla_f(x)))
+    return {"ok": ok, "max_abs_err": max(err, gerr), "shape": [N, C],
+            "pallas_ms": round(t_pal * 1e3, 2),
+            "xla_ms": round(t_xla * 1e3, 2)}
+
+
+def smoke_bias_gelu(N=None, C=None):
+    """Mosaic-compile the fused bias+GeLU epilogue (fwd + hand dx
+    kernel) at an MLP-block shape against the XLA composition."""
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.ops.pallas_kernels import fused_bias_gelu, \
+        _bias_gelu_xla
+
+    on_tpu = jax.default_backend() == "tpu"
+    N = N or (8192 if on_tpu else 64)
+    C = C or (4096 if on_tpu else 128)
+    rng = np.random.RandomState(6)
+    x = jnp.asarray(rng.randn(N, C).astype(np.float32))
+    b = jnp.asarray(rng.randn(C).astype(np.float32))
+
+    pal = jax.jit(lambda xx, bb: fused_bias_gelu(xx, bb))
+    xla = jax.jit(lambda xx, bb: _bias_gelu_xla({}, xx, bb))
+    err = float(jnp.max(jnp.abs(pal(x, b) - xla(x, b))))
+    pg = jax.jit(jax.grad(lambda xx: (pal(xx, b) ** 2).sum()))
+    xg = jax.jit(jax.grad(lambda xx: (xla(xx, b) ** 2).sum()))
+    gerr = float(jnp.max(jnp.abs(pg(x) - xg(x))))
+    ok = bool(err < 2e-4 and gerr < 2e-3)
+    t_pal = _time_median(lambda: _force(pal(x, b)))
+    t_xla = _time_median(lambda: _force(xla(x, b)))
+    return {"ok": ok, "max_abs_err": max(err, gerr), "shape": [N, C],
+            "pallas_ms": round(t_pal * 1e3, 2),
+            "xla_ms": round(t_xla * 1e3, 2)}
+
+
+def smoke_embedding(N=None, V=None, D=None):
+    """Mosaic-compile the scalar-prefetch embedding gather at an
+    LM-vocabulary shape against jnp.take, incl. the scatter-add bwd."""
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.ops.pallas_kernels import fused_embedding
+
+    on_tpu = jax.default_backend() == "tpu"
+    N = N or (8192 if on_tpu else 64)
+    V = V or (32768 if on_tpu else 512)
+    D = D or (512 if on_tpu else 128)
+    rng = np.random.RandomState(7)
+    ids = jnp.asarray((rng.rand(N) * V).astype(np.int32))
+    w = jnp.asarray(rng.randn(V, D).astype(np.float32))
+
+    pal = jax.jit(lambda ww: fused_embedding(ids, ww))
+    xla = jax.jit(lambda ww: jnp.take(ww, ids, axis=0))
+    err = float(jnp.max(jnp.abs(pal(w) - xla(w))))
+    pg = jax.jit(jax.grad(lambda ww: (pal(ww) ** 2).sum()))
+    xg = jax.jit(jax.grad(lambda ww: (xla(ww) ** 2).sum()))
+    gerr = float(jnp.max(jnp.abs(pg(w) - xg(w))))
+    ok = bool(err == 0.0 and gerr < 1e-4)
+    t_pal = _time_median(lambda: _force(pal(w)))
+    t_xla = _time_median(lambda: _force(xla(w)))
+    return {"ok": ok, "max_abs_err": max(err, gerr), "shape": [N, V, D],
+            "pallas_ms": round(t_pal * 1e3, 2),
+            "xla_ms": round(t_xla * 1e3, 2)}
+
+
+def smoke_int8_dense(M=None, N=None, K=None):
+    """Mosaic-compile the int8 dequant-fused dense kernel against its
+    f32-dequant XLA composition (the int8 inference tier's hot rung)."""
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.ops.registry import get_op
+    from mxnet_tpu.ops.quant import quantize_per_channel
+
+    on_tpu = jax.default_backend() == "tpu"
+    M = M or (1024 if on_tpu else 32)
+    N = N or (4096 if on_tpu else 64)
+    K = K or (4096 if on_tpu else 128)
+    qfc = get_op("QuantizedFullyConnected")
+    attrs = qfc.normalize_attrs({"num_hidden": N, "no_bias": True})
+    rng = np.random.RandomState(8)
+    x = jnp.asarray(rng.randn(M, K).astype(np.float32))
+    wq_np, s_np = quantize_per_channel(rng.randn(N, K).astype(np.float32))
+    wq, s = jnp.asarray(wq_np), jnp.asarray(s_np)
+
+    def run(fn):
+        return jax.jit(lambda xx: fn(attrs, [xx, wq, s], [], False,
+                                     None)[0][0])
+
+    xla, pal = run(qfc.forward), run(qfc.variant_fn("pallas"))
+    err = float(jnp.max(jnp.abs(xla(x) - pal(x))))
+    ok = bool(err < 2e-2)
+    t_pal = _time_median(lambda: _force(pal(x)))
+    t_xla = _time_median(lambda: _force(xla(x)))
+    return {"ok": ok, "max_abs_err": err, "shape": [M, N, K],
+            "pallas_ms": round(t_pal * 1e3, 2),
+            "xla_ms": round(t_xla * 1e3, 2)}
+
+
 _SMOKES = (("flash_attention", smoke_flash_attention),
            ("sgd_mom_update", smoke_sgd_mom),
            ("adam_update", smoke_adam),
            ("softmax_cross_entropy", smoke_softmax_ce),
-           ("fused_conv_bn_relu", smoke_conv_bn_relu))
+           ("fused_conv_bn_relu", smoke_conv_bn_relu),
+           ("layernorm", smoke_layernorm),
+           ("bias_gelu", smoke_bias_gelu),
+           ("embedding", smoke_embedding),
+           ("int8_dense", smoke_int8_dense))
 
 
 def _write_report(res):

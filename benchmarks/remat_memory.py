@@ -1,0 +1,115 @@
+"""Remat-policy memory benchmark at the resnet20 bench point.
+
+Measures what ``MXNET_REMAT_POLICY`` actually buys: the fused train
+step's saved-residual bytes (the activations stored between the forward
+and backward halves of the one XLA program — ``remat.residual_bytes``,
+a pure trace, backend-independent) under each policy, plus the
+batch-bucket headroom math: with a budget calibrated to "the ``none``
+policy just fits at the bench batch", which larger batch bucket does
+each policy admit (``telemetry.memory.batch_headroom``)?
+
+Writes ``benchmarks/results/remat_memory.json``; the tests gate
+``all < dots < none`` and bench.py attaches the summary to the BENCH
+payload so the r06 measurement records the roofline delta alongside
+the kernel-tier selections.
+
+    python benchmarks/remat_memory.py
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+
+import numpy as np  # noqa: E402
+
+BATCH = 32
+BUCKETS = (32, 64, 128, 256)
+
+
+def measure(batch=BATCH, num_layers=20, quiet=False):
+    """Residual bytes per policy for one resnet20 fused-step binding.
+    Returns the result dict (never raises into bench.py)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import remat
+    from mxnet_tpu.models import resnet
+    from mxnet_tpu.telemetry.memory import batch_headroom
+
+    sym = resnet.get_symbol(num_classes=10, num_layers=num_layers,
+                            image_shape="3,32,32")
+    rng = np.random.RandomState(0)
+    imgs = rng.rand(2 * batch, 3, 32, 32).astype(np.float32)
+    labels = (rng.rand(2 * batch) * 10).astype(np.float32)
+
+    reports = {}
+    for policy in remat.POLICIES:
+        remat.set_active(None)
+        mx.random.seed(0)
+        it = mx.io.NDArrayIter(imgs, labels, batch_size=batch)
+        mod = mx.mod.Module(sym, context=mx.cpu())
+        mod.fit(it, num_epoch=1, initializer=mx.initializer.Xavier(),
+                optimizer_params={"learning_rate": 0.05,
+                                  "momentum": 0.9}, remat=policy)
+        rep = mod._exec_group.fused_memory_report()
+        reports[policy] = rep
+        if not quiet:
+            print(f"[remat_memory] {policy:>4}: residual "
+                  f"{rep['residual_bytes'] / 1e6:.2f} MB  donate "
+                  f"{rep['donated_args']}", file=sys.stderr)
+    remat.set_active(None)
+
+    # headroom: budget = fixed + what `none` needs at the bench batch —
+    # i.e. exactly the machine the unrematerialized step saturates; the
+    # admitted bucket per policy shows the freed bytes becoming batch
+    fixed = reports["none"]["param_bytes"] + \
+        reports["none"]["state_bytes"]
+    per_sample = {p: (r["residual_bytes"] + r["batch_bytes"]) / batch
+                  for p, r in reports.items()}
+    budget = fixed + per_sample["none"] * batch
+    admitted = {p: batch_headroom(budget, fixed, per_sample[p], BUCKETS)
+                for p in reports}
+
+    out = {
+        "batch": batch,
+        "buckets": list(BUCKETS),
+        "policies": {p: {
+            "residual_bytes": r["residual_bytes"],
+            "residual_mb": round(r["residual_bytes"] / 1e6, 3),
+            "donated_args": r["donated_args"],
+            "admitted_bucket": admitted[p],
+        } for p, r in reports.items()},
+        "fixed_bytes": int(fixed),
+        "budget_bytes": int(budget),
+        "residual_ratio_all_vs_none": round(
+            reports["all"]["residual_bytes"]
+            / max(1, reports["none"]["residual_bytes"]), 4),
+        "gate_all_lt_none": bool(reports["all"]["residual_bytes"]
+                                 < reports["none"]["residual_bytes"]),
+        "gate_dots_lt_none": bool(reports["dots"]["residual_bytes"]
+                                  < reports["none"]["residual_bytes"]),
+    }
+    return out
+
+
+def main(quiet=False):
+    try:
+        out = measure(quiet=quiet)
+    except Exception as e:      # bench variant must never sink the run
+        return {"error": f"{type(e).__name__}: {e}"}
+    try:
+        results_dir = os.path.join(os.path.dirname(
+            os.path.abspath(__file__)), "results")
+        os.makedirs(results_dir, exist_ok=True)
+        with open(os.path.join(results_dir, "remat_memory.json"),
+                  "w") as f:
+            json.dump(out, f, indent=1)
+    except OSError:
+        pass
+    return out
+
+
+if __name__ == "__main__":
+    print(json.dumps(main(), indent=1))

@@ -25,6 +25,7 @@ from .attribute import AttrScope
 from .name import NameManager, Prefix
 from .executor import Executor
 from . import program_cache
+from . import remat  # fused-step rematerialization/donation policy
 from . import analysis  # bind-time graph verifier & hazard linter
 from . import io
 from . import recordio

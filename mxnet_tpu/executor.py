@@ -593,8 +593,13 @@ class Executor:
         return self._prog_cache_base + (kind,) + extras
 
     def _get_program(self, kind):
+        from . import remat as _remat
         naive = naive_engine_active()
-        cache_key = (kind, naive)
+        # the staged gradient program honors the remat policy too (the
+        # fused step applies it in executor_group); the policy rides
+        # both cache keys so flipping it mid-process re-traces
+        remat_policy = _remat.active() if kind == "fwd_bwd" else "none"
+        cache_key = (kind, naive, remat_policy)
         fn = self._jit_cache.get(cache_key)
         if fn is not None:
             if _telemetry.enabled():
@@ -602,7 +607,9 @@ class Executor:
             return fn
         gkey = None
         if not naive:
-            extras = (tuple(self._watched()),) if kind == "fwd_bwd" else ()
+            extras = (tuple(self._watched()),
+                      ("remat", remat_policy)) if kind == "fwd_bwd" \
+                else ()
             gkey = self.program_cache_key(kind, *extras)
             if gkey is not None:
                 fn = _progcache.get(gkey)
@@ -637,6 +644,7 @@ class Executor:
                                            True, rng)
                     return outs, new_aux
 
+                f = _remat.wrap(f, remat_policy)
                 outs, vjp_fn, new_aux = jax.vjp(f, w, has_aux=True)
                 grads, = vjp_fn(head_grads)
                 return outs, new_aux, grads

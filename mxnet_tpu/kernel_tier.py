@@ -116,8 +116,15 @@ def _key(opdef, attrs, shapes, dtypes, is_train):
     tok = _attr_token(attrs)
     if tok is None:
         return None
+    # the remat policy rides the key alongside the program-cache token:
+    # under "all"/"dots" a kernel's forward re-executes inside the
+    # backward, so a winner measured under "none" is not evidence — a
+    # persisted selection must never leak across policies (the same
+    # rule the fused-step program cache applies)
+    from . import remat as _remat
     return (opdef.name, _backend(), tok,
-            tuple(tuple(s) for s in shapes), tuple(dtypes), bool(is_train))
+            tuple(tuple(s) for s in shapes), tuple(dtypes), bool(is_train),
+            ("remat", _remat.active()))
 
 
 # ------------------------------------------------------ persisted winners

@@ -439,7 +439,7 @@ class BaseModule:
             begin_epoch=0, num_epoch=None, validation_metric=None,
             monitor=None, steps_per_dispatch=None, zero_stage=None,
             spmd=None, mesh=None, checkpoint=None, resume=None,
-            elastic=None):
+            elastic=None, remat=None):
         """The training loop (reference base_module.py:368-507 contract).
 
         ``steps_per_dispatch`` (default ``MXNET_STEPS_PER_DISPATCH``,
@@ -488,6 +488,17 @@ class BaseModule:
         at the next batch boundary) instead of hanging in a collective
         against a dead peer — the caller re-forms the job over the
         survivors (``checkpoint.reexec_survivor``) and resumes.
+
+        ``remat`` (default ``MXNET_REMAT_POLICY``, else ``"none"``):
+        rematerialization policy for the fused/K-step program —
+        ``"dots"`` recomputes the elementwise chains between saved
+        matmul/conv outputs during backward, ``"all"`` replays the
+        whole forward — shrinking the step's saved-residual set so the
+        HBM freed by ZeRO and the memory accountant buys the
+        next-larger batch bucket (docs/performance.md). The policy
+        keys the program cache and the kernel-tier autotune cache, and
+        extends donation to the step's eval-only intermediates (rng
+        chain, fully-refreshed aux).
         """
         from ..initializer import Uniform
         from ..checkpoint import CheckpointManager, DeadWorkerError
@@ -503,6 +514,11 @@ class BaseModule:
             self._spmd = bool(spmd)
         if mesh is not None:
             self._mesh_config = mesh
+        if remat is not None:
+            from .. import remat as _remat_mod
+            # pin process-wide so the kernel-tier autotune key sees the
+            # same policy token the program-cache key carries
+            self._remat = _remat_mod.set_active(remat)
 
         # checkpointing arrangement: explicit kwarg > MXNET_CKPT_DIR env
         # (the env path only engages on modules with an executor group —

@@ -176,6 +176,15 @@ class DataParallelExecutorGroup:
         arg_shapes, out_shapes, aux_shapes = \
             self.symbol.infer_shape(**shapes)
         arg_types = {d.name: d.dtype for d in self.data_shapes}
+        # params declared with an explicit var dtype bind a cell of that
+        # dtype (the int8 tier's quantized weights — set_params would
+        # otherwise silently upcast them into a float32 cell, wasting
+        # the HBM the quantization bought); analysis rule GV105 audits
+        # the same declaration
+        for n in self.symbol._topo_nodes():
+            if n.is_variable and n._extra.get("__dtype__") and \
+                    n.name not in arg_types:
+                arg_types[n.name] = np.dtype(n._extra["__dtype__"])
 
         if self._spmd_plan is not None:
             # lower ctx_group tags onto the model axis now that shapes
@@ -251,7 +260,7 @@ class DataParallelExecutorGroup:
                              if name in self.executor.arg_dict]
 
     # ------------------------------------------------------- fused training
-    def setup_fused_step(self, optimizer, zero_stage=0):
+    def setup_fused_step(self, optimizer, zero_stage=0, remat=None):
         """Compile forward+backward+optimizer-update into ONE jitted XLA
         program (the TPU-native analog of the reference's bulk train
         segment, graph_executor.cc:678-756, plus its fused update ops).
@@ -262,13 +271,25 @@ class DataParallelExecutorGroup:
         optimizer state, and the new params all-gather back — otherwise
         the replicated (all-reduce) plan runs unchanged.
 
+        ``remat`` (default ``MXNET_REMAT_POLICY``, else ``none``)
+        applies a rematerialization policy to the step's differentiated
+        forward — ``dots`` keeps matmul/conv outputs saved and
+        recomputes the elementwise chains between them, ``all`` replays
+        the whole forward inside the backward — and additionally
+        donates the step's eval-only intermediates (the rng key chain
+        and, when the training forward refreshes every aux entry, the
+        aux buffers). The policy is part of the program-cache key and
+        of the kernel-tier autotune key (mxnet_tpu/remat.py).
+
         Per-batch work then becomes: slice batch -> async device_put ->
         one XLA dispatch -> buffer swaps. Returns False when the
         optimizer or binding can't express it (imperative path remains).
         """
         from ..executor import naive_engine_active
+        from .. import remat as _remat
         self._zero_plan = None
         self._state_layout = None
+        self._remat_policy = _remat.resolve(remat)
         plan = optimizer.fused_plan()
         if plan is None or not self.for_training or self.inputs_need_grad:
             return False
@@ -341,6 +362,8 @@ class DataParallelExecutorGroup:
                     dst._set(jnp.full(dst.shape, jnp.nan,
                                       dst.asjax().dtype))
 
+        remat_policy = self._remat_policy
+
         # lr/wd arrive as TWO stacked f32 arrays, not 2x161 python
         # scalars: scalar jit args each become their own host->device
         # transfer per dispatch, which through a remote chip is hundreds
@@ -354,6 +377,10 @@ class DataParallelExecutorGroup:
 
             def f(wv):
                 return runner({**rest, **wv}, aux_vals, True, rng)
+
+            # remat policy: shrink the saved-residual set of this vjp
+            # (identity under "none" — the traced program is unchanged)
+            f = _remat.wrap(f, remat_policy)
 
             outs, vjp_fn, new_aux = jax.vjp(f, w, has_aux=True)
             heads = [jnp.ones(o.shape, o.dtype) if is_loss
@@ -426,19 +453,34 @@ class DataParallelExecutorGroup:
         # data/label entries that _load_batch can alias to iterator
         # arrays, and donating those would delete the caller's buffers
         # out from under it (measured: "Array has been deleted" in eval
-        # paths sharing those arrays). Aux (BN stats) stays undonated for
-        # the same reason: eval paths read the same cells mid-epoch.
+        # paths sharing those arrays). Aux (BN stats) stays undonated by
+        # default for the same reason: eval paths read the same cells
+        # mid-epoch. A remat policy extends the donation set to the
+        # step's eval-only intermediates — the rng key chain, and the
+        # aux buffers when the training forward provably refreshes EVERY
+        # aux entry (cells then re-point at the returned buffers before
+        # any reader runs; an aux entry the step passes through untouched
+        # would leave a deleted buffer behind, so partial coverage keeps
+        # aux undonated).
+        donate = (0, 4)
+        if remat_policy != "none":
+            donate = (0, 3, 4)
+            if self._aux_fully_refreshed():
+                donate = (0, 2, 3, 4)
+        self._fused_donate = donate
         self._step_core = step      # pure; the scan program re-uses it
         self._fused_keep_grads = keep_grads
         # the comm-plan token keys the traced collective structure:
         # replicated all-reduce vs reduce-scatter/shard-update/all-gather
-        # trace differently even for identical symbols and optimizers
+        # trace differently even for identical symbols and optimizers;
+        # the remat token keys the checkpoint-policy + donation shape
         zero_armed = zero_plan is not None or \
             (spmd_plan is not None and spmd_plan.zero)
         self._fused_cache_key = exe.program_cache_key(
             "fused_step", tuple(watched), tuple(metric_pairs), keep_grads,
             optimizer.fused_plan_token(),
-            ("comm", "rs" if zero_armed else "ar"))
+            ("comm", "rs" if zero_armed else "ar"),
+            ("remat", remat_policy))
         self._fused_prog = None
         if self._fused_cache_key is not None:
             self._fused_prog = _progcache.get(self._fused_cache_key)
@@ -449,7 +491,7 @@ class DataParallelExecutorGroup:
             if _telemetry.enabled():
                 _telemetry.counter("executor.jit_cache.miss").inc()
             self._fused_prog = _telemetry.wrap_dispatch(
-                jax.jit(step, donate_argnums=(0, 4)), "fused_step")
+                jax.jit(step, donate_argnums=donate), "fused_step")
             if self._fused_cache_key is not None:
                 _progcache.put(self._fused_cache_key, self._fused_prog)
         self._scan_prog = None      # K-step lax.scan program (lazy)
@@ -490,6 +532,78 @@ class DataParallelExecutorGroup:
                     return jax.device_put(x, self._repl_sharding)
                 self._fused_states[nm] = jax.tree.map(_put, init_state(w))
         return True
+
+    def _aux_fully_refreshed(self):
+        """Does one training forward return a new value for EVERY aux
+        entry? (True for the BatchNorm moving-stat contract — and the
+        empty-aux case.) Gates aux donation under a remat policy: a
+        pass-through aux entry would otherwise be left as a deleted
+        buffer in its cell. Pure trace (``jax.eval_shape``)."""
+        import jax as _jax
+        exe = self.executor
+        if not exe.aux_names:
+            return True
+        try:
+            _outs, new_aux = _jax.eval_shape(
+                lambda a, x, r: exe._runner(a, x, True, r),
+                exe._arg_vals(), exe._aux_vals(),
+                _jax.random.PRNGKey(0))
+            return set(new_aux) == set(exe.aux_names)
+        except Exception:
+            return False
+
+    def fused_memory_report(self):
+        """Byte accounting of the armed fused step under the active
+        remat policy: the VJP residual set (the activations stored
+        between the forward and backward halves — what a remat policy
+        shrinks), plus the param/batch footprints for headroom math.
+        Trace-only (``remat.residual_bytes``); returns None when the
+        fused step is not armed. Mirrored into ``memory.fused.*`` gauges
+        for diagnose/bench consumption."""
+        import jax as _jax
+        from .. import remat as _remat
+        if getattr(self, "_step_core", None) is None:
+            return None
+        exe = self.executor
+
+        def nbytes(tree):
+            return int(sum(
+                int(np.prod(v.shape)) * v.dtype.itemsize
+                for v in _jax.tree_util.tree_leaves(tree)))
+
+        arg_vals = exe._arg_vals()
+        w = {nm: arg_vals.pop(nm) for nm in self._fused_watched}
+        aux_vals = exe._aux_vals()
+        rng = _jax.random.PRNGKey(0)
+        runner = exe._runner
+
+        def f(wv):
+            return runner({**arg_vals, **wv}, aux_vals, True, rng)
+
+        policy = getattr(self, "_remat_policy", "none")
+        try:
+            resid = _remat.residual_bytes(_remat.wrap(f, policy), w)
+        except Exception:
+            return None
+        batch_names = set(self.data_names) | set(self.label_names)
+        report = {
+            "policy": policy,
+            "residual_bytes": resid,
+            "param_bytes": nbytes(w),
+            "state_bytes": nbytes(self._fused_states),
+            "batch_bytes": nbytes([v for nm, v in arg_vals.items()
+                                   if nm in batch_names]),
+            "batch_size": self.batch_size,
+            "donated_args": list(getattr(self, "_fused_donate", (0, 4))),
+        }
+        for k in ("residual_bytes", "param_bytes", "state_bytes",
+                  "batch_bytes"):
+            _telemetry.gauge(f"memory.fused.{k}",
+                             policy=policy).set(report[k])
+        _telemetry.flightrec.note("memory.fused_step", **{
+            k: report[k] for k in ("policy", "residual_bytes",
+                                   "param_bytes", "batch_bytes")})
+        return report
 
     # ----------------------------------------------- fused-state transport
     def export_fused_states(self):
@@ -672,8 +786,15 @@ class DataParallelExecutorGroup:
                 return
         if _telemetry.enabled():
             _telemetry.counter("executor.jit_cache.miss").inc()
+        # a remat policy extends donation to the aux carry: the scan
+        # body threads the FULL aux dict through the carry, so every
+        # entry comes back as a (possibly aliased) output buffer and the
+        # cells re-point at it — safe without the per-entry cover check
+        # the single step needs
+        donate = (0, 1, 2, 3) if getattr(
+            self, "_remat_policy", "none") != "none" else (0, 1, 2)
         fn = _telemetry.wrap_dispatch(
-            jax.jit(scan_fn, donate_argnums=(0, 1, 2)), "scan_step")
+            jax.jit(scan_fn, donate_argnums=donate), "scan_step")
         if gkey is not None:
             _progcache.put(gkey, fn)
         self._scan_prog, self._scan_K = fn, K

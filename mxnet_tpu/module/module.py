@@ -73,6 +73,7 @@ class Module(BaseModule):
         self._zero_stage = None         # None -> MXNET_ZERO_STAGE, else 0
         self._spmd = None               # None -> MXNET_SPMD at bind time
         self._mesh_config = None        # parallel.MeshConfig (spmd mode)
+        self._remat = None              # None -> MXNET_REMAT_POLICY
 
     # ------------------------------------------------------------ checkpoint
     @staticmethod
@@ -341,7 +342,8 @@ class Module(BaseModule):
                 and self._exec_group.executor._monitor_callback is None):
             self._fused_armed = bool(
                 self._exec_group.setup_fused_step(optimizer,
-                                                  zero_stage=zero_stage))
+                                                  zero_stage=zero_stage,
+                                                  remat=self._remat))
         if spmd_plan is not None and not self._fused_armed:
             self.logger.warning(
                 "spmd requested but the fused train step could not arm "

@@ -7,4 +7,5 @@ from . import optimizer_op  # noqa: F401 — registers fused updates
 from . import rnn_op  # noqa: F401 — registers the fused RNN
 from .. import operator as _custom_op  # noqa: F401 — registers Custom
 from . import pallas_kernels  # noqa: F401 — Pallas kernel-tier variants
+from . import quant  # noqa: F401 — int8 PTQ ops + graph rewrite
 from . import cost  # noqa: F401 — seeds flops/bytes metadata (MFU)

@@ -169,6 +169,65 @@ def _rnn_bytes(attrs, in_shapes):
     return _B * (_sum_elems(in_shapes) + out)
 
 
+def _qfc_flops(attrs, in_shapes):
+    # dense matmul + per-element weight dequant; bias add when present
+    data_s = in_shapes[0]
+    num_hidden = parse_int(attrs["num_hidden"])
+    n = data_s[0]
+    in_dim = _prod(data_s[1:])
+    flops = 2.0 * n * in_dim * num_hidden + num_hidden * in_dim
+    if not parse_bool(attrs.get("no_bias", False)):
+        flops += n * num_hidden
+    return flops
+
+
+def _qfc_bytes(attrs, in_shapes):
+    # int8 weights move at 1 B/element — the tier's whole point; data,
+    # scales, bias and output stay at the 4 B accounting width
+    data_s, w_s = in_shapes[0], in_shapes[1]
+    num_hidden = parse_int(attrs["num_hidden"])
+    float_elems = _prod(data_s) + data_s[0] * num_hidden + \
+        sum(_prod(s) for s in in_shapes[2:] if s is not None)
+    return _B * float_elems + 1.0 * _prod(w_s)
+
+
+def _qconv_flops(attrs, in_shapes):
+    w_s = in_shapes[1]
+    return _conv_flops(attrs, in_shapes) + float(_prod(w_s))
+
+
+def _qconv_bytes(attrs, in_shapes):
+    data_s, w_s = in_shapes[0], in_shapes[1]
+    nf = parse_int(attrs["num_filter"])
+    out = data_s[0] * nf * _prod(_conv_out_spatial(attrs, data_s))
+    float_elems = _prod(data_s) + out + \
+        sum(_prod(s) for s in in_shapes[2:] if s is not None)
+    return _B * float_elems + 1.0 * _prod(w_s)
+
+
+def _embedding_cost():
+    # gather: ids + the N looked-up rows move; the untouched vocabulary
+    # rows do not (one-pass gather, fused or not)
+    def flops(attrs, in_shapes):
+        return 0.0
+
+    def nbytes(attrs, in_shapes):
+        ids = _prod(in_shapes[0])
+        d = in_shapes[1][1]
+        return _B * (ids + 2.0 * ids * d)
+
+    return flops, nbytes
+
+
+def _attention_flops(attrs, in_shapes):
+    b, h, t, d = in_shapes[0]
+    return 4.0 * b * h * t * t * d
+
+
+def _attention_bytes(attrs, in_shapes):
+    return _B * 2.0 * _sum_elems(in_shapes)
+
+
 def _dot_flops(attrs, in_shapes):
     a, b = in_shapes[0], in_shapes[1]
     ta = parse_bool(attrs.get("transpose_a", False))
@@ -267,6 +326,11 @@ _SPECIFIC = {
     "dot": (_dot_flops, _dot_bytes),
     "batch_dot": (_dot_flops, _dot_bytes),
     "BatchNorm": _ew(10.0, writes=1),
+    "LayerNorm": _ew(8.0),
+    "FusedBiasGeLU": _ew(10.0),          # erf ≈ several VPU ops
+    "QuantizedFullyConnected": (_qfc_flops, _qfc_bytes),
+    "QuantizedConvolution": (_qconv_flops, _qconv_bytes),
+    "attention": (_attention_flops, _attention_bytes),
     "InstanceNorm": _ew(10.0),
     "L2Normalization": _ew(4.0),
     "LRN": _ew(8.0),
@@ -279,16 +343,14 @@ _SPECIFIC = {
     "Dropout": _ew(2.0),
     "Activation": _ew(1.0),
     "LeakyReLU": _ew(2.0),
-    "Embedding": _move(),
+    "Embedding": _embedding_cost(),
     "sgd_update": _opt_cost(4.0, 3),
     "sgd_mom_update": _opt_cost(6.0, 5),
     "adam_update": _opt_cost(12.0, 7),
     "rmsprop_update": _opt_cost(8.0, 5),
     "rmspropalex_update": _opt_cost(12.0, 9),
     "pallas_sgd_mom_update": _opt_cost(6.0, 5),
-    "pallas_flash_attention": (
-        lambda attrs, s: 4.0 * s[0][0] * s[0][1] * s[0][2] ** 2 * s[0][3],
-        lambda attrs, s: _B * 2.0 * _sum_elems(s)),
+    "pallas_flash_attention": (_attention_flops, _attention_bytes),
     "LinearRegressionOutput": _ew(2.0),
     "LogisticRegressionOutput": _ew(4.0),
     "MAERegressionOutput": _ew(2.0),

@@ -328,6 +328,16 @@ def _ID_INFER(attrs, in_shapes, out_known=None):
     return [merged] + list(in_shapes[1:]), [merged], []
 
 
+def gelu_exact(x):
+    """Exact (erf-based) GeLU in f32, cast back to the input dtype —
+    the shared definition the Activation op, the LeakyReLU gelu mode,
+    and the FusedBiasGeLU epilogue (ops/pallas_kernels.py) all lower
+    through, so the kernel tier's numerics gate compares one function."""
+    x32 = x.astype(jnp.float32)
+    y = 0.5 * x32 * (1.0 + lax.erf(x32 * np.float32(0.7071067811865476)))
+    return y.astype(x.dtype)
+
+
 @register("Activation", inputs=("data",), attr_spec={"act_type": (None, "relu")},
           infer_shape=_ID_INFER)
 def _activation(attrs, x):
@@ -342,6 +352,8 @@ def _activation(attrs, x):
         return jax.nn.softplus(x)
     if t == "softsign":
         return x / (1 + jnp.abs(x))
+    if t == "gelu":
+        return gelu_exact(x)
     raise ValueError(f"act_type {t}")
 
 
@@ -379,6 +391,9 @@ def _lrelu_fwd(attrs, inputs, aux, is_train, rng):
         else:
             slope_r = (lo + hi) / 2.0
         return [jnp.where(x > 0, x, slope_r * x)], []
+    if t == "gelu":
+        # reference ships gelu through LeakyReLU(act_type='gelu')
+        return [gelu_exact(x)], []
     raise ValueError(f"act_type {t}")
 
 
@@ -485,6 +500,44 @@ def _instance_norm(attrs, data, gamma, beta):
     bshape = (1, -1) + (1,) * (data.ndim - 2)
     return (data - mean) * lax.rsqrt(var + eps) * gamma.reshape(bshape) + \
         beta.reshape(bshape)
+
+
+def _ln_infer(attrs, in_shapes):
+    data_s = in_shapes[0]
+    if data_s is None:
+        return in_shapes, [None, None, None], []
+    axis = parse_int(attrs.get("axis", -1)) % len(data_s)
+    c = (data_s[axis],)
+    red = tuple(d for i, d in enumerate(data_s) if i != axis)
+    return [data_s, c, c], [data_s, red, red], []
+
+
+def _ln_fwd(attrs, inputs, aux, is_train, rng):
+    """LayerNorm (reference: layer_norm-inl.h) — per-sample statistics
+    over one axis. Outputs [out, mean, std]; statistics accumulate in
+    float32 regardless of input dtype (same rule as BatchNorm)."""
+    data, gamma, beta = inputs
+    axis = parse_int(attrs.get("axis", -1)) % data.ndim
+    eps = parse_float(attrs.get("eps", 1e-5))
+    x32 = data.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=axis)
+    var = jnp.var(x32, axis=axis)
+    std = jnp.sqrt(var + eps)
+    bshape = [1] * data.ndim
+    bshape[axis] = -1
+    me = jnp.expand_dims(mean, axis)
+    rstd = jnp.expand_dims(lax.rsqrt(var + eps), axis)
+    out = (x32 - me) * rstd * gamma.astype(jnp.float32).reshape(bshape) \
+        + beta.astype(jnp.float32).reshape(bshape)
+    return [out.astype(data.dtype), mean, std], []
+
+
+register("LayerNorm", inputs=("data", "gamma", "beta"), full=_ln_fwd,
+         num_outputs=3, output_names=["output", "mean", "std"],
+         num_visible=lambda attrs: 3 if attrs.get("output_mean_var") else 1,
+         attr_spec={"axis": (parse_int, -1), "eps": (parse_float, 1e-5),
+                    "output_mean_var": (parse_bool, False)},
+         infer_shape=_ln_infer)
 
 
 @register("L2Normalization", inputs=("data",),

@@ -19,6 +19,22 @@ alternative on an op whose ``forward`` stays the exact XLA composition:
   rtc.py correctness demo) and ``adam_update`` variants: the whole
   elementwise update in one tiled VMEM pass per parameter.
 
+The memory-bound sweep (ROADMAP 4) widened the tier with three more
+families, each fusing what the roofline section of diagnose.py names as
+HBM-round-trip chains:
+
+* **fused LayerNorm** — a ``LayerNorm`` variant: one row-block VMEM
+  pass for the forward (whole rows resident, f32 statistics) and
+  hand-written backward kernels (a dx row pass plus a dgamma/dbeta
+  accumulation pass) instead of XLA's mean/var/normalize chain;
+* **fused bias+GeLU** — the ``FusedBiasGeLU`` op: the dense→GeLU
+  epilogue as one VMEM pass (bias add + erf GeLU), with a hand dx
+  kernel; composes with ``FullyConnected(no_bias=True)`` so the matmul
+  output is touched exactly once more;
+* **fused embedding lookup** — an ``Embedding`` variant: scalar-
+  prefetched ids drive the weight BlockSpec's index map (one-pass
+  gather + optional scale), backward is a scatter-add.
+
 Every kernel carries a custom VJP. Where a hand backward kernel exists
 (softmax-CE) it is used; elsewhere the backward recomputes through the
 XLA composition under ``jax.custom_vjp`` (the flash-attention recompute
@@ -38,7 +54,8 @@ from ..base import parse_bool, parse_float, parse_int
 from .registry import OP_REGISTRY, get_op, register
 
 __all__ = ["pallas_call", "pallas_sgd_mom_update", "pallas_adam_update",
-           "fused_softmax_ce", "fused_conv_bn_relu"]
+           "fused_softmax_ce", "fused_conv_bn_relu", "fused_layernorm",
+           "fused_bias_gelu", "fused_embedding"]
 
 
 def _interpret():
@@ -534,6 +551,341 @@ def _opt_variant(op_name, kernel_builder, n_in, n_out):
     return variant, eligible
 
 
+# ==========================================================================
+# fused LayerNorm (LayerNorm pallas variant): one VMEM pass forward
+# (whole rows resident, f32 statistics), hand-written backward kernels
+# ==========================================================================
+def _ln_fwd_kernel(eps):
+    def kernel(x_ref, g_ref, b_ref, y_ref, mean_ref, rstd_ref):
+        x = x_ref[...].astype(jnp.float32)            # (block_n, C)
+        mean = jnp.mean(x, axis=-1, keepdims=True)
+        d = x - mean
+        var = jnp.mean(d * d, axis=-1, keepdims=True)
+        rstd = jax.lax.rsqrt(var + eps)
+        g = g_ref[...].astype(jnp.float32)            # (1, C)
+        b = b_ref[...].astype(jnp.float32)
+        y_ref[...] = (d * rstd * g + b).astype(y_ref.dtype)
+        mean_ref[...] = mean
+        rstd_ref[...] = rstd
+    return kernel
+
+
+def _ln_bwd_dx_kernel(x_ref, g_ref, ct_ref, mean_ref, rstd_ref, dx_ref):
+    x = x_ref[...].astype(jnp.float32)
+    ct = ct_ref[...].astype(jnp.float32)
+    g = g_ref[...].astype(jnp.float32)                # (1, C)
+    rstd = rstd_ref[...]                              # (block_n, 1)
+    xh = (x - mean_ref[...]) * rstd
+    gy = ct * g
+    m1 = jnp.mean(gy, axis=-1, keepdims=True)
+    m2 = jnp.mean(gy * xh, axis=-1, keepdims=True)
+    dx_ref[...] = (rstd * (gy - m1 - xh * m2)).astype(dx_ref.dtype)
+
+
+def _ln_bwd_dparams_kernel(x_ref, ct_ref, mean_ref, rstd_ref,
+                           dg_ref, db_ref):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        dg_ref[...] = jnp.zeros(dg_ref.shape, jnp.float32)
+        db_ref[...] = jnp.zeros(db_ref.shape, jnp.float32)
+
+    x = x_ref[...].astype(jnp.float32)
+    ct = ct_ref[...].astype(jnp.float32)
+    xh = (x - mean_ref[...]) * rstd_ref[...]
+    dg_ref[...] += jnp.sum(ct * xh, axis=0)[None, :]
+    db_ref[...] += jnp.sum(ct, axis=0)[None, :]
+
+
+def _ln_specs(n, c):
+    bn = _row_blocks(n, c)
+    row = pl.BlockSpec((bn, c), lambda i: (i, 0))
+    stat = pl.BlockSpec((bn, 1), lambda i: (i, 0))
+    par = pl.BlockSpec((1, c), lambda i: (0, 0))
+    return bn, row, stat, par
+
+
+def _pl_layernorm_fwd(x2, gamma, beta, eps):
+    n, c = x2.shape
+    bn, row, stat, par = _ln_specs(n, c)
+    f32 = jnp.float32
+    return pallas_call(
+        _ln_fwd_kernel(eps),
+        out_shape=[jax.ShapeDtypeStruct((n, c), x2.dtype),
+                   jax.ShapeDtypeStruct((n, 1), f32),
+                   jax.ShapeDtypeStruct((n, 1), f32)],
+        grid=(n // bn,), in_specs=[row, par, par],
+        out_specs=[row, stat, stat])(
+            x2, gamma.reshape(1, c), beta.reshape(1, c))
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _ln_pl_fn(x2, gamma, beta, eps):
+    return _pl_layernorm_fwd(x2, gamma, beta, eps)
+
+
+def _ln_pl_fwd_rule(x2, gamma, beta, eps):
+    y, mean, rstd = _pl_layernorm_fwd(x2, gamma, beta, eps)
+    return (y, mean, rstd), (x2, gamma, mean, rstd)
+
+
+def _ln_pl_bwd_rule(eps, res, cts):
+    # mean/std are statistic outputs (hidden unless output_mean_var);
+    # their cotangents are treated as zero, like BatchNorm's
+    x2, gamma, mean, rstd = res
+    ct = cts[0]
+    n, c = x2.shape
+    bn, row, stat, par = _ln_specs(n, c)
+    dx = pallas_call(
+        _ln_bwd_dx_kernel,
+        out_shape=jax.ShapeDtypeStruct((n, c), x2.dtype),
+        grid=(n // bn,), in_specs=[row, par, row, stat, stat],
+        out_specs=row)(x2, gamma.reshape(1, c), ct, mean, rstd)
+    dg, db = pallas_call(
+        _ln_bwd_dparams_kernel,
+        out_shape=[jax.ShapeDtypeStruct((1, c), jnp.float32)] * 2,
+        grid=(n // bn,), in_specs=[row, row, stat, stat],
+        out_specs=[pl.BlockSpec((1, c), lambda i: (0, 0))] * 2)(
+            x2, ct, mean, rstd)
+    return (dx, dg.reshape(c).astype(gamma.dtype),
+            db.reshape(c).astype(gamma.dtype))
+
+
+_ln_pl_fn.defvjp(_ln_pl_fwd_rule, _ln_pl_bwd_rule)
+
+
+def fused_layernorm(data, gamma, beta, eps=1e-5):
+    """Functional surface of the fused LayerNorm kernel (last axis).
+
+    Returns ``(out, mean, std)`` with mean/std shaped like
+    ``data.shape[:-1]`` — the LayerNorm op's output contract."""
+    c = data.shape[-1]
+    x2 = data.reshape(-1, c)
+    y, mean, rstd = _ln_pl_fn(x2, gamma, beta, float(eps))
+    lead = data.shape[:-1]
+    return (y.reshape(data.shape), mean.reshape(lead),
+            (1.0 / rstd).reshape(lead))
+
+
+def _layernorm_variant(attrs, inputs, aux, is_train, rng):
+    data, gamma, beta = inputs
+    eps = parse_float(attrs.get("eps", 1e-5))
+    y, mean, std = fused_layernorm(data, gamma, beta, eps)
+    return [y, mean, std], []
+
+
+def _layernorm_eligible(attrs, in_shapes, in_dtypes):
+    data_s = in_shapes[0]
+    if len(data_s) < 2:
+        return False
+    axis = parse_int(attrs.get("axis", -1))
+    if axis not in (-1, len(data_s) - 1):
+        return False
+    return data_s[-1] <= 65536 and str(in_dtypes[0]) in (
+        "float32", "bfloat16", "float16")
+
+
+def _register_layernorm_variant():
+    ln = get_op("LayerNorm")
+    if "pallas" not in ln.variants:
+        ln.add_variant("pallas", _layernorm_variant,
+                       eligible=_layernorm_eligible)
+
+
+# ==========================================================================
+# fused bias + GeLU epilogue (FusedBiasGeLU op): the dense→GeLU pattern
+# collapses to ONE VMEM pass over the matmul output instead of XLA's
+# bias-add / erf / mul chain each re-touching HBM
+# ==========================================================================
+_INV_SQRT2 = 0.7071067811865476
+_INV_SQRT2PI = 0.3989422804014327
+
+
+def _bias_gelu_core(x32):
+    return 0.5 * x32 * (1.0 + jax.lax.erf(x32 * _INV_SQRT2))
+
+
+def _bias_gelu_kernel(x_ref, b_ref, o_ref):
+    x = x_ref[...].astype(jnp.float32) + b_ref[...].astype(jnp.float32)
+    o_ref[...] = _bias_gelu_core(x).astype(o_ref.dtype)
+
+
+def _bias_gelu_dx_kernel(x_ref, b_ref, ct_ref, dx_ref):
+    z = x_ref[...].astype(jnp.float32) + b_ref[...].astype(jnp.float32)
+    phi = jnp.exp(-0.5 * z * z) * _INV_SQRT2PI
+    dgelu = 0.5 * (1.0 + jax.lax.erf(z * _INV_SQRT2)) + z * phi
+    dx_ref[...] = (ct_ref[...].astype(jnp.float32) * dgelu).astype(
+        dx_ref.dtype)
+
+
+def _pl_bias_gelu(x2, bias, kernel):
+    n, c = x2.shape
+    bn, row, _stat, par = _ln_specs(n, c)
+    in_specs = [row, par] + ([row] if kernel is _bias_gelu_dx_kernel
+                             else [])
+
+    def call(*ops):
+        return pallas_call(
+            kernel, out_shape=jax.ShapeDtypeStruct((n, c), x2.dtype),
+            grid=(n // bn,), in_specs=in_specs, out_specs=row)(*ops)
+    return call
+
+
+@jax.custom_vjp
+def _bias_gelu_fn(x2, bias):
+    return _pl_bias_gelu(x2, bias, _bias_gelu_kernel)(
+        x2, bias.reshape(1, -1))
+
+
+def _bias_gelu_fwd_rule(x2, bias):
+    return _bias_gelu_fn(x2, bias), (x2, bias)
+
+
+def _bias_gelu_bwd_rule(res, ct):
+    x2, bias = res
+    # dx: one hand-written VMEM pass; dbias: the (C,) column reduce of
+    # dx, left to XLA (a single well-fused reduction)
+    dx = _pl_bias_gelu(x2, bias, _bias_gelu_dx_kernel)(
+        x2, bias.reshape(1, -1), ct)
+    db = jnp.sum(dx.astype(jnp.float32), axis=0).astype(bias.dtype)
+    return dx, db
+
+
+_bias_gelu_fn.defvjp(_bias_gelu_fwd_rule, _bias_gelu_bwd_rule)
+
+
+def fused_bias_gelu(data, bias):
+    """Functional surface of the fused bias+GeLU epilogue kernel."""
+    c = data.shape[-1]
+    return _bias_gelu_fn(data.reshape(-1, c), bias).reshape(data.shape)
+
+
+def _bias_gelu_xla(attrs, data, bias):
+    # the exact composition (bias add + erf GeLU), accumulated in f32
+    # like the kernel so both tiers share one numeric definition
+    bshape = (1,) * (data.ndim - 1) + (-1,)
+    x32 = data.astype(jnp.float32) + \
+        bias.astype(jnp.float32).reshape(bshape)
+    return _bias_gelu_core(x32).astype(data.dtype)
+
+
+def _bias_gelu_variant(attrs, inputs, aux, is_train, rng):
+    data, bias = inputs
+    return [fused_bias_gelu(data, bias)], []
+
+
+def _bias_gelu_eligible(attrs, in_shapes, in_dtypes):
+    data_s, bias_s = in_shapes[0], in_shapes[1]
+    if len(data_s) < 2 or tuple(bias_s) != (data_s[-1],):
+        return False
+    return data_s[-1] <= 65536 and str(in_dtypes[0]) in (
+        "float32", "bfloat16", "float16")
+
+
+def _bias_gelu_infer(attrs, in_shapes, out_known=None):
+    data_s = in_shapes[0]
+    if out_known and out_known[0] is not None and data_s is None:
+        data_s = out_known[0]
+    c = (data_s[-1],) if data_s is not None else None
+    return [data_s, c], [data_s], []
+
+
+def _register_bias_gelu():
+    if "FusedBiasGeLU" in OP_REGISTRY:
+        return
+    register("FusedBiasGeLU", inputs=("data", "bias"),
+             simple=_bias_gelu_xla, infer_shape=_bias_gelu_infer,
+             variants={"pallas": (_bias_gelu_variant,
+                                  _bias_gelu_eligible)})
+
+
+_register_bias_gelu()
+
+
+# ==========================================================================
+# fused embedding lookup (Embedding pallas variant): one-pass gather
+# (+ optional scale) driven by scalar-prefetched ids — the row index IS
+# the weight BlockSpec's index_map — with a scatter-add backward
+# ==========================================================================
+def _emb_gather_kernel(scale):
+    def kernel(ids_ref, w_ref, o_ref):
+        x = w_ref[...]
+        if scale != 1.0:
+            x = (x.astype(jnp.float32) * scale).astype(o_ref.dtype)
+        o_ref[...] = x
+    return kernel
+
+
+def _pl_embedding(ids, weight, scale):
+    from jax.experimental.pallas import tpu as pltpu
+    n = ids.shape[0]
+    _v, d = weight.shape
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1, grid=(n,),
+        in_specs=[pl.BlockSpec((1, d), lambda i, ids_ref:
+                               (ids_ref[i], 0))],
+        out_specs=pl.BlockSpec((1, d), lambda i, ids_ref: (i, 0)))
+    return pallas_call(
+        _emb_gather_kernel(scale),
+        out_shape=jax.ShapeDtypeStruct((n, d), weight.dtype),
+        grid_spec=grid_spec)(ids, weight)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _emb_fn(data, weight, scale):
+    ids = data.astype(jnp.int32).ravel()
+    out = _pl_embedding(ids, weight, scale)
+    return out.reshape(tuple(data.shape) + (weight.shape[1],))
+
+
+def _emb_fwd_rule(data, weight, scale):
+    # weight rides the residuals only for its shape/dtype (it is a live
+    # parameter either way — no extra buffer is stored)
+    return _emb_fn(data, weight, scale), (data, weight)
+
+
+def _emb_bwd_rule(scale, res, ct):
+    data, weight = res
+    ids = data.astype(jnp.int32).ravel()
+    ct32 = ct.reshape(-1, weight.shape[1]).astype(jnp.float32)
+    if scale != 1.0:
+        ct32 = ct32 * scale
+    dw = jnp.zeros(weight.shape, jnp.float32).at[ids].add(ct32)
+    return jnp.zeros_like(data), dw.astype(weight.dtype)
+
+
+_emb_fn.defvjp(_emb_fwd_rule, _emb_bwd_rule)
+
+
+def fused_embedding(data, weight, scale=1.0):
+    """Functional surface of the fused embedding-lookup kernel."""
+    return _emb_fn(data, weight, float(scale))
+
+
+def _embedding_variant(attrs, inputs, aux, is_train, rng):
+    data, weight = inputs
+    return [_emb_fn(data, weight,
+                    parse_float(attrs.get("scale", 1.0)))], []
+
+
+def _embedding_eligible(attrs, in_shapes, in_dtypes):
+    w_s = in_shapes[1] if len(in_shapes) > 1 else None
+    if w_s is None or len(w_s) != 2 or len(in_shapes[0]) < 1:
+        return False
+    if str(in_dtypes[1]) not in ("float32", "bfloat16", "float16"):
+        return False
+    # Mosaic wants lane-aligned rows; interpret mode (off-TPU) takes any
+    return w_s[1] % 128 == 0 or _interpret()
+
+
+def _register_embedding_variant():
+    emb = get_op("Embedding")
+    if "pallas" not in emb.variants:
+        emb.add_variant("pallas", _embedding_variant,
+                        eligible=_embedding_eligible)
+
+
 def _register_opt_variants():
     sgd = get_op("sgd_mom_update")
     if "pallas" not in sgd.variants:
@@ -555,3 +907,5 @@ def _register_softmax_ce_variant():
 
 _register_opt_variants()
 _register_softmax_ce_variant()
+_register_layernorm_variant()
+_register_embedding_variant()

@@ -516,10 +516,18 @@ def _embedding_infer(attrs, in_shapes):
 @register("Embedding", inputs=("data", "weight"),
           attr_spec={"input_dim": (parse_int, None),
                      "output_dim": (parse_int, None),
-                     "dtype": (None, "float32")},
+                     "dtype": (None, "float32"),
+                     "scale": (parse_float, 1.0)},
           infer_shape=_embedding_infer)
 def _embedding(attrs, data, weight):
-    return jnp.take(weight, data.astype(jnp.int32), axis=0)
+    out = jnp.take(weight, data.astype(jnp.int32), axis=0)
+    # optional post-lookup scale (transformer embedding-sharing wants
+    # sqrt(d_model)); the 1.0 default is skipped so pre-scale graphs
+    # stay bit-exact
+    scale = parse_float(attrs.get("scale", 1.0))
+    if scale != 1.0:
+        out = out * jnp.asarray(scale, out.dtype)
+    return out
 
 
 @register("take", inputs=("a", "indices"),

@@ -29,7 +29,10 @@ where ``kind`` is one of ``fwd_infer`` / ``fwd_train`` / ``fwd_bwd`` /
 depends on (the watched-param set for gradient programs; the optimizer's
 ``fused_plan_token()``, the comm-plan token — replicated all-reduce vs
 ZeRO-1 reduce-scatter, ``("comm", "ar"|"rs")`` — and the scan length K
-for the fused/scan train steps). Anything the key cannot capture — model-parallel plans, monitor
+for the fused/scan train steps; every gradient-bearing kind also
+carries the remat-policy token ``("remat", none|dots|all)`` — a
+checkpointed program and an unrematerialized one trace differently for
+one symbol, mxnet_tpu/remat.py). Anything the key cannot capture — model-parallel plans, monitor
 taps, the NaiveEngine debug mode — is simply not cached here and keeps
 its per-executor lifecycle.
 

@@ -485,47 +485,72 @@ def flash_attention(q, k, v, causal=False, block_q=128, block_k=128):
     return _flash(q, k, v)
 
 
+def _attention_xla_forward(attrs, q, k, v):
+    # the exact composition the flash kernel is gated against —
+    # VERDICT §5 measured flash both beating and losing to this,
+    # which is precisely why the tier autotunes instead of trusting
+    # the kernel's name
+    from .base import parse_bool
+    from .parallel.ring_attention import attention as xla_attention
+    return xla_attention(q, k, v,
+                         causal=parse_bool(attrs.get("causal", False)))
+
+
+def _attention_pallas_variant(attrs, inputs, aux, is_train, rng):
+    from .base import parse_bool
+    q, k, v = inputs
+    out = flash_attention(q, k, v,
+                          causal=parse_bool(attrs.get("causal",
+                                                      False)),
+                          block_q=int(attrs.get("block_q", 128)),
+                          block_k=int(attrs.get("block_k", 128)))
+    return [out], []
+
+
+def _attention_eligible(attrs, in_shapes, in_dtypes):
+    if len(in_shapes[0]) != 4:
+        return False
+    t = in_shapes[0][2]
+    bq = min(int(attrs.get("block_q", 128)), t)
+    bk = min(int(attrs.get("block_k", 128)), t)
+    return t % bq == 0 and t % bk == 0
+
+
+_ATTENTION_ATTRS = {"causal": (None, False),
+                    "block_q": (int, 128),
+                    "block_k": (int, 128)}
+
+
 def _register_flash():
     if "pallas_flash_attention" in OP_REGISTRY:
         return
-
-    def xla_forward(attrs, q, k, v):
-        # the exact composition the flash kernel is gated against —
-        # VERDICT §5 measured flash both beating and losing to this,
-        # which is precisely why the tier autotunes instead of trusting
-        # the kernel's name
-        from .base import parse_bool
-        from .parallel.ring_attention import attention as xla_attention
-        return xla_attention(q, k, v,
-                             causal=parse_bool(attrs.get("causal", False)))
-
-    def pallas_variant(attrs, inputs, aux, is_train, rng):
-        from .base import parse_bool
-        q, k, v = inputs
-        out = flash_attention(q, k, v,
-                              causal=parse_bool(attrs.get("causal",
-                                                          False)),
-                              block_q=int(attrs.get("block_q", 128)),
-                              block_k=int(attrs.get("block_k", 128)))
-        return [out], []
-
-    def eligible(attrs, in_shapes, in_dtypes):
-        if len(in_shapes[0]) != 4:
-            return False
-        t = in_shapes[0][2]
-        bq = min(int(attrs.get("block_q", 128)), t)
-        bk = min(int(attrs.get("block_k", 128)), t)
-        return t % bq == 0 and t % bk == 0
-
     _register_op("pallas_flash_attention", inputs=("q", "k", "v"),
-                 simple=xla_forward,
-                 attr_spec={"causal": (None, False),
-                            "block_q": (int, 128),
-                            "block_k": (int, 128)},
-                 variants={"pallas": (pallas_variant, eligible)})
+                 simple=_attention_xla_forward,
+                 attr_spec=dict(_ATTENTION_ATTRS),
+                 variants={"pallas": (_attention_pallas_variant,
+                                      _attention_eligible)})
+
+
+def _register_attention():
+    """``attention``: the graph-level attention OpDef the transformer
+    workload (ROADMAP 1) binds. Forward is the exact XLA composition
+    (``parallel.ring_attention.attention``); its *fused* lowering is the
+    flash kernel already registered on the tier — giving ring_attention's
+    flash machinery a first-class registered consumer. The sequence-
+    sharded lowering (ring attention over the mesh's ``seq`` axis) rides
+    the same OpDef when the transformer Module lands."""
+    if "attention" in OP_REGISTRY:
+        return
+    _register_op("attention", inputs=("q", "k", "v"),
+                 simple=_attention_xla_forward,
+                 shape_passthrough=True,
+                 attr_spec=dict(_ATTENTION_ATTRS),
+                 variants={"pallas": (_attention_pallas_variant,
+                                      _attention_eligible)})
 
 
 _register_flash()
+_register_attention()
 
 # rtc's ops register after ops/cost.py's import-time pass — re-seed so
 # pallas_sgd_mom_update / pallas_flash_attention carry their estimators

@@ -157,6 +157,22 @@ class BucketEngine(_EngineBase):
         from ..context import current_context
         from ..module import BucketingModule
 
+        # compute_dtype="int8" selects the quantized inference tier:
+        # the symbol is rewritten onto the Quantized* ops and every
+        # dense/conv weight splits into an int8 cell + per-channel f32
+        # scales (ops/quant.py) BEFORE binding, so each ladder rung pins
+        # a quantized program and the warm-restart payload (serve/
+        # warm.py) persists the already-quantized symbol+params —
+        # restores rebuild without re-quantizing. Activations stay
+        # float; outputs sit within quant.INT8_TOL of the float ladder.
+        self.quantized = None
+        if compute_dtype is not None and str(compute_dtype) == "int8":
+            from ..ops import quant as _quant
+            symbol, arg_params = _quant.quantize_symbol(
+                symbol, dict(arg_params or {}))
+            self.quantized = "int8"
+            compute_dtype = None
+
         if isinstance(data_shapes, dict):
             data_shapes = list(data_shapes.items())
         self.data_names = tuple(nm for nm, _ in data_shapes)

@@ -154,6 +154,13 @@ class InferenceServer:
                 raise MXNetError(
                     f"register({name!r}) needs model=, predictor=, or "
                     "symbol= + params + data_shapes")
+            # MXNET_SERVE_QUANTIZE=int8 defaults every symbol-sourced
+            # registration onto the quantized ladder (explicit
+            # compute_dtype= wins)
+            if compute_dtype is None:
+                import os as _os
+                compute_dtype = _os.environ.get(
+                    "MXNET_SERVE_QUANTIZE") or None
             engine = BucketEngine(
                 name, symbol, arg_params or {}, aux_params or {},
                 data_shapes, label_names=label_names or ("softmax_label",),
@@ -487,6 +494,7 @@ class InferenceServer:
                 "exec_est_ms": {b: round(s * 1e3, 3) for b, s in
                                 sorted(e.engine.exec_est.items())},
                 "programs_resident": e.engine.programs_resident(),
+                "quantized": getattr(e.engine, "quantized", None),
             }
         compiles = None
         if self._warm_mark is not None:

@@ -58,6 +58,10 @@ def server_payload(server):
                 "label_names": list(eng._label_names),
                 "ladder": list(eng.ladder.sizes),
                 "compute_dtype": eng._compute_dtype,
+                # int8 engines persist the ALREADY-quantized symbol +
+                # params (compute_dtype is None by then), so restore
+                # re-binds without re-quantizing; recorded for audit
+                "quantized": getattr(eng, "quantized", None),
             }
         elif isinstance(eng, PredictorEngine) and eng._path is not None:
             models[name] = {"type": "predictor", "path": eng._path}

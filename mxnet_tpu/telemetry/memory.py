@@ -35,7 +35,7 @@ from . import metrics as _metrics
 
 __all__ = ["enabled", "on_alloc", "on_swap", "on_free", "live_bytes",
            "peak_bytes", "snapshot", "reset_peak", "assert_no_leak",
-           "record_executor_bind"]
+           "record_executor_bind", "batch_headroom", "program_memory"]
 
 _enabled = os.environ.get("MXNET_MEMORY_ACCOUNTING", "1") != "0"
 _lock = threading.Lock()
@@ -233,6 +233,44 @@ def assert_no_leak(ctx=None, tolerance_bytes=0):
         raise AssertionError(
             "device-memory leak across the guarded region: "
             + "; ".join(leaks))
+
+
+# ------------------------------------------------------ batch headroom
+def batch_headroom(budget_bytes, fixed_bytes, per_sample_bytes, buckets):
+    """Largest batch bucket admissible under a device-memory budget.
+
+    ``fixed_bytes`` is the batch-independent footprint (params,
+    optimizer state, program constants); ``per_sample_bytes`` the
+    batch-linear part (activations/residuals + inputs, per sample) —
+    the quantity a remat policy shrinks
+    (``executor_group.fused_memory_report``). Returns the largest rung
+    of ``buckets`` whose estimated step peak fits the budget, or None
+    when none does. This is the gate converting remat-freed HBM into
+    the next-larger batch bucket (docs/performance.md).
+    """
+    fit = [int(b) for b in buckets
+           if fixed_bytes + per_sample_bytes * int(b) <= budget_bytes]
+    return max(fit) if fit else None
+
+
+def program_memory(compiled):
+    """Byte stats of one compiled XLA program (``jax`` Compiled object
+    or anything with ``memory_analysis()``): argument/output/temp sizes.
+    Best-effort — returns None where the backend exposes no analysis.
+    Note: XLA:CPU's temp figure is not schedule-aware (it will not move
+    under remat); the residual-set measure (``remat.residual_bytes``)
+    is the backend-independent signal, this one is the on-device
+    cross-check."""
+    try:
+        ma = compiled.memory_analysis()
+        if ma is None:
+            return None
+        return {"argument_bytes": int(ma.argument_size_in_bytes),
+                "output_bytes": int(ma.output_size_in_bytes),
+                "temp_bytes": int(ma.temp_size_in_bytes),
+                "alias_bytes": int(ma.alias_size_in_bytes)}
+    except Exception:
+        return None
 
 
 # -------------------------------------------------------- executor binds

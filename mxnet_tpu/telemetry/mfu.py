@@ -47,9 +47,12 @@ _TRAIN_FACTORS = {
     "Convolution": 3.0, "Deconvolution": 3.0, "FullyConnected": 3.0,
     "FusedConvBNReLU": 3.0, "RNN": 3.0, "dot": 3.0, "batch_dot": 3.0,
     "BatchNorm": 3.0,
+    "attention": 3.0, "pallas_flash_attention": 3.0,
     "sgd_update": 1.0, "sgd_mom_update": 1.0, "adam_update": 1.0,
     "rmsprop_update": 1.0, "rmspropalex_update": 1.0,
     "pallas_sgd_mom_update": 1.0,
+    # inference-tier ops never appear in a train graph
+    "QuantizedFullyConnected": 1.0, "QuantizedConvolution": 1.0,
 }
 _DEFAULT_TRAIN_FACTOR = 2.0
 

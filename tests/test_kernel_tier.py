@@ -210,6 +210,219 @@ def test_parity_adam_update(dtype, tol):
     assert ok, f"adam parity failed at {dtype}: {err}"
 
 
+@pytest.mark.parametrize("dtype,tol", _DTYPE_CASES)
+def test_parity_layernorm(dtype, tol):
+    ln = get_op("LayerNorm")
+    attrs = ln.normalize_attrs({})
+    ok, err = kernel_tier.numerics_gate(
+        ln, attrs, [(16, 96), (96,), (96,)],
+        [dtype, "float32", "float32"], tol=tol)
+    assert ok, f"LayerNorm parity failed at {dtype}: {err}"
+
+
+@pytest.mark.parametrize("dtype,tol", _DTYPE_CASES)
+def test_parity_bias_gelu(dtype, tol):
+    bg = get_op("FusedBiasGeLU")
+    ok, err = kernel_tier.numerics_gate(
+        bg, {}, [(16, 64), (64,)], [dtype, dtype], tol=tol)
+    assert ok, f"bias+GeLU parity failed at {dtype}: {err}"
+
+
+@pytest.mark.parametrize("dtype,tol", _DTYPE_CASES)
+def test_parity_embedding(dtype, tol):
+    emb = get_op("Embedding")
+    attrs = emb.normalize_attrs({"input_dim": 50, "output_dim": 64,
+                                 "scale": 1.5})
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray((rng.rand(24) * 50).astype("f"))
+    w = jnp.asarray(rng.randn(50, 64).astype("f")).astype(dtype)
+    ok, err = kernel_tier.numerics_gate(
+        emb, attrs, [(24,), (50, 64)], ["float32", dtype], tol=tol,
+        inputs=[ids, w])
+    assert ok, f"embedding parity failed at {dtype}: {err}"
+
+
+@pytest.mark.parametrize("dtype,tol", _DTYPE_CASES)
+def test_parity_attention(dtype, tol):
+    att = get_op("attention")
+    attrs = att.normalize_attrs({"causal": True})
+    ok, err = kernel_tier.numerics_gate(
+        att, attrs, [(2, 2, 128, 32)] * 3, [dtype] * 3, tol=tol)
+    assert ok, f"attention parity failed at {dtype}: {err}"
+
+
+def test_layernorm_hand_backward_gradients():
+    """The fused LayerNorm's HAND backward kernels (dx row pass +
+    dgamma/dbeta accumulation) match the XLA composition's gradients
+    for every differentiable input."""
+    from mxnet_tpu.ops.pallas_kernels import fused_layernorm
+    ln = get_op("LayerNorm")
+    attrs = ln.normalize_attrs({})
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(24, 48).astype("f"))
+    g = jnp.asarray(rng.rand(48).astype("f") + 0.5)
+    b = jnp.asarray(rng.randn(48).astype("f"))
+
+    def loss_pl(x, g, b):
+        return (fused_layernorm(x, g, b)[0] ** 2).sum()
+
+    def loss_xla(x, g, b):
+        return (ln.forward(attrs, [x, g, b], [], True,
+                           None)[0][0] ** 2).sum()
+
+    gp = jax.grad(loss_pl, argnums=(0, 1, 2))(x, g, b)
+    gx = jax.grad(loss_xla, argnums=(0, 1, 2))(x, g, b)
+    for a, r, nm in zip(gp, gx, ("dx", "dgamma", "dbeta")):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(r),
+                                   rtol=1e-4, atol=1e-4,
+                                   err_msg=f"LayerNorm {nm}")
+
+
+def test_bias_gelu_hand_backward_gradients():
+    from mxnet_tpu.ops.pallas_kernels import (fused_bias_gelu,
+                                              _bias_gelu_xla)
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(16, 40).astype("f"))
+    b = jnp.asarray(rng.randn(40).astype("f"))
+    gp = jax.grad(lambda x, b: (fused_bias_gelu(x, b) ** 2).sum(),
+                  argnums=(0, 1))(x, b)
+    gx = jax.grad(lambda x, b: (_bias_gelu_xla({}, x, b) ** 2).sum(),
+                  argnums=(0, 1))(x, b)
+    for a, r, nm in zip(gp, gx, ("dx", "dbias")):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(r),
+                                   rtol=1e-4, atol=1e-5,
+                                   err_msg=f"bias+GeLU {nm}")
+
+
+def test_embedding_scatter_add_backward():
+    """The fused embedding's scatter-add backward matches jnp.take's
+    gradient — including repeated ids (the accumulate case)."""
+    from mxnet_tpu.ops.pallas_kernels import fused_embedding
+    rng = np.random.RandomState(2)
+    ids = jnp.asarray(np.array([3, 1, 3, 3, 0, 1], "f"))  # repeats
+    w = jnp.asarray(rng.randn(8, 32).astype("f"))
+    gp = jax.grad(lambda w: (fused_embedding(ids, w, 2.0) ** 2).sum())(w)
+    gx = jax.grad(lambda w: ((jnp.take(w, ids.astype(jnp.int32),
+                                       axis=0) * 2.0) ** 2).sum())(w)
+    np.testing.assert_allclose(np.asarray(gp), np.asarray(gx),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_attention_grad_parity():
+    """The attention OpDef's pallas (flash) variant differentiates to
+    the same gradients as the XLA composition (flash-recompute VJP)."""
+    att = get_op("attention")
+    attrs = att.normalize_attrs({"causal": True})
+    rng = np.random.RandomState(3)
+    q, k, v = (jnp.asarray(rng.randn(1, 2, 128, 16).astype("f"))
+               for _ in range(3))
+
+    def loss(fn):
+        return lambda q: (fn(attrs, [q, k, v], [], True,
+                             None)[0][0] ** 2).sum()
+
+    gx = jax.grad(loss(att.forward))(q)
+    gp = jax.grad(loss(att.variant_fn("pallas")))(q)
+    np.testing.assert_allclose(np.asarray(gp), np.asarray(gx),
+                               rtol=1e-3, atol=1e-4)
+
+
+_NEW_KERNEL_SITES = [
+    ("LayerNorm", {}, [(16, 96), (96,), (96,)],
+     ["float32", "float32", "float32"]),
+    ("FusedBiasGeLU", {}, [(16, 64), (64,)], ["float32", "float32"]),
+    ("Embedding", {"input_dim": 50, "output_dim": 128},
+     [(24,), (50, 128)], ["float32", "float32"]),
+    ("attention", {}, [(2, 2, 128, 32)] * 3, ["float32"] * 3),
+]
+
+
+@pytest.mark.parametrize("opname,raw_attrs,shapes,dtypes",
+                         _NEW_KERNEL_SITES,
+                         ids=[s[0] for s in _NEW_KERNEL_SITES])
+def test_new_kernels_never_selected_when_slower(opname, raw_attrs,
+                                                shapes, dtypes,
+                                                monkeypatch):
+    """Each memory-bound-sweep kernel rides the one-shot scripted-timer
+    autotune: a slower measurement can never select it, a faster one
+    does."""
+    op = get_op(opname)
+    attrs = op.normalize_attrs(raw_attrs)
+    _fake_tpu(monkeypatch, pallas_ms=3.0, xla_ms=1.0)
+    assert kernel_tier.resolve(op, attrs, shapes, dtypes,
+                               True) == "xla"
+    assert "slower" in kernel_tier.decisions()[-1]["reason"]
+    kernel_tier.clear()
+    _fake_tpu(monkeypatch, pallas_ms=1.0, xla_ms=2.0)
+    assert kernel_tier.resolve(op, attrs, shapes, dtypes,
+                               True) == "pallas"
+
+
+# ----------------------------------------- remat-policy autotune keying
+def test_remat_policy_keys_autotune(monkeypatch):
+    """Flipping MXNET_REMAT_POLICY never reuses a stale selection: the
+    policy token rides the autotune key (in-memory AND persisted), so
+    each policy gets its own measurement."""
+    from mxnet_tpu.telemetry import metrics as _metrics
+    sm, attrs, shapes, dtypes = _softmax_site()
+    monkeypatch.setenv("MXNET_REMAT_POLICY", "none")
+    _fake_tpu(monkeypatch, pallas_ms=1.0, xla_ms=2.0)
+    runs = metrics.counter("kernel_tier.autotune.runs")
+    r0 = runs.value
+    assert kernel_tier.resolve(sm, attrs, shapes, dtypes,
+                               True) == "pallas"
+    assert runs.value == r0 + 1
+    # same site under a different policy: a FRESH autotune, and this
+    # one measures pallas slower — the none-policy winner must not leak
+    monkeypatch.setenv("MXNET_REMAT_POLICY", "all")
+    times = iter([1.0e-3, 3.0e-3])             # xla 1ms, pallas 3ms
+    monkeypatch.setattr(kernel_tier, "_time_variant",
+                        lambda run, r, x, reps: next(times))
+    assert kernel_tier.resolve(sm, attrs, shapes, dtypes,
+                               True) == "xla"
+    assert runs.value == r0 + 2
+    # and each policy's winner stays cached independently
+    assert kernel_tier.resolve(sm, attrs, shapes, dtypes,
+                               True) == "xla"
+    monkeypatch.setenv("MXNET_REMAT_POLICY", "none")
+    assert kernel_tier.resolve(sm, attrs, shapes, dtypes,
+                               True) == "pallas"
+    assert runs.value == r0 + 2
+
+
+def test_remat_policy_keys_persisted_cache(tmp_path, monkeypatch):
+    """The persisted kernel_tier.json distinguishes policies too: a
+    fresh process under a different policy re-tunes instead of reusing
+    the other policy's winner."""
+    monkeypatch.setenv("MXNET_AUTOTUNE_CACHE_DIR", str(tmp_path))
+    monkeypatch.setenv("MXNET_REMAT_POLICY", "none")
+    sm, attrs, shapes, dtypes = _softmax_site()
+    _fake_tpu(monkeypatch, pallas_ms=1.0, xla_ms=2.0)
+    assert kernel_tier.resolve(sm, attrs, shapes, dtypes,
+                               True) == "pallas"
+    kernel_tier.clear()                        # "fresh process"
+    monkeypatch.setenv("MXNET_REMAT_POLICY", "all")
+    times = iter([1.0e-3, 3.0e-3])
+    monkeypatch.setattr(kernel_tier, "_backend", lambda: "tpu")
+    monkeypatch.setattr(kernel_tier, "_device_kind", lambda: "TPU test")
+    monkeypatch.setattr(kernel_tier, "_time_variant",
+                        lambda run, r, x, reps: next(times))
+    assert kernel_tier.resolve(sm, attrs, shapes, dtypes,
+                               True) == "xla"
+    assert kernel_tier.decisions()[-1]["source"] == "autotune"
+    # while the none-policy entry is still served persisted
+    kernel_tier.clear()
+    monkeypatch.setenv("MXNET_REMAT_POLICY", "none")
+    monkeypatch.setattr(kernel_tier, "_backend", lambda: "tpu")
+    monkeypatch.setattr(kernel_tier, "_device_kind", lambda: "TPU test")
+    monkeypatch.setattr(
+        kernel_tier, "_time_variant",
+        lambda *a, **k: pytest.fail("persisted winner re-timed"))
+    assert kernel_tier.resolve(sm, attrs, shapes, dtypes,
+                               True) == "pallas"
+    assert kernel_tier.decisions()[-1]["source"] == "persisted"
+
+
 def test_parity_custom_vjp_gradients():
     """The Pallas variants' custom VJPs match the XLA compositions'
     gradients (softmax-CE uses its hand backward kernel; conv+BN+ReLU

@@ -1,0 +1,262 @@
+"""Int8 PTQ tier: per-channel quantization, graph rewrite, kernel-tier
+gates, export round-trip, quantized serving.
+
+Everything on the CPU mesh (Pallas interpret mode); the tolerance class
+is quant.INT8_TOL for int8-vs-float comparisons and the standard tier
+tolerances for pallas-vs-xla of the SAME quantized op.
+"""
+import os
+import tempfile
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import kernel_tier, program_cache
+from mxnet_tpu.ops import quant
+from mxnet_tpu.ops.registry import get_op
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    monkeypatch.delenv("MXNET_KERNEL_TIER", raising=False)
+    monkeypatch.delenv("MXNET_SERVE_QUANTIZE", raising=False)
+    kernel_tier.clear()
+    yield
+    kernel_tier.clear()
+
+
+def _mlp_symbol():
+    data = mx.sym.var("data")
+    fc = mx.sym.FullyConnected(data=data, num_hidden=32, name="f1")
+    act = mx.sym.Activation(fc, act_type="relu")
+    fc2 = mx.sym.FullyConnected(act, num_hidden=10, name="f2")
+    return mx.sym.SoftmaxOutput(fc2, name="softmax")
+
+
+def _convnet_symbol():
+    data = mx.sym.var("data")
+    c = mx.sym.Convolution(data=data, kernel=(3, 3), num_filter=8,
+                           pad=(1, 1), name="c1")
+    a = mx.sym.Activation(c, act_type="relu")
+    f = mx.sym.FullyConnected(a, num_hidden=10, name="f1")
+    return mx.sym.SoftmaxOutput(f, name="softmax")
+
+
+def _bound(sym, data_shape):
+    mod = mx.mod.Module(sym, context=mx.cpu())
+    mod.bind([("data", data_shape)], [("softmax_label",
+                                       (data_shape[0],))],
+             for_training=False)
+    mod.init_params(mx.initializer.Xavier())
+    return mod
+
+
+# ------------------------------------------------------------ numerics
+def test_quantize_per_channel_roundtrip():
+    rng = np.random.RandomState(0)
+    w = rng.randn(16, 64).astype(np.float32) * np.linspace(
+        0.01, 3.0, 16)[:, None]          # per-channel dynamic range
+    q, s = quant.quantize_per_channel(w)
+    assert q.dtype == np.int8 and s.shape == (16,)
+    back = np.asarray(quant.dequantize(jnp.asarray(q), jnp.asarray(s)))
+    # per-channel error bound: half an lsb of each channel's scale
+    assert np.all(np.abs(back - w) <= 0.5 * s[:, None] + 1e-7)
+    # a global (per-tensor) scale would be ~100x worse on channel 0
+    zero = np.zeros((4, 8), np.float32)
+    qz, sz = quant.quantize_per_channel(zero)
+    assert np.all(qz == 0) and np.all(sz == 1.0)
+
+
+# -------------------------------------------------------- graph rewrite
+def test_quantize_symbol_structure():
+    sym = _mlp_symbol()
+    mod = _bound(sym, (4, 16))
+    ap, _ = mod.get_params()
+    assert quant.quantizable_weights(sym, ap) == ["f1_weight",
+                                                  "f2_weight"]
+    qsym, qargs = quant.quantize_symbol(sym, ap)
+    ops = {n.op for n in qsym._topo_nodes() if not n.is_variable}
+    assert "FullyConnected" not in ops
+    assert "QuantizedFullyConnected" in ops
+    assert {"f1_weight_q", "f1_weight_scale", "f2_weight_q",
+            "f2_weight_scale", "f1_bias", "f2_bias"} <= set(qargs)
+    assert "f1_weight" not in qargs
+    assert qargs["f1_weight_q"].dtype == np.int8
+    # node/output names unchanged — downstream wiring intact
+    assert qsym.list_outputs() == sym.list_outputs()
+
+
+def test_quantize_symbol_rejects_unquantizable():
+    data = mx.sym.var("data")
+    out = mx.sym.Activation(data, act_type="relu")
+    with pytest.raises(mx.base.MXNetError):
+        quant.quantize_symbol(mx.sym.SoftmaxOutput(out), {})
+
+
+def test_quantized_outputs_within_tolerance():
+    for sym_fn, shape in ((_mlp_symbol, (4, 16)),
+                          (_convnet_symbol, (4, 3, 8, 8))):
+        sym = sym_fn()
+        mod = _bound(sym, shape)
+        ap, xp = mod.get_params()
+        qsym, qargs = quant.quantize_symbol(sym, ap)
+        qmod = mx.mod.Module(qsym, context=mx.cpu())
+        qmod.bind([("data", shape)], [("softmax_label", (shape[0],))],
+                  for_training=False)
+        qmod.init_params(initializer=None, arg_params=qargs,
+                         aux_params=xp)
+        # the int8 weights bind int8 CELLS (no silent f32 upcast)
+        wq = qmod._exec_group.executor.arg_dict
+        qnames = [n for n in wq if n.endswith("_q")]
+        assert qnames and all(wq[n].dtype == np.int8 for n in qnames)
+        x = np.random.RandomState(1).rand(*shape).astype(np.float32)
+        batch = mx.io.DataBatch([mx.nd.array(x)], [])
+        mod.forward(batch, is_train=False)
+        ref = mod.get_outputs()[0].asnumpy()
+        qmod.forward(batch, is_train=False)
+        got = qmod.get_outputs()[0].asnumpy()
+        assert np.allclose(ref, got, **quant.INT8_TOL)
+
+
+# ------------------------------------------------------- kernel tier
+@pytest.mark.parametrize("dtype", ["float32", "bfloat16"])
+def test_quantized_fc_pallas_gate(dtype):
+    qfc = get_op("QuantizedFullyConnected")
+    attrs = qfc.normalize_attrs({"num_hidden": 32})
+    ok, err = kernel_tier.numerics_gate(
+        qfc, attrs, [(8, 64), (32, 64), (32,), (32,)],
+        [dtype, "int8", "float32", "float32"])
+    assert ok, f"max_abs_err={err}"
+
+
+@pytest.mark.parametrize("dtype", ["float32", "bfloat16"])
+def test_quantized_conv_pallas_gate(dtype):
+    qcv = get_op("QuantizedConvolution")
+    attrs = qcv.normalize_attrs({"kernel": (3, 3), "num_filter": 8,
+                                 "pad": (1, 1)})
+    ok, err = kernel_tier.numerics_gate(
+        qcv, attrs, [(2, 4, 8, 8), (8, 4, 3, 3), (8,), (8,)],
+        [dtype, "int8", "float32", "float32"])
+    assert ok, f"max_abs_err={err}"
+
+
+def test_quantized_pallas_never_selected_when_slower(monkeypatch):
+    """The quantized kernels ride the same scripted-timer autotune: a
+    slower measurement can never select them."""
+    qfc = get_op("QuantizedFullyConnected")
+    attrs = qfc.normalize_attrs({"num_hidden": 32})
+    shapes = [(8, 64), (32, 64), (32,), (32,)]
+    dtypes = ["float32", "int8", "float32", "float32"]
+    times = iter([1.0, 3.0])                   # xla 1ms, pallas 3ms
+    monkeypatch.setattr(kernel_tier, "_backend", lambda: "tpu")
+    monkeypatch.setattr(kernel_tier, "_device_kind", lambda: "TPU test")
+    monkeypatch.setattr(kernel_tier, "_time_variant",
+                        lambda run, r, x, reps: next(times) / 1e3)
+    assert kernel_tier.resolve(qfc, attrs, shapes, dtypes,
+                               False) == "xla"
+    assert "slower" in kernel_tier.decisions()[-1]["reason"]
+
+
+# ------------------------------------------------------------- export
+def test_export_quantize_roundtrip(tmp_path):
+    from mxnet_tpu.predict import export_model, Predictor
+    sym = _mlp_symbol()
+    mod = _bound(sym, (4, 16))
+    ap, xp = mod.get_params()
+    pf = export_model(str(tmp_path / "f.mxp"), sym, ap, xp,
+                      {"data": (4, 16)})
+    pq = export_model(str(tmp_path / "q.mxp"), sym, ap, xp,
+                      {"data": (4, 16)}, quantize="int8")
+    # the int8 artifact ships smaller weights
+    assert os.path.getsize(pq) < os.path.getsize(pf)
+    predf, predq = Predictor(pf), Predictor(pq)
+    assert predf.quantize is None
+    assert predq.quantize == "int8"
+    assert predq._manifest["quantized_weights"] == ["f1_weight",
+                                                    "f2_weight"]
+    x = np.random.RandomState(2).rand(4, 16).astype(np.float32)
+    of = predf.forward(data=x)[0].asnumpy()
+    oq = predq.forward(data=x)[0].asnumpy()
+    assert np.allclose(of, oq, **quant.INT8_TOL)
+    assert not np.array_equal(of, oq)       # it IS quantized
+
+
+def test_export_quantize_rejects_unknown_dtype(tmp_path):
+    from mxnet_tpu.predict import export_model
+    sym = _mlp_symbol()
+    mod = _bound(sym, (4, 16))
+    ap, xp = mod.get_params()
+    with pytest.raises(mx.base.MXNetError):
+        export_model(str(tmp_path / "x.mxp"), sym, ap, xp,
+                     {"data": (4, 16)}, quantize="int4")
+
+
+# ------------------------------------------------------------- serving
+def test_int8_serve_zero_compiles_and_tolerance():
+    """The acceptance gate: compile_count() delta == 0 after warmup on
+    the int8 ladder, outputs within the tolerance class of the float
+    ladder, stats report the quantized tier."""
+    sym = _mlp_symbol()
+    mod = _bound(sym, (8, 16))
+    ap, xp = mod.get_params()
+    server = mx.serve.serve(mod, name="q8", ladder=[1, 2, 4, 8],
+                            compute_dtype="int8", start=False)
+    try:
+        eng = server._registry.entries()[0].engine
+        assert eng.quantized == "int8"
+        assert eng._compute_dtype is None       # rewrite consumed it
+        assert eng.warmup_compiles > 0
+        mark = program_cache.compile_count()
+        x = np.random.RandomState(3).rand(4, 16).astype(np.float32)
+        out = eng.forward(4, {"data": x})[0].asnumpy()
+        assert program_cache.compile_count() - mark == 0
+        assert eng.compiles_since_warmup() == 0
+        assert server.stats()["models"]["q8"]["quantized"] == "int8"
+        # float reference through the original module
+        batch = mx.io.DataBatch([mx.nd.array(x)], [])
+        fmod = mx.mod.Module(sym, context=mx.cpu())
+        fmod.bind([("data", (4, 16))], [("softmax_label", (4,))],
+                  for_training=False)
+        fmod.init_params(initializer=None, arg_params=ap,
+                         aux_params=xp)
+        fmod.forward(batch, is_train=False)
+        ref = fmod.get_outputs()[0].asnumpy()
+        assert np.allclose(ref, out, **quant.INT8_TOL)
+    finally:
+        server.stop()
+
+
+def test_serve_quantize_env_default(monkeypatch):
+    monkeypatch.setenv("MXNET_SERVE_QUANTIZE", "int8")
+    sym = _mlp_symbol()
+    mod = _bound(sym, (4, 16))
+    server = mx.serve.serve(mod, name="envq", ladder=[1, 4],
+                            start=False)
+    try:
+        eng = server._registry.entries()[0].engine
+        assert eng.quantized == "int8"
+    finally:
+        server.stop()
+
+
+def test_int8_serve_warm_payload_persists_quantized():
+    """The warm-restart payload carries the ALREADY-quantized symbol +
+    int8 params (restore re-binds without re-quantizing)."""
+    from mxnet_tpu.serve.warm import server_payload
+    sym = _mlp_symbol()
+    mod = _bound(sym, (4, 16))
+    server = mx.serve.serve(mod, name="wq", ladder=[1, 2],
+                            compute_dtype="int8", start=False)
+    try:
+        payload = server_payload(server)
+        rec = payload["models"]["wq"]
+        assert rec["quantized"] == "int8"
+        assert rec["compute_dtype"] is None
+        assert rec["arg_params"]["f1_weight_q"].dtype == np.int8
+        qsym = mx.sym.load_json(rec["symbol"])
+        ops = {n.op for n in qsym._topo_nodes() if not n.is_variable}
+        assert "QuantizedFullyConnected" in ops
+    finally:
+        server.stop()
