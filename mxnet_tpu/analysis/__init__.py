@@ -6,7 +6,13 @@ this framework's own hazard classes: shape/dtype/structure consistency
 (``graph_verifier``), use-after-donation through the fused/scan/ZeRO
 plans (``donation_checker``), cross-worker collective dispatch order
 (``collective_order``), program-cache key churn (``retrace_churn``),
-and host syncs on the fit hot path (``host_sync``).
+host syncs on the fit hot path (``host_sync``), dtype flow through the
+mixed-precision/int8-quant tiers (``precision_flow``, QT7xx), and the
+static memory planner (``memory_planner``, ME8xx — peak HBM predicted
+before anything compiles; ``memplan.py``). Registration-time siblings:
+``kernelcheck.py`` validates Pallas kernel specs at ``add_variant``
+(PK9xx), ``envaudit.py`` keeps MXNET_* env reads and docs/env_var.md
+in lockstep.
 
 Three surfaces:
 
@@ -30,9 +36,11 @@ from .passes import (AnalysisContext, PASSES, run_passes, lint_symbol,
                      lint_executor, lint_module, lint_json,
                      validate_executor, validate_module, resolve_mode,
                      attr_cache_stable)
+from . import envaudit, kernelcheck, memplan, precision
 
 __all__ = ["Diagnostic", "Report", "RULES", "SEVERITIES",
            "AnalysisContext", "PASSES", "run_passes", "lint_symbol",
            "lint_executor", "lint_module", "lint_json",
            "validate_executor", "validate_module", "resolve_mode",
-           "attr_cache_stable"]
+           "attr_cache_stable", "envaudit", "kernelcheck", "memplan",
+           "precision"]

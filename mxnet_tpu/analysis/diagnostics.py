@@ -15,6 +15,9 @@ Rule id scheme (the NNVM-pass analog of compiler warning numbers):
 * ``RC4xx`` — retrace / program-cache churn
 * ``HS5xx`` — host synchronization in the fit hot path
 * ``MF6xx`` — MFU/cost-metadata coverage
+* ``QT7xx`` — precision flow (mixed precision + the int8 quant rewrite)
+* ``ME8xx`` — static memory planner (predicted-OOM before compile)
+* ``PK9xx`` — Pallas kernel registration (VMEM/tiling/dtype feasibility)
 * ``XX0xx`` — analysis-infrastructure notices
 
 Severities: ``error`` (the program is wrong or will crash/deadlock),
@@ -84,6 +87,28 @@ RULES = {
     # ---- MFU coverage ---------------------------------------------------
     "MF601": ("info", "op has no flops/bytes cost metadata (invisible "
                       "to MFU/roofline accounting)"),
+    # ---- precision flow -------------------------------------------------
+    "QT701": ("warning", "silent float32 upcast inside a reduced-"
+                         "precision (bf16/fp16) compute graph"),
+    "QT702": ("error", "Quantized op consumes a weight that was never "
+                       "rewritten to int8 + scale"),
+    "QT703": ("error", "int8-quantized weight shared with a "
+                       "non-quantized consumer (reads raw int8 codes)"),
+    "QT704": ("warning", "dequantize->requantize round-trip (int8 -> "
+                         "float -> int8 detour)"),
+    "QT705": ("warning", "loss-head accumulation narrower than float32"),
+    # ---- static memory planner ------------------------------------------
+    "ME801": ("error", "predicted peak HBM exceeds device capacity "
+                       "(OOM before anything compiles)"),
+    "ME802": ("info", "device-memory headroom admits a larger batch "
+                      "bucket"),
+    # ---- Pallas kernel registration -------------------------------------
+    "PK901": ("error", "declared kernel tile working set exceeds the "
+                       "per-generation VMEM budget"),
+    "PK902": ("error", "declared kernel tile violates lane/sublane "
+                       "alignment (last dim % 128, dtype sublane rows)"),
+    "PK903": ("error", "kernel variant declares no (or unsupported) "
+                       "dtype coverage for the numerics gate"),
     # ---- infrastructure -------------------------------------------------
     "XX001": ("info", "an analysis pass failed to run"),
 }

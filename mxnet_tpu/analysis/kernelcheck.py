@@ -1,0 +1,118 @@
+"""Pallas kernel registration validator (PK9xx).
+
+A VMEM-infeasible or misaligned kernel variant used to fail *silently*:
+Mosaic rejects the tile at autotune time, the numerics gate or the
+timer never selects it, and the kernel just never wins — the work of
+writing it evaporates with no diagnostic. This module moves that
+failure to **import time**: ``OpDef.add_variant(...,
+kernel_spec=...)`` declares the variant's worst-case VMEM-resident
+tiles and the dtype set its eligibility admits, and registration
+validates the declaration against hard TPU constraints:
+
+* ``PK901`` — the declared tiles' combined working set exceeds the
+  per-generation VMEM budget (the min across
+  ``telemetry.mfu.PEAKS[*]["vmem_bytes"]``: a portable kernel must fit
+  the smallest core it may autotune on);
+* ``PK902`` — a declared tile violates lane/sublane alignment: the
+  last dim must be a multiple of 128 lanes and the second-to-last a
+  multiple of the dtype's sublane rows (f32 8, bf16 16, int8/fp8 32);
+* ``PK903`` — the declared dtype coverage is empty or names a dtype
+  the kernel tier's numerics gate cannot compare.
+
+``kernel_spec`` schema (plain dict, validated here)::
+
+    {"tiles": [((rows, cols), "float32"), ...],   # worst-case blocks
+               # resident in VMEM simultaneously (inputs + outputs +
+               # scratch at the eligibility bounds)
+     "dtypes": ("float32", "bfloat16")}           # numerics-gate set
+
+Failures raise ``MXNetError`` naming the op, the variant, and the rule
+id — the registration analog of ``bind(validate="raise")``.
+"""
+from __future__ import annotations
+
+from ..base import MXNetError
+
+__all__ = ["SUPPORTED_GATE_DTYPES", "SUBLANE_ROWS", "LANES",
+           "validate_kernel_spec", "tile_bytes"]
+
+#: dtypes the kernel-tier numerics gate can compare against the XLA
+#: reference (kernel_tier.py gates every variant before selection)
+SUPPORTED_GATE_DTYPES = frozenset({
+    "float32", "bfloat16", "float16", "int8", "int32", "uint8",
+})
+
+#: minimum second-to-last-dim rows per dtype (TPU tiling: the last dim
+#: is always 128 lanes; sublanes scale inversely with element width)
+SUBLANE_ROWS = {
+    "float32": 8, "int32": 8, "uint32": 8,
+    "bfloat16": 16, "float16": 16,
+    "int8": 32, "uint8": 32, "float8_e4m3fn": 32, "float8_e5m2": 32,
+}
+LANES = 128
+
+_ITEMSIZE = {"float32": 4, "int32": 4, "uint32": 4, "bfloat16": 2,
+             "float16": 2, "int8": 1, "uint8": 1,
+             "float8_e4m3fn": 1, "float8_e5m2": 1}
+
+
+def tile_bytes(shape, dtype):
+    n = 1
+    for d in shape:
+        n *= int(d)
+    return n * _ITEMSIZE.get(str(dtype), 4)
+
+
+def _budget():
+    from ..telemetry.mfu import min_vmem_budget
+    return min_vmem_budget()
+
+
+def validate_kernel_spec(op_name, variant, spec):
+    """Validate one variant's kernel_spec; raises MXNetError with the
+    failing PK9xx rule id. Returns the spec on success."""
+    where = f"op {op_name!r} variant {variant!r}"
+    if not isinstance(spec, dict):
+        raise MXNetError(f"PK903: {where}: kernel_spec must be a dict "
+                         f"with 'tiles' and 'dtypes', got "
+                         f"{type(spec).__name__}")
+
+    dtypes = tuple(str(d) for d in spec.get("dtypes", ()))
+    if not dtypes:
+        raise MXNetError(
+            f"PK903: {where} declares no dtype coverage; the numerics "
+            "gate cannot qualify a kernel with no comparable dtypes")
+    bad = [d for d in dtypes if d not in SUPPORTED_GATE_DTYPES]
+    if bad:
+        raise MXNetError(
+            f"PK903: {where} declares dtype(s) {bad} outside the "
+            f"numerics gate's coverage {sorted(SUPPORTED_GATE_DTYPES)}")
+
+    tiles = spec.get("tiles", ())
+    total = 0
+    for entry in tiles:
+        shape, dtype = entry
+        shape = tuple(int(d) for d in shape)
+        dtype = str(dtype)
+        if any(d <= 0 for d in shape):
+            raise MXNetError(
+                f"PK902: {where} tile {shape} has a non-positive dim")
+        if shape[-1] % LANES != 0:
+            raise MXNetError(
+                f"PK902: {where} tile {shape} ({dtype}): last dim "
+                f"{shape[-1]} is not a multiple of {LANES} lanes")
+        sublane = SUBLANE_ROWS.get(dtype, 8)
+        if len(shape) >= 2 and shape[-2] % sublane != 0:
+            raise MXNetError(
+                f"PK902: {where} tile {shape} ({dtype}): sublane dim "
+                f"{shape[-2]} is not a multiple of {sublane} rows "
+                f"({dtype} packs {sublane}-row sublanes)")
+        total += tile_bytes(shape, dtype)
+    budget = _budget()
+    if total > budget:
+        raise MXNetError(
+            f"PK901: {where} declares a {total / (1 << 20):.1f} MiB "
+            f"VMEM working set; the per-generation budget is "
+            f"{budget / (1 << 20):.0f} MiB — shrink the block caps or "
+            "tighten the eligibility bounds")
+    return spec

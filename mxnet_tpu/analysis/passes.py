@@ -4,8 +4,10 @@ The reference validated every graph with iterative NNVM passes
 (InferShape/InferType, graph_executor.cc:425) *before* anything
 executed. This module re-grows that discipline for the hazards this
 framework actually has: donated fused/scan buffers, in-program
-collective plans, ready-order bucket all-reduces, and program-cache
-keys. Each pass walks the Symbol node graph plus whatever execution
+collective plans, ready-order bucket all-reduces, program-cache
+keys, dtype flow through the mixed-precision/int8 tiers
+(``precision_flow``/QT7xx, precision.py), and predicted-OOM memory
+plans (``memory_planner``/ME8xx, memplan.py — inert unless armed). Each pass walks the Symbol node graph plus whatever execution
 state is available (a bound Executor, an armed exec group's fused/scan
 plan, a kvstore bucket scheduler) and emits structured diagnostics —
 finding at bind time what PR 2's runtime NaN-poison and crash dumps
@@ -58,7 +60,8 @@ class AnalysisContext:
 
     def __init__(self, symbol=None, known_shapes=None, executor=None,
                  exec_group=None, module=None, kvstore=None, sched=None,
-                 json_graph=None, assume_multiworker=False):
+                 json_graph=None, assume_multiworker=False,
+                 compute_dtype=None, memplan=None):
         self.symbol = symbol
         self.known_shapes = dict(known_shapes or {})
         self.executor = executor
@@ -70,6 +73,14 @@ class AnalysisContext:
         # single-process runs can't diverge across workers; fixtures and
         # mxlint set this to audit a plan as if it ran on a multihost mesh
         self.assume_multiworker = assume_multiworker
+        # precision_flow: simulate a mixed-precision binding; bound
+        # executors contribute their own _compute_dtype when unset
+        self.compute_dtype = compute_dtype
+        # memory_planner: options dict ({"capacity_bytes":..., "policy":
+        # ..., "buckets":...}); None (the default) keeps the planner
+        # inert so bind-time lint stays inside the <2% overhead gate —
+        # mxlint --memory-plan and MXNET_LINT_MEMPLAN_BUDGET arm it
+        self.memplan = memplan
 
 
 # --------------------------------------------------------------- helpers
@@ -693,6 +704,69 @@ def mfu_coverage(ctx, out):
                  "tools/mxlint.py --mfu-audit"))
 
 
+def memory_planner(ctx, out):
+    """ME8xx: the static memory planner as a lint pass.
+
+    Inert unless armed — planning walks the graph per policy, which the
+    warm-bind <2% overhead gate cannot absorb on every bind. Armed by an
+    explicit ``AnalysisContext(memplan={...})`` (mxlint --memory-plan)
+    or by ``MXNET_LINT_MEMPLAN_BUDGET`` (bytes, or "16G") for bindings
+    that know their shapes. Options: ``capacity_bytes`` (default: the
+    env budget, else ``telemetry.mfu.device_hbm_bytes()``), ``policy``
+    (default: the active remat policy), ``buckets`` (ME802 ladder),
+    plus anything ``memplan.plan_symbol`` takes.
+    """
+    opts = ctx.memplan
+    if opts is None:
+        raw = os.environ.get("MXNET_LINT_MEMPLAN_BUDGET", "").strip()
+        if not raw:
+            return
+        mult = 1
+        if raw[-1:].upper() == "G":
+            raw, mult = raw[:-1], 1 << 30
+        elif raw[-1:].upper() == "M":
+            raw, mult = raw[:-1], 1 << 20
+        try:
+            opts = {"capacity_bytes": int(float(raw) * mult)}
+        except ValueError:
+            return
+    opts = dict(opts)
+    sym = ctx.symbol
+    if sym is None and ctx.executor is not None:
+        sym = ctx.executor._symbol
+    if sym is None:
+        return
+    shapes = _known_shapes(ctx)
+    g = ctx.exec_group
+    if g is not None:
+        shapes = {d.name: tuple(d.shape) for d in g.data_shapes}
+        for l in (g.label_shapes or []):
+            shapes[l.name] = tuple(l.shape)
+    if not shapes:
+        return
+    from . import memplan as _memplan
+    from ..telemetry.mfu import device_hbm_bytes
+    capacity = opts.pop("capacity_bytes", None)
+    if capacity is None:
+        capacity = device_hbm_bytes()
+    buckets = opts.pop("buckets", None)
+    if "policy" not in opts:
+        from .. import remat as _remat
+        opts["policy"] = getattr(g, "_remat_policy", None) \
+            if g is not None else None
+        opts["policy"] = opts["policy"] or _remat.active()
+    if g is not None:
+        opts.setdefault("n_data", getattr(g, "_n_data", 1))
+        opts.setdefault("for_training", bool(g.for_training))
+        opts.setdefault("compute_dtype", g.compute_dtype)
+    plan = _memplan.plan_symbol(sym, shapes, **opts)
+    _memplan.record_plan(plan)
+    out.extend(_memplan.plan_findings(plan, capacity_bytes=capacity,
+                                      buckets=buckets))
+
+
+from .precision import precision_flow  # noqa: E402  (pass body)
+
 #: pass name -> callable(ctx, out_list); order is the report order
 PASSES = OrderedDict([
     ("graph_verifier", graph_verifier),
@@ -702,6 +776,8 @@ PASSES = OrderedDict([
     ("retrace_churn", retrace_churn),
     ("host_sync", host_sync),
     ("mfu_coverage", mfu_coverage),
+    ("precision_flow", precision_flow),
+    ("memory_planner", memory_planner),
 ])
 
 

@@ -1,0 +1,376 @@
+"""Precision-flow dataflow pass (QT7xx): dtypes through the bound graph.
+
+The executor already decides real dtypes at bind time — ``__dtype__``
+declarations bind typed cells (the int8 quant tier), ``compute_dtype``
+casts float variables at graph entry (mixed precision), and the
+Quantized* ops carry int8/f32 input contracts — but until now nothing
+*checked* the flow: a float weight feeding a ``QuantizedFullyConnected``
+silently produced garbage, a stray f32 constant in a bf16 graph silently
+widened the whole downstream chain, and an int8->float->int8 detour
+(the dequant/requant round-trip a careless ``quantize_symbol`` composition
+can introduce) just burned bytes. This pass re-runs the same forward
+dtype propagation statically — declared ``__dtype__`` cells, bound array
+dtypes, the registry's ``infer_type`` where an op registers one, and
+attr-driven rules for Cast/creation/Quantized/loss ops — and audits the
+result:
+
+* ``QT701`` — a node inside a reduced-precision (bf16/fp16) graph whose
+  output silently widens to f32 because one input is f32 (mixing, not an
+  explicit Cast);
+* ``QT702`` — a ``Quantized*`` node whose weight slot is not an int8
+  entry: the weight was never rewritten to int8 + scale;
+* ``QT703`` — an int8 weight feeding a Quantized weight slot that is
+  *also* consumed by a non-quantized node, which would read the raw
+  int8 codes as values;
+* ``QT704`` — a Cast back to int8 whose source chain (through
+  movement ops and casts) starts at an int8 entry: a dequant->requant
+  round trip;
+* ``QT705`` — a loss head whose *declared* input dtype is narrower than
+  f32 (accumulating the loss in bf16/fp16). The ``compute_dtype`` mixed-
+  precision path is exempt by design: master params stay f32 and the
+  entry cast's vjp upcasts gradients, so accumulation is f32 there.
+
+Pure observer over the Symbol graph — no jax import, no tracing.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .diagnostics import Diagnostic
+
+__all__ = ["entry_dtypes", "dtype_name", "is_reduced_float",
+           "is_floating", "precision_flow"]
+
+#: float dtypes narrower than f32 (the mixed-precision compute tier)
+REDUCED_FLOATS = frozenset({"float16", "bfloat16"})
+_FLOAT_WIDTH = {"float16": 2, "bfloat16": 2, "float32": 4, "float64": 8}
+
+#: ops that move data without touching values: dtype passes through
+#: input 0 and a dequant->requant chain may thread through them (QT704)
+_MOVEMENT_OPS = frozenset({
+    "Reshape", "reshape", "Flatten", "flatten", "transpose", "_copy",
+    "identity", "BlockGrad", "stop_gradient", "expand_dims", "slice",
+    "slice_axis", "SliceChannel", "split", "repeat", "tile", "reverse",
+    "flip", "swapaxes", "SwapAxis", "broadcast_axis", "broadcast_to",
+    "Crop", "Pad", "pad",
+})
+
+_CAST_OPS = frozenset({"Cast", "cast"})
+
+
+def dtype_name(dt):
+    """Canonical dtype name; tolerates np dtypes, strings, ml_dtypes."""
+    try:
+        return str(np.dtype(dt))
+    except TypeError:
+        return str(dt)
+
+
+def is_floating(name):
+    return name in _FLOAT_WIDTH
+
+
+def is_reduced_float(name):
+    return name in REDUCED_FLOATS
+
+
+def _promote(names):
+    """jnp-style promotion over entry dtype names: widest float wins,
+    else widest int; empty -> f32. (Deliberately NOT numpy promotion,
+    which widens int32+f32 to f64 — XLA never does.)"""
+    floats = [n for n in names if is_floating(n)]
+    if floats:
+        return max(floats, key=lambda n: _FLOAT_WIDTH[n])
+    ints = [n for n in names if n.startswith(("int", "uint", "bool"))]
+    if ints:
+        return max(ints, key=lambda n: np.dtype(n).itemsize
+                   if n != "bool" else 1)
+    return names[0] if names else "float32"
+
+
+def _label_names(symbol):
+    """Variables feeding a loss head past slot 0 — exempt from
+    compute_dtype casting (mirrors executor._build_graph_runner)."""
+    labels = set()
+    for node in symbol._topo_nodes():
+        if node.is_variable:
+            continue
+        try:
+            if node.opdef().is_loss:
+                for inp, _ in node.inputs[1:]:
+                    if inp.is_variable:
+                        labels.add(inp.name)
+        except Exception:  # unregistered op: no label exemption
+            continue
+    return labels
+
+
+def entry_dtypes(symbol, compute_dtype=None, bound_dtypes=None):
+    """Forward dtype propagation: {(id(node), out_idx): dtype name}.
+
+    Entry dtypes seed from ``__dtype__`` declarations, then
+    ``bound_dtypes`` (executor bindings), default f32; when
+    ``compute_dtype`` is given, floating variables (except loss labels)
+    take it — exactly the executor's graph-entry cast. Ops propagate via
+    the registry's ``infer_type`` when registered, else attr/op-family
+    rules, else promotion over the inputs.
+    """
+    bound_dtypes = bound_dtypes or {}
+    cd = dtype_name(compute_dtype) if compute_dtype is not None else None
+    labels = _label_names(symbol) if cd is not None else set()
+    out = {}
+    for node in symbol._topo_nodes():
+        if node.is_variable:
+            declared = node._extra.get("__dtype__")
+            name = (dtype_name(declared) if declared
+                    else dtype_name(bound_dtypes.get(node.name,
+                                                     "float32")))
+            if (cd is not None and is_floating(name)
+                    and node.name not in labels):
+                name = cd
+            out[(id(node), 0)] = name
+            continue
+        try:
+            opdef = node.opdef()
+        except Exception:
+            opdef = None
+        in_names = [out.get((id(inp), idx), "float32")
+                    for inp, idx in node.inputs]
+        n_out = opdef.num_outputs(node.attrs) if opdef is not None else 1
+        names = None
+        if opdef is not None and opdef.infer_type is not None:
+            try:
+                _in, outs, _aux = opdef.infer_type(
+                    node.attrs, [np.dtype(n) if n != "bfloat16" else n
+                                 for n in in_names])
+                names = [dtype_name(t) for t in outs]
+            except Exception:
+                names = None
+        if names is None:
+            if node.op in _CAST_OPS and node.attrs.get("dtype"):
+                names = [dtype_name(node.attrs["dtype"])] * n_out
+            elif "dtype" in node.attrs and not node.inputs:
+                # creation ops (_zeros/_ones/_arange): dtype attr rules
+                names = [dtype_name(node.attrs["dtype"])] * n_out
+            elif node.op.startswith("Quantized"):
+                # data in, data dtype out (dequant happens inside)
+                names = [in_names[0] if in_names else "float32"] * n_out
+            elif node.op == "Embedding":
+                # output follows the table, not the int ids
+                names = [in_names[1] if len(in_names) > 1
+                         else "float32"] * n_out
+            elif opdef is not None and opdef.is_loss:
+                names = [in_names[0] if in_names else "float32"] * n_out
+            elif node.op in _MOVEMENT_OPS:
+                names = [in_names[0] if in_names else "float32"] * n_out
+            else:
+                aux_n = (len(opdef.aux_names(node.attrs))
+                         if opdef is not None else 0)
+                regular = in_names[:len(in_names) - aux_n] if aux_n \
+                    else in_names
+                names = [_promote(regular)] * n_out
+        for i in range(n_out):
+            out[(id(node), i)] = names[i] if i < len(names) else names[-1]
+    return out
+
+
+_F32 = np.dtype("float32")
+
+
+def _bound_var_dtypes(executor):
+    """Bound cells that deviate from the f32 default — the only
+    entries the propagation needs seeded (absent names default f32).
+    Kept to raw dtype compares: this runs on every validated bind and
+    rides inside the <2% warm-bind overhead gate."""
+    out = {}
+    for nm, a in zip(executor.arg_names, executor.arg_arrays):
+        if a is None:
+            continue
+        d = getattr(getattr(a, "_data", None), "dtype", None)
+        if d is None:
+            d = np.dtype(a.dtype)
+        if d != _F32:
+            out[nm] = str(d)
+    return out
+
+
+def _has_precision_surface(symbol):
+    """Can any QT rule fire on this graph absent reduced/bound-typed
+    entries? Declared dtypes and Quantized nodes are the only
+    dtype-independent triggers; memoized per symbol so the all-f32
+    steady state short-circuits the whole pass."""
+    for n in symbol._topo_nodes():
+        if n.is_variable:
+            if "__dtype__" in n._extra:
+                return True
+        elif n.op.startswith("Quantized"):
+            return True
+    return False
+
+
+def _regular_inputs(node):
+    try:
+        aux_n = len(node.opdef().aux_names(node.attrs))
+    except Exception:
+        aux_n = 0
+    return node.inputs[:len(node.inputs) - aux_n] if aux_n \
+        else node.inputs
+
+
+def precision_flow(ctx, out):
+    """The QT7xx pass body (registered in passes.PASSES)."""
+    sym = ctx.symbol
+    exe = ctx.executor
+    if sym is None and exe is not None:
+        sym = exe._symbol
+    if sym is None:
+        return
+    compute_dtype = getattr(ctx, "compute_dtype", None)
+    if compute_dtype is None and exe is not None:
+        compute_dtype = getattr(exe, "_compute_dtype", None)
+    bound = _bound_var_dtypes(exe) if exe is not None else {}
+
+    from .passes import _symbol_memo  # lazy: avoid circular import
+    if compute_dtype is None and not bound and not _symbol_memo(
+            sym, "precision_surface", None,
+            lambda: _has_precision_surface(sym)):
+        return      # all-f32 graph, no quant surface: nothing can fire
+    memo_key = (dtype_name(compute_dtype) if compute_dtype is not None
+                else None, tuple(sorted(bound.items())))
+    out.extend(_symbol_memo(
+        sym, "precision_flow", memo_key,
+        lambda: _audit(sym, compute_dtype, bound)))
+
+
+def _audit(sym, compute_dtype, bound):
+    found = []
+    nodes = sym._topo_nodes()
+    dtypes = entry_dtypes(sym, compute_dtype=compute_dtype,
+                          bound_dtypes=bound)
+    cd_name = dtype_name(compute_dtype) if compute_dtype is not None \
+        else None
+    reduced_graph = (cd_name in REDUCED_FLOATS) or any(
+        is_reduced_float(dtypes[(id(n), 0)])
+        for n in nodes if n.is_variable)
+
+    # QT701: silent f32 widening inside a reduced-precision graph.
+    # Explicit Casts and loss heads (upcasting *into* the loss is the
+    # QT705 fix, not a hazard) are exempt.
+    if reduced_graph:
+        flagged_ops = set()
+        for n in nodes:
+            if n.is_variable or n.op in _CAST_OPS:
+                continue
+            try:
+                if n.opdef().is_loss:
+                    continue
+            except Exception:
+                pass
+            if dtypes.get((id(n), 0)) != "float32":
+                continue
+            in_names = [dtypes.get((id(inp), idx), "float32")
+                        for inp, idx in _regular_inputs(n)]
+            if any(is_reduced_float(nm) for nm in in_names) and \
+                    "float32" in in_names and n.op not in flagged_ops:
+                flagged_ops.add(n.op)
+                found.append(Diagnostic(
+                    "QT701", f"node {n.name!r} mixes "
+                    f"{[nm for nm in in_names if is_reduced_float(nm)][0]}"
+                    " and float32 inputs; the output (and everything "
+                    "downstream) silently widens to float32",
+                    node=n.name, op=n.op,
+                    hint="cast the f32 operand (or declare/create it at "
+                         "the compute dtype); use an explicit Cast if "
+                         "the upcast is intended"))
+
+    # QT702/703: the int8 quant-rewrite contract around Quantized* ops
+    quant_weight_vars = set()
+    for n in nodes:
+        if n.is_variable or not n.op.startswith("Quantized"):
+            continue
+        ins = _regular_inputs(n)
+        if len(ins) < 2:
+            continue
+        wnode, widx = ins[1]
+        wdt = dtypes.get((id(wnode), widx), "float32")
+        if wdt != "int8":
+            found.append(Diagnostic(
+                "QT702", f"{n.op} node {n.name!r} consumes weight "
+                f"{wnode.name!r} of dtype {wdt}; the quant rewrite "
+                "never produced an int8 + scale pair for it",
+                node=n.name, op=n.op,
+                hint="run quantize_symbol over the trained symbol (or "
+                     "bind the _q/_scale params it produced)"))
+        elif wnode.is_variable:
+            quant_weight_vars.add(id(wnode))
+
+    if quant_weight_vars:
+        for n in nodes:
+            if n.is_variable:
+                continue
+            for i, (inp, _idx) in enumerate(_regular_inputs(n)):
+                if id(inp) not in quant_weight_vars:
+                    continue
+                if n.op.startswith("Quantized") and i == 1:
+                    continue
+                found.append(Diagnostic(
+                    "QT703", f"int8 weight {inp.name!r} also feeds "
+                    f"{n.op} node {n.name!r} (slot {i}), which reads "
+                    "the raw int8 codes as values",
+                    node=n.name, op=n.op,
+                    hint="keep a float copy for the non-quantized "
+                         "consumer, or route it through the Quantized "
+                         "op"))
+
+    # QT704: Cast back to int8 whose source chain is int8 already
+    for n in nodes:
+        if n.is_variable or n.op not in _CAST_OPS:
+            continue
+        if dtype_name(n.attrs.get("dtype", "")) != "int8":
+            continue
+        src, sidx = n.inputs[0] if n.inputs else (None, 0)
+        hops = 0
+        while (src is not None and not src.is_variable and hops < 64
+               and (src.op in _MOVEMENT_OPS or src.op in _CAST_OPS)
+               and src.inputs):
+            src, sidx = src.inputs[0]
+            hops += 1
+        if src is not None and \
+                dtypes.get((id(src), sidx)) == "int8" and hops >= 0 \
+                and (id(src), sidx) != (id(n.inputs[0][0]),
+                                        n.inputs[0][1]):
+            found.append(Diagnostic(
+                "QT704", f"Cast node {n.name!r} requantizes to int8 a "
+                f"chain that starts int8 at {src.name!r}: a "
+                "dequantize->requantize round trip",
+                node=n.name, op=n.op,
+                hint="drop the float detour; quantize_symbol already "
+                     "produces int8 weights consumed in place"))
+
+    # QT705: loss-head accumulation narrower than f32 BY DECLARATION
+    # (a second propagation without compute_dtype: the mixed-precision
+    # entry cast keeps f32 master accumulation and is exempt)
+    declared = entry_dtypes(sym, compute_dtype=None, bound_dtypes=bound) \
+        if compute_dtype is not None else dtypes
+    for n in nodes:
+        if n.is_variable:
+            continue
+        try:
+            if not n.opdef().is_loss:
+                continue
+        except Exception:
+            continue
+        ins = _regular_inputs(n)
+        if not ins:
+            continue
+        pnode, pidx = ins[0]
+        pdt = declared.get((id(pnode), pidx), "float32")
+        if is_reduced_float(pdt):
+            found.append(Diagnostic(
+                "QT705", f"loss head {n.name!r} accumulates in {pdt}; "
+                "bf16/fp16 loss accumulation loses update signal at "
+                "scale", node=n.name, op=n.op,
+                hint="keep the loss head's input f32 (upcast before "
+                     "the head, or use compute_dtype= mixed precision, "
+                     "whose master params stay f32)"))
+    return found

@@ -890,10 +890,18 @@ class Executor:
         arg_names = symbol.list_arguments()
         aux_names = symbol.list_auxiliary_states()
         type_dict = type_dict or {}
+        # a variable's declared __dtype__ binds a cell of that dtype
+        # (the int8 quant tier's _q weights; executor_group does the
+        # same — analysis rule GV105 audits the declaration either way);
+        # an explicit type_dict entry wins
+        declared = {n.name: np.dtype(n._extra["__dtype__"])
+                    for n in symbol._topo_nodes()
+                    if n.is_variable and n._extra.get("__dtype__")}
         args = {}
         for nm, s in zip(arg_names, arg_shapes):
             args[nm] = nd_zeros(s, ctx=ctx,
-                                dtype=type_dict.get(nm, np.float32))
+                                dtype=type_dict.get(
+                                    nm, declared.get(nm, np.float32)))
         req = grad_req if isinstance(grad_req, dict) else \
             {nm: grad_req for nm in arg_names}
         grads = {nm: nd_zeros(s, ctx=ctx, dtype=type_dict.get(nm, np.float32))

@@ -605,6 +605,55 @@ class DataParallelExecutorGroup:
                                    "param_bytes", "batch_bytes")})
         return report
 
+    def static_memory_plan(self, policy=None, buckets=None,
+                           capacity_bytes=None):
+        """Static peak-HBM plan for this binding — the zero-trace fast
+        path of the batch-bucket headroom gate.
+
+        Same component semantics as ``fused_memory_report`` (the tests
+        cross-check the two within 5%) but computed purely from the
+        graph by ``analysis.memplan``: no ``eval_shape``, no trace, no
+        armed optimizer required. When the fused step IS armed, the
+        exact state-tree bytes and remat policy are used; otherwise the
+        planner's optimizer-multiplier estimate. Returns the plan dict
+        (plus ``headroom_bucket`` when ``buckets``+``capacity_bytes``
+        are given), mirrored into the ``memplan.*`` gauges.
+        """
+        from .. import remat as _remat
+        from ..analysis import memplan as _memplan
+        shapes = {d.name: tuple(d.shape) for d in self.data_shapes}
+        for l in (self.label_shapes or []):
+            shapes[l.name] = tuple(l.shape)
+        state_bytes = None
+        states = getattr(self, "_fused_states", None)
+        if states:
+            # exact armed-state bytes (the flat ZeRO tree is the full
+            # (n, chunk) layout — global, like the estimate; the
+            # planner divides per device when zero=True)
+            state_bytes = int(sum(
+                int(np.prod(v.shape)) * v.dtype.itemsize
+                for v in jax.tree_util.tree_leaves(states)))
+        policy = policy or getattr(self, "_remat_policy", None) \
+            or _remat.active()
+        plan = _memplan.plan_symbol(
+            self.symbol, shapes, policy=policy,
+            for_training=self.for_training,
+            compute_dtype=self.compute_dtype,
+            n_data=self._n_data, spmd_plan=self._spmd_plan,
+            zero=bool(self._state_layout is not None
+                      or (self._spmd_plan is not None
+                          and self._spmd_plan.zero)),
+            donation=getattr(self, "_fused_prog", None) is not None,
+            fixed_params=self.fixed_param_names,
+            state_bytes=state_bytes)
+        _memplan.record_plan(plan)
+        if buckets and capacity_bytes and plan.get("per_sample_bytes"):
+            from ..telemetry.memory import batch_headroom
+            plan["headroom_bucket"] = batch_headroom(
+                capacity_bytes, plan["fixed_bytes"] + plan["grad_bytes"],
+                plan["per_sample_bytes"], buckets)
+        return plan
+
     # ----------------------------------------------- fused-state transport
     def export_fused_states(self):
         """Host-format (param-shaped numpy) fused optimizer states — the

@@ -28,7 +28,8 @@ from __future__ import annotations
 from ..base import parse_bool, parse_int, parse_tuple
 from .registry import OP_REGISTRY
 
-__all__ = ["seed_costs", "uncovered_ops", "optimizer_flops"]
+__all__ = ["seed_costs", "uncovered_ops", "partial_cost_ops",
+           "optimizer_flops"]
 
 _B = 4.0                                   # accounting bytes / element
 
@@ -432,6 +433,19 @@ def uncovered_ops():
     seen = {}
     for name, opdef in OP_REGISTRY.items():
         if not opdef.has_cost():
+            seen.setdefault(id(opdef), opdef.name)
+    return sorted(seen.values())
+
+
+def partial_cost_ops():
+    """Ops carrying exactly ONE of flops/bytes_moved — a half-seeded
+    estimator under-counts one roofline axis while looking covered.
+    Both the memory planner and the roofline fold per-op byte counts,
+    so the consistency contract (tests/test_analysis.py) pins this
+    list empty."""
+    seen = {}
+    for name, opdef in OP_REGISTRY.items():
+        if (opdef.flops is None) != (opdef.bytes_moved is None):
             seen.setdefault(id(opdef), opdef.name)
     return sorted(seen.values())
 

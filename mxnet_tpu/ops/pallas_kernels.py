@@ -202,6 +202,15 @@ def _softmax_ce_eligible(attrs, in_shapes, in_dtypes):
                                                 "float16")
 
 
+#: worst-case VMEM residency at the eligibility bounds (c <= 65536 ->
+#: 8-row blocks; small c -> 256-row blocks at ~2 MiB): prob in + out.
+#: Validated at registration by analysis/kernelcheck.py (PK9xx).
+_SOFTMAX_CE_KSPEC = {
+    "tiles": [((8, 65536), "float32"), ((8, 65536), "float32")],
+    "dtypes": ("float32", "bfloat16", "float16"),
+}
+
+
 # ==========================================================================
 # fused conv + BatchNorm + ReLU
 # ==========================================================================
@@ -388,6 +397,16 @@ def _cbr_eligible(attrs, in_shapes, in_dtypes):
     return str(in_dtypes[0]) in ("float32", "bfloat16", "float16")
 
 
+#: stats + normalize passes: (block_c<=128, block_x<=2MiB/4/block_c)
+#: data tile twice resident (in + normalized out) plus the per-channel
+#: accumulator rows
+_CBR_KSPEC = {
+    "tiles": [((128, 4096), "float32"), ((128, 4096), "float32"),
+              ((8, 128), "float32")],
+    "dtypes": ("float32", "bfloat16", "float16"),
+}
+
+
 def _cbr_infer(attrs, in_shapes):
     from .nn import _conv_infer
     conv_attrs = dict(attrs, no_bias=True)
@@ -411,7 +430,8 @@ def _register_fused_conv_bn_relu():
              aux=("moving_mean", "moving_var"),
              full=_cbr_xla_variant,
              attr_spec=attrs, infer_shape=_cbr_infer,
-             variants={"pallas": (_cbr_pallas_variant, _cbr_eligible)})
+             variants={"pallas": (_cbr_pallas_variant, _cbr_eligible,
+                                  _CBR_KSPEC)})
 
 
 _register_fused_conv_bn_relu()
@@ -551,6 +571,13 @@ def _opt_variant(op_name, kernel_builder, n_in, n_out):
     return variant, eligible
 
 
+def _opt_kspec(n_arrays):
+    """n_arrays (256, 128) f32 tiles resident per grid step — the
+    flattened elementwise update's whole working set."""
+    return {"tiles": [((_TILE_ROWS, _LANES), "float32")] * n_arrays,
+            "dtypes": ("float32", "bfloat16", "float16")}
+
+
 # ==========================================================================
 # fused LayerNorm (LayerNorm pallas variant): one VMEM pass forward
 # (whole rows resident, f32 statistics), hand-written backward kernels
@@ -686,11 +713,21 @@ def _layernorm_eligible(attrs, in_shapes, in_dtypes):
         "float32", "bfloat16", "float16")
 
 
+#: whole rows resident (C <= 65536 -> 8-row blocks): x in, y out, and
+#: the f32 statistics columns
+_LN_KSPEC = {
+    "tiles": [((8, 65536), "float32"), ((8, 65536), "float32"),
+              ((8, 128), "float32")],
+    "dtypes": ("float32", "bfloat16", "float16"),
+}
+
+
 def _register_layernorm_variant():
     ln = get_op("LayerNorm")
     if "pallas" not in ln.variants:
         ln.add_variant("pallas", _layernorm_variant,
-                       eligible=_layernorm_eligible)
+                       eligible=_layernorm_eligible,
+                       kernel_spec=_LN_KSPEC)
 
 
 # ==========================================================================
@@ -791,13 +828,23 @@ def _bias_gelu_infer(attrs, in_shapes, out_known=None):
     return [data_s, c], [data_s], []
 
 
+#: row blocks with whole channels resident (C <= 65536): x, bias
+#: broadcast rows, and the GeLU output
+_BIAS_GELU_KSPEC = {
+    "tiles": [((8, 65536), "float32"), ((8, 65536), "float32"),
+              ((8, 65536), "float32")],
+    "dtypes": ("float32", "bfloat16", "float16"),
+}
+
+
 def _register_bias_gelu():
     if "FusedBiasGeLU" in OP_REGISTRY:
         return
     register("FusedBiasGeLU", inputs=("data", "bias"),
              simple=_bias_gelu_xla, infer_shape=_bias_gelu_infer,
              variants={"pallas": (_bias_gelu_variant,
-                                  _bias_gelu_eligible)})
+                                  _bias_gelu_eligible,
+                                  _BIAS_GELU_KSPEC)})
 
 
 _register_bias_gelu()
@@ -875,15 +922,27 @@ def _embedding_eligible(attrs, in_shapes, in_dtypes):
         return False
     if str(in_dtypes[1]) not in ("float32", "bfloat16", "float16"):
         return False
+    if w_s[1] > 16384:
+        # one looked-up row must fit the declared VMEM tile (PK901's
+        # eligibility-side bound; wider tables keep the XLA gather)
+        return False
     # Mosaic wants lane-aligned rows; interpret mode (off-TPU) takes any
     return w_s[1] % 128 == 0 or _interpret()
+
+
+#: one prefetched row in, one out, at the D <= 16384 eligibility bound
+_EMB_KSPEC = {
+    "tiles": [((8, 16384), "float32"), ((8, 16384), "float32")],
+    "dtypes": ("float32", "bfloat16", "float16"),
+}
 
 
 def _register_embedding_variant():
     emb = get_op("Embedding")
     if "pallas" not in emb.variants:
         emb.add_variant("pallas", _embedding_variant,
-                        eligible=_embedding_eligible)
+                        eligible=_embedding_eligible,
+                        kernel_spec=_EMB_KSPEC)
 
 
 def _register_opt_variants():
@@ -891,18 +950,21 @@ def _register_opt_variants():
     if "pallas" not in sgd.variants:
         sgd.add_variant("pallas",
                         *_opt_variant("sgd_mom_update", _sgd_mom_kernel,
-                                      3, 2))
+                                      3, 2),
+                        kernel_spec=_opt_kspec(5))
     adam = get_op("adam_update")
     if "pallas" not in adam.variants:
         adam.add_variant("pallas",
-                         *_opt_variant("adam_update", _adam_kernel, 4, 3))
+                         *_opt_variant("adam_update", _adam_kernel, 4, 3),
+                         kernel_spec=_opt_kspec(7))
 
 
 def _register_softmax_ce_variant():
     sm = get_op("SoftmaxOutput")
     if "pallas" not in sm.variants:
         sm.add_variant("pallas", _softmax_ce_variant,
-                       eligible=_softmax_ce_eligible)
+                       eligible=_softmax_ce_eligible,
+                       kernel_spec=_SOFTMAX_CE_KSPEC)
 
 
 _register_opt_variants()

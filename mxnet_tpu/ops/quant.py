@@ -166,9 +166,18 @@ def _qfc_eligible(attrs, in_shapes, in_dtypes):
         return False
     if str(in_dtypes[1]) != "int8":
         return False
-    # whole-K tiles must fit VMEM alongside the (bm, bn) accumulator
-    return w_s[1] <= 16384 and str(in_dtypes[0]) in (
-        "float32", "bfloat16", "float16")
+    if w_s[1] > 16384 or str(in_dtypes[0]) not in (
+            "float32", "bfloat16", "float16"):
+        return False
+    # whole-K tiles must fit VMEM alongside the (bm, bn) accumulator:
+    # bound the ACTUAL block working set (x f32 + w int8 + out f32),
+    # mirroring _pl_qfc_matmul's block choice — the declared
+    # _QFC_KSPEC is validated against the same ceiling at registration
+    from .pallas_kernels import _divisor_block
+    k = w_s[1]
+    bm = _divisor_block(data_s[0], 256)
+    bn = _divisor_block(w_s[0], 256)
+    return bm * k * 4 + bn * k * 1 + bm * bn * 4 <= 12 << 20
 
 
 # -------------------------------------------------- quantized conv op
@@ -233,8 +242,30 @@ def _qconv_eligible(attrs, in_shapes, in_dtypes):
         return False
     if str(in_dtypes[1]) != "int8":
         return False
-    return int(np.prod(w_s[1:])) <= 65536 and str(in_dtypes[0]) in (
-        "float32", "bfloat16", "float16")
+    if int(np.prod(w_s[1:])) > 65536 or str(in_dtypes[0]) not in (
+            "float32", "bfloat16", "float16"):
+        return False
+    # the dequant pass keeps (bo, cols) int8-in + f32-out resident:
+    # bound the block working set like _qconv_pallas_variant builds it
+    from .pallas_kernels import _divisor_block
+    cols = int(np.prod(w_s[1:]))
+    bo = _divisor_block(w_s[0], 256)
+    return bo * cols * 5 <= 8 << 20
+
+
+#: worst-case VMEM residency at the _qfc_eligible bound (<= 12 MiB):
+#: x rows f32, int8 weight tile decoded in VMEM, f32 accumulator
+_QFC_KSPEC = {
+    "tiles": [((256, 8192), "float32"), ((256, 16384), "int8"),
+              ((256, 256), "float32")],
+    "dtypes": ("float32", "bfloat16", "float16", "int8"),
+}
+
+#: dequant rows pass at the _qconv_eligible bound: int8 in + f32 out
+_QCONV_KSPEC = {
+    "tiles": [((256, 6144), "int8"), ((256, 6144), "float32")],
+    "dtypes": ("float32", "bfloat16", "float16", "int8"),
+}
 
 
 def _register_quant_ops():
@@ -246,12 +277,13 @@ def _register_quant_ops():
              attr_spec={"num_hidden": (parse_int, None),
                         "no_bias": (parse_bool, False),
                         "flatten": (parse_bool, True)},
-             variants={"pallas": (_qfc_pallas_variant, _qfc_eligible)})
+             variants={"pallas": (_qfc_pallas_variant, _qfc_eligible,
+                                  _QFC_KSPEC)})
     register("QuantizedConvolution", inputs=_qconv_inputs,
              simple=_qconv_xla, infer_shape=_qconv_infer,
              attr_spec=dict(_CONV_ATTRS),
              variants={"pallas": (_qconv_pallas_variant,
-                                  _qconv_eligible)})
+                                  _qconv_eligible, _QCONV_KSPEC)})
 
 
 _register_quant_ops()

@@ -99,7 +99,9 @@ class OpDef:
         self.variants = {}
         for vname, vfn in (variants or {}).items():
             if isinstance(vfn, tuple):
-                self.add_variant(vname, vfn[0], eligible=vfn[1])
+                self.add_variant(vname, vfn[0], eligible=vfn[1],
+                                 kernel_spec=vfn[2]
+                                 if len(vfn) > 2 else None)
             else:
                 self.add_variant(vname, vfn)
         self.flops = flops
@@ -158,7 +160,8 @@ class OpDef:
         return list(self._output_names)
 
     # --- kernel-tier variants + cost metadata ---------------------------
-    def add_variant(self, name, forward, eligible=None):
+    def add_variant(self, name, forward, eligible=None,
+                    kernel_spec=None):
         """Attach an alternative kernel implementation.
 
         ``forward`` has the full op signature (attrs, inputs, aux,
@@ -166,12 +169,25 @@ class OpDef:
         in_shapes, in_dtypes)`` optionally restricts the shapes/attrs
         the kernel handles. ``name="xla"`` is reserved for the stock
         ``self.forward`` composition and cannot be overridden.
+
+        ``kernel_spec`` declares a Pallas kernel's worst-case VMEM
+        tiles and numerics-gate dtype coverage
+        (``{"tiles": [((rows, cols), dtype), ...], "dtypes": (...)}``);
+        it is validated HERE, at registration — an infeasible kernel
+        (VMEM-overflowing tile, lane/sublane misalignment, uncoverable
+        dtypes) raises MXNetError with its PK9xx rule id at import
+        instead of being silently never-selected by the autotuner
+        (analysis/kernelcheck.py).
         """
         if name == "xla":
             raise MXNetError(
                 f"op {self.name!r}: 'xla' names the stock forward; "
                 "register a differently-named variant")
-        self.variants[name] = {"fn": forward, "eligible": eligible}
+        if kernel_spec is not None:
+            from ..analysis.kernelcheck import validate_kernel_spec
+            validate_kernel_spec(self.name, name, kernel_spec)
+        self.variants[name] = {"fn": forward, "eligible": eligible,
+                               "kernel_spec": kernel_spec}
         return self
 
     def variant_fn(self, name):

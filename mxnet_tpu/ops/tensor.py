@@ -398,7 +398,20 @@ def _reshape(attrs, x):
 alias("reshape", "Reshape")
 
 
-@register("Flatten", inputs=("data",))
+def _flatten_infer(attrs, in_shapes):
+    # pure-python inference keeps Flatten off the jax.eval_shape
+    # fallback — the static memory planner's trace-free guarantee
+    # walks these shapes for every bundled model
+    s = in_shapes[0]
+    if s is None or any(d == 0 for d in s[1:]):
+        return in_shapes, [None], []
+    n = 1
+    for d in s[1:]:
+        n *= int(d)
+    return in_shapes, [(s[0], n)], []
+
+
+@register("Flatten", inputs=("data",), infer_shape=_flatten_infer)
 def _flatten(attrs, x):
     return jnp.reshape(x, (x.shape[0], -1))
 

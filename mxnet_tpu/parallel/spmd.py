@@ -160,6 +160,22 @@ class SpmdPlan:
             "zero": self.zero,
         }
 
+    def param_shard_fraction(self, name, shape):
+        """Fraction of one param resident per device under its spec —
+        the static memory planner's layout-awareness (analysis/
+        memplan.py): a replicated param costs 1.0 everywhere, a
+        model-axis-sharded one 1/axis_size on the sharded dim."""
+        spec = self.param_spec(name)
+        frac = 1.0
+        for dim, axes in enumerate(tuple(spec)):
+            if axes is None or dim >= len(shape):
+                continue
+            for ax in (axes if isinstance(axes, tuple) else (axes,)):
+                n = self.mesh.shape.get(ax, 1)
+                if n > 1 and shape[dim] % n == 0:
+                    frac /= n
+        return frac
+
     # ----------------------------------------------------------- placing
     def place_param(self, name, value):
         return jax.device_put(value, self.param_sharding(name))

@@ -44,10 +44,23 @@ from __future__ import annotations
 
 import os
 
-__all__ = ["POLICIES", "resolve", "active", "set_active", "wrap",
-           "residual_bytes"]
+__all__ = ["POLICIES", "DOT_SAVEABLE_OPS", "resolve", "active",
+           "set_active", "wrap", "residual_bytes"]
 
 POLICIES = ("none", "dots", "all")
+
+#: static mirror of ``jax.checkpoint_policies.dots_saveable`` at the op
+#: level: ops whose outputs come off the MXU (dot_general / conv
+#: primitives) and therefore STAY SAVED under the ``dots`` policy while
+#: everything elementwise between them is recomputed. The static memory
+#: planner (analysis/memplan.py) folds output bytes of exactly these
+#: ops to predict the ``dots`` residual set without tracing; keep the
+#: set in sync with the saveable primitives when jax's policy changes.
+DOT_SAVEABLE_OPS = frozenset({
+    "Convolution", "Deconvolution", "FullyConnected", "dot", "batch_dot",
+    "FusedConvBNReLU", "QuantizedFullyConnected", "QuantizedConvolution",
+    "RNN", "attention", "pallas_flash_attention",
+})
 
 _override = None        # fit(remat=...) pins the process-wide policy
 

@@ -267,6 +267,9 @@ def _register_builtin():
         w, g, m = inputs
         return list(pallas_sgd_mom_update(w, g, m, **_hyper(attrs))), []
 
+    # 3 inputs + 2 outputs resident as (256, 128) f32 tiles
+    kspec = {"tiles": [((256, 128), "float32")] * 5,
+             "dtypes": ("float32", "bfloat16", "float16")}
     _register_op("pallas_sgd_mom_update",
                  inputs=("weight", "grad", "mom"),
                  simple=xla_forward, num_outputs=2,
@@ -276,7 +279,7 @@ def _register_builtin():
                             "wd": (float, 0.0),
                             "rescale_grad": (float, 1.0),
                             "clip_gradient": (lambda v: float(v), None)},
-                 variants={"pallas": pallas_variant})
+                 variants={"pallas": (pallas_variant, None, kspec)})
 
 
 _register_builtin()
@@ -511,6 +514,10 @@ def _attention_eligible(attrs, in_shapes, in_dtypes):
     if len(in_shapes[0]) != 4:
         return False
     t = in_shapes[0][2]
+    if in_shapes[0][3] > 512:
+        # q/k/v/acc blocks keep whole head rows in VMEM — the declared
+        # _ATTENTION_KSPEC tile bound (PK901's eligibility side)
+        return False
     bq = min(int(attrs.get("block_q", 128)), t)
     bk = min(int(attrs.get("block_k", 128)), t)
     return t % bq == 0 and t % bk == 0
@@ -520,6 +527,12 @@ _ATTENTION_ATTRS = {"causal": (None, False),
                     "block_q": (int, 128),
                     "block_k": (int, 128)}
 
+#: q/k/v blocks plus the f32 accumulator at the d <= 512 bound
+_ATTENTION_KSPEC = {
+    "tiles": [((128, 512), "float32")] * 4,
+    "dtypes": ("float32", "bfloat16", "float16"),
+}
+
 
 def _register_flash():
     if "pallas_flash_attention" in OP_REGISTRY:
@@ -528,7 +541,8 @@ def _register_flash():
                  simple=_attention_xla_forward,
                  attr_spec=dict(_ATTENTION_ATTRS),
                  variants={"pallas": (_attention_pallas_variant,
-                                      _attention_eligible)})
+                                      _attention_eligible,
+                                      _ATTENTION_KSPEC)})
 
 
 def _register_attention():
@@ -546,7 +560,8 @@ def _register_attention():
                  shape_passthrough=True,
                  attr_spec=dict(_ATTENTION_ATTRS),
                  variants={"pallas": (_attention_pallas_variant,
-                                      _attention_eligible)})
+                                      _attention_eligible,
+                                      _ATTENTION_KSPEC)})
 
 
 _register_flash()

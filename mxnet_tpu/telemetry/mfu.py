@@ -26,18 +26,27 @@ from __future__ import annotations
 
 from . import metrics as _metrics
 
-__all__ = ["PEAKS", "device_peaks", "cost_table", "roofline",
+__all__ = ["PEAKS", "device_peaks", "device_hbm_bytes",
+           "min_vmem_budget", "cost_table", "roofline",
            "model_mfu", "record_gauges", "train_factor"]
 
 #: device_kind -> {"bf16": peak bf16 FLOP/s, "f32": peak f32 FLOP/s,
-#:                 "hbm": HBM bytes/s}
+#:                 "hbm": HBM bytes/s, "hbm_bytes": HBM capacity,
+#:                 "vmem_bytes": per-core VMEM budget (the Pallas
+#:                 kernel validator's tile ceiling, analysis PK901)}
 PEAKS = {
-    "TPU v4":      {"bf16": 275e12, "f32": 137e12, "hbm": 1228e9},
-    "TPU v5 lite": {"bf16": 197e12, "f32": 98e12,  "hbm": 819e9},
-    "TPU v5e":     {"bf16": 197e12, "f32": 98e12,  "hbm": 819e9},
-    "TPU v5p":     {"bf16": 459e12, "f32": 229e12, "hbm": 2765e9},
-    "TPU v6 lite": {"bf16": 918e12, "f32": 459e12, "hbm": 1640e9},
-    "TPU v6e":     {"bf16": 918e12, "f32": 459e12, "hbm": 1640e9},
+    "TPU v4":      {"bf16": 275e12, "f32": 137e12, "hbm": 1228e9,
+                    "hbm_bytes": 32e9, "vmem_bytes": 16 << 20},
+    "TPU v5 lite": {"bf16": 197e12, "f32": 98e12,  "hbm": 819e9,
+                    "hbm_bytes": 16e9, "vmem_bytes": 16 << 20},
+    "TPU v5e":     {"bf16": 197e12, "f32": 98e12,  "hbm": 819e9,
+                    "hbm_bytes": 16e9, "vmem_bytes": 16 << 20},
+    "TPU v5p":     {"bf16": 459e12, "f32": 229e12, "hbm": 2765e9,
+                    "hbm_bytes": 95e9, "vmem_bytes": 16 << 20},
+    "TPU v6 lite": {"bf16": 918e12, "f32": 459e12, "hbm": 1640e9,
+                    "hbm_bytes": 32e9, "vmem_bytes": 32 << 20},
+    "TPU v6e":     {"bf16": 918e12, "f32": 459e12, "hbm": 1640e9,
+                    "hbm_bytes": 32e9, "vmem_bytes": 32 << 20},
 }
 
 #: backward-pass FLOP multiplier per op family: weight-bearing ops run
@@ -74,6 +83,27 @@ def device_peaks(device_kind=None, dtype="bf16"):
     if rec is None:
         return None, None
     return rec.get(dtype, rec["bf16"]), rec["hbm"]
+
+
+def device_hbm_bytes(device_kind=None):
+    """HBM capacity of one device, or None off the table — the static
+    memory planner's ME801 budget (analysis/memplan.py)."""
+    if device_kind is None:
+        try:
+            import jax
+            device_kind = jax.devices()[0].device_kind
+        except Exception:
+            return None
+    rec = PEAKS.get(device_kind)
+    return int(rec["hbm_bytes"]) if rec else None
+
+
+def min_vmem_budget():
+    """The smallest per-core VMEM across known generations — the
+    registration-time tile ceiling a portable Pallas kernel must fit
+    (analysis rule PK901: a kernel validated here runs on every listed
+    generation)."""
+    return int(min(rec["vmem_bytes"] for rec in PEAKS.values()))
 
 
 def cost_table(symbol, shapes, train=True):

@@ -1,11 +1,14 @@
 """Static-analysis (graph verifier & hazard linter) tests.
 
-Three seeded-hazard fixtures — a use-after-donation fused plan, a
-nondeterministic bucket order, a cache-churn attr — each tripping
-exactly one rule, plus zero-false-positive gates over the bundled
-model zoo and the ZeRO/scan/bucketed configurations, the GV/HS rule
-set, bind-time warn/raise surfaces, telemetry mirroring, suppression,
-and the registration-time infer-signature validation.
+Seeded-hazard fixtures — use-after-donation, nondeterministic bucket
+order, cache-churn attrs, and one per precision-flow rule
+(QT701–QT705) — each tripping exactly one rule, plus zero-false-
+positive gates over the bundled model zoo (f32 / simulated-bf16 /
+int8-quantized) and the ZeRO/scan/bucketed configurations, the GV/HS
+rule set, bind-time warn/raise surfaces, telemetry mirroring,
+suppression, the registration-time infer-signature validation, the
+Pallas kernel-spec validator (PK9xx), the env-var doc-sync audit, and
+the cost-metadata consistency contract.
 """
 import json
 import logging
@@ -15,10 +18,17 @@ import pytest
 
 import mxnet_tpu as mx
 from mxnet_tpu.analysis import (AnalysisContext, RULES, lint_json,
-                                lint_module, lint_symbol, run_passes)
+                                lint_executor, lint_module, lint_symbol,
+                                run_passes)
 from mxnet_tpu.kvstore_sched import BucketScheduler
 from mxnet_tpu.ops.registry import OpDef
 from mxnet_tpu.program_cache import attr_cache_stable
+
+
+def _precision_rules(sym, **ctx_kwargs):
+    report = run_passes(AnalysisContext(symbol=sym, **ctx_kwargs),
+                        passes=["precision_flow"])
+    return report
 
 
 def _two_fc():
@@ -119,6 +129,49 @@ def test_bundled_models_lint_clean(name, build, shapes):
     assert not len(report), f"{name}: {report.format()}"
 
 
+@pytest.mark.parametrize("name,build,shapes", MODEL_SHAPES,
+                         ids=[m[0] for m in MODEL_SHAPES])
+def test_bundled_models_bf16_precision_clean(name, build, shapes):
+    """Simulated-bf16 compute over the zoo: the QT7xx pass must stay
+    quiet (the mixed-precision entry cast is uniform — no mixing)."""
+    from mxnet_tpu import models
+    report = lint_symbol(build(models), shapes=shapes,
+                         compute_dtype="bfloat16")
+    assert not len(report), f"{name}@bf16: {report.format()}"
+
+
+@pytest.mark.parametrize("name,build,shapes", MODEL_SHAPES,
+                         ids=[m[0] for m in MODEL_SHAPES])
+def test_bundled_models_int8_quantized_lint_clean(name, build, shapes):
+    """The int8 quant-rewritten zoo lints clean: declared int8 cells,
+    Quantized* weight contracts, no QT/GV findings."""
+    from mxnet_tpu import models
+    qsym, _qargs = _quantized_model(lambda: build(models), shapes)
+    report = lint_symbol(qsym, shapes=shapes)
+    assert not len(report), f"{name}@int8: {report.format()}"
+
+
+def test_gv105_quantized_cells_bind_without_warning():
+    """GV105 regression gate: the quant rewrite's declared __dtype__
+    int8 cells must bind int8 and pass dtype validation with zero
+    warn-mode findings — for the MLP and a convnet."""
+    from mxnet_tpu import models
+    cases = [(models.mlp.get_symbol(10), {"data": (8, 784)}),
+             (models.lenet.get_symbol(10), {"data": (8, 1, 28, 28)})]
+    for sym, shapes in cases:
+        qsym, qargs = _quantized_model(lambda s=sym: s, shapes)
+        exe = qsym.simple_bind(ctx=mx.cpu(), grad_req="null",
+                               validate=None, **shapes)
+        # the executor honored the declarations (int8 cells bound)
+        bound = dict(zip(exe.arg_names, exe.arg_arrays))
+        qcells = [nm for nm in bound if nm.endswith("_q")]
+        assert qcells
+        for nm in qcells:
+            assert str(np.dtype(bound[nm].dtype)) == "int8", nm
+        report = lint_executor(exe)
+        assert not len(report), report.format()
+
+
 def test_fused_module_lint_clean():
     """The plain fused (replicated) arrangement has zero findings."""
     report = lint_module(_fused_module())
@@ -156,6 +209,82 @@ def test_kvstore_bucket_plan_lint_clean():
         kv.close()
 
 
+# ----------------------------------------------------- precision flow
+def test_fixture_qt701_silent_f32_upcast():
+    """A stock-f32 creation op mixed into a bf16 compute graph widens
+    the chain silently -> QT701 and nothing else."""
+    net = mx.sym.var("a") + mx.sym.zeros((4, 8))
+    report = _precision_rules(net, compute_dtype="bfloat16")
+    assert report.rules == {"QT701"}
+    assert len(report) == 1
+    # same graph at full f32: no reduced inputs, no finding
+    assert not len(_precision_rules(net))
+
+
+def test_fixture_qt702_unrewritten_quant_weight():
+    """A Quantized op fed a float weight (no int8+scale rewrite) is an
+    error -> QT702 alone."""
+    q = mx.sym.QuantizedFullyConnected(
+        mx.sym.var("data"), mx.sym.var("w"),
+        mx.sym.var("s", dtype="float32"), num_hidden=8, no_bias=True,
+        name="qfc")
+    report = _precision_rules(q)
+    assert report.rules == {"QT702"}
+    assert report.errors and "w" in report.errors[0].message
+
+
+def test_fixture_qt703_shared_int8_weight():
+    """The int8 weight also feeding a float consumer -> QT703 alone."""
+    wq = mx.sym.var("w_q", dtype="int8")
+    q = mx.sym.QuantizedFullyConnected(
+        mx.sym.var("data"), wq, mx.sym.var("s", dtype="float32"),
+        num_hidden=8, no_bias=True, name="qfc")
+    report = _precision_rules(mx.Group([q, mx.sym.sum(wq)]))
+    assert report.rules == {"QT703"}
+    assert "w_q" in report.errors[0].message
+
+
+def test_fixture_qt704_dequant_requant_roundtrip():
+    """int8 -> float -> (movement) -> int8 is a round trip -> QT704."""
+    v = mx.sym.var("q", dtype="int8")
+    f = mx.sym.Flatten(mx.sym.Cast(v, dtype="float32"))
+    report = _precision_rules(mx.sym.Cast(f, dtype="int8"))
+    assert report.rules == {"QT704"}
+    # a single explicit dequant (no requant) is NOT a round trip
+    assert not len(_precision_rules(mx.sym.Cast(v, dtype="float32")))
+
+
+def test_fixture_qt705_narrow_loss_accumulation():
+    """A loss head whose declared input dtype is bf16 -> QT705 alone;
+    compute_dtype-driven reduction (f32 master params) is exempt."""
+    d = mx.sym.var("data", dtype="bfloat16")
+    w = mx.sym.var("w", dtype="bfloat16")
+    b = mx.sym.var("b", dtype="bfloat16")
+    fc = mx.sym.FullyConnected(d, weight=w, bias=b, num_hidden=4,
+                               name="fc")
+    report = _precision_rules(mx.sym.SoftmaxOutput(fc, name="softmax"))
+    assert report.rules == {"QT705"}
+    # the exemption: an all-f32 graph under bf16 compute_dtype keeps
+    # its f32 master accumulation -> clean
+    clean = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(mx.sym.var("data"), num_hidden=4,
+                              name="fc2"), name="softmax2")
+    assert not len(_precision_rules(clean, compute_dtype="bfloat16"))
+
+
+def _quantized_model(build, shapes):
+    """Int8 quant-rewrite of a bundled model with zero weights (the
+    rewrite and lint surfaces are shape/dtype-driven)."""
+    import jax.numpy as jnp
+    from mxnet_tpu.ops.quant import quantize_symbol
+    sym = build()
+    arg_shapes, _o, _a = sym.infer_shape(**shapes)
+    args = {nm: mx.nd.NDArray(jnp.zeros(s, np.float32))
+            for nm, s in zip(sym.list_arguments(), arg_shapes)
+            if nm not in shapes}
+    return quantize_symbol(sym, args)
+
+
 # -------------------------------------------------------- graph verifier
 def test_gv_duplicate_variable():
     a = mx.sym.var("x")
@@ -187,12 +316,29 @@ def test_gv_inference_conflict_is_error():
 
 def test_gv_stall_without_infer_shape():
     """An op with neither infer_shape nor shape_passthrough stalls on a
-    partial input shape -> GV107 names the op."""
+    partial input shape -> GV107 names the op. (Flatten used to be the
+    fixture; it now registers a pure-python infer_shape for the
+    trace-free memory planner, so a scratch op seeds the stall.)"""
+    from mxnet_tpu.ops.registry import OP_REGISTRY, register
+    from mxnet_tpu.symbol import _create
+    if "lint_stall_fixture" not in OP_REGISTRY:
+        register("lint_stall_fixture",
+                 simple=lambda attrs, x: x.reshape(x.shape[0], -1))
     d = mx.sym.var("data", shape=(0, 5))     # batch unknown
-    net = mx.sym.Flatten(d)
+    net = _create("lint_stall_fixture", [d])
     report = lint_symbol(net)
     assert "GV107" in report.rules
-    assert any(f.op == "Flatten" for f in report)
+    assert any(f.op == "lint_stall_fixture" for f in report)
+
+
+def test_flatten_infers_without_abstract_eval():
+    """Flatten's registered infer_shape propagates partial batch dims
+    in pure python (no eval_shape fallback)."""
+    d = mx.sym.var("data", shape=(0, 5))
+    net = mx.sym.Flatten(d)
+    assert "GV107" not in lint_symbol(net).rules
+    _, outs, _ = net.infer_shape_partial()
+    assert outs == [(0, 5)]
 
 
 def test_gv_shape_passthrough_flag_infers_and_silences():
@@ -208,12 +354,30 @@ def test_gv_shape_passthrough_flag_infers_and_silences():
 
 
 def test_gv_dtype_conflict():
+    """An explicitly bound array conflicting with the declared dtype
+    trips GV105 (simple_bind now honors declarations itself — the
+    conflict needs a user-provided array)."""
     d = mx.sym.var("data", dtype="float16")
     net = mx.sym.FullyConnected(d, num_hidden=4, name="fc")
-    exe = net.simple_bind(ctx=mx.cpu(), data=(2, 8), validate=None)
+    args = {"data": mx.nd.zeros((2, 8)),           # f32, declared f16
+            "fc_weight": mx.nd.zeros((4, 8)),
+            "fc_bias": mx.nd.zeros((4,))}
+    exe = net.bind(mx.cpu(), args=args, grad_req="null", validate=None)
     from mxnet_tpu.analysis import lint_executor
     report = lint_executor(exe)
     assert "GV105" in report.rules
+
+
+def test_simple_bind_honors_declared_dtype():
+    """simple_bind binds a declared __dtype__ cell (the quant tier's
+    int8 weights) instead of silently upcasting to f32."""
+    d = mx.sym.var("data", dtype="float16")
+    net = mx.sym.FullyConnected(d, num_hidden=4, name="fc")
+    exe = net.simple_bind(ctx=mx.cpu(), data=(2, 8), validate=None)
+    bound = dict(zip(exe.arg_names, exe.arg_arrays))
+    assert str(np.dtype(bound["data"].dtype)) == "float16"
+    from mxnet_tpu.analysis import lint_executor
+    assert "GV105" not in lint_executor(exe).rules
 
 
 def test_json_dead_node_and_dangling_input():
@@ -495,6 +659,176 @@ def test_mxlint_rules_listing(capsys):
     out = capsys.readouterr().out
     for rule in RULES:
         assert rule in out
+
+
+def test_mxlint_env_audit_gate(capsys):
+    """The doc-sync CI gate: zero drift, exit 0."""
+    main = _mxlint_main()
+    assert main(["--env-audit"]) == 0
+    out = capsys.readouterr().out
+    assert "0 undocumented, 0 dead rows" in out
+
+
+def test_mxlint_memory_plan_cli(capsys):
+    """--memory-plan renders a per-policy plan; a tiny capacity trips
+    ME801 (exit 1), headroom trips ME802 (info, exit 0)."""
+    main = _mxlint_main()
+    assert main(["--memory-plan", "resnet20", "--policy", "none",
+                 "--policy", "dots", "--batch", "64"]) == 0
+    out = capsys.readouterr().out
+    assert "memory plan for resnet20" in out and "residuals" in out
+
+    assert main(["--memory-plan", "resnet20", "--batch", "256",
+                 "--capacity-gb", "0.05"]) == 1
+    out = capsys.readouterr().out
+    assert "ME801" in out
+
+    assert main(["--memory-plan", "resnet20", "--batch", "64",
+                 "--policy", "all", "--capacity-gb", "4"]) == 0
+    out = capsys.readouterr().out
+    assert "ME802" in out
+
+    assert main(["--memory-plan", "nosuchmodel"]) == 2
+
+
+def test_mxlint_precision_audit_cli(capsys):
+    """The quant/mixed-precision zoo audits clean through the CLI
+    (mlp only here — the full corpus runs under --check in CI)."""
+    main = _mxlint_main()
+    assert main(["--precision-audit", "--compute-dtype",
+                 "float32"]) == 0
+    out = capsys.readouterr().out
+    assert "models/mlp@float32" in out and "models/mlp@int8" in out
+
+
+def test_mxlint_mfu_audit_includes_planner_bytes(capsys):
+    main = _mxlint_main()
+    assert main(["--mfu-audit"]) == 0
+    out = capsys.readouterr().out
+    assert "planner per-op" in out and "BatchNorm" in out
+
+
+# ------------------------------------ Pallas kernel validator (PK9xx)
+def _dummy_variant(attrs, inputs, aux, is_train, rng):
+    return list(inputs), []
+
+
+def test_fixture_pk901_vmem_overflow():
+    """A declared working set past the per-generation VMEM budget
+    fails loudly at registration with PK901."""
+    op = OpDef("pk901_fixture", lambda *a: ([], []))
+    with pytest.raises(mx.MXNetError, match="PK901"):
+        op.add_variant("pallas", _dummy_variant, kernel_spec={
+            "tiles": [((256, 32768), "float32")] * 2,   # 64 MiB
+            "dtypes": ("float32",)})
+    assert "pallas" not in op.variants
+
+
+def test_fixture_pk902_misaligned_tile():
+    """Lane (last % 128) and sublane (dtype rows) misalignment both
+    fail with PK902."""
+    op = OpDef("pk902_fixture", lambda *a: ([], []))
+    with pytest.raises(mx.MXNetError, match="PK902"):
+        op.add_variant("pallas", _dummy_variant, kernel_spec={
+            "tiles": [((8, 100), "float32")], "dtypes": ("float32",)})
+    with pytest.raises(mx.MXNetError, match="PK902"):
+        op.add_variant("pallas", _dummy_variant, kernel_spec={
+            "tiles": [((8, 128), "int8")],     # int8 packs 32 rows
+            "dtypes": ("int8",)})
+
+
+def test_fixture_pk903_dtype_coverage():
+    """Empty or gate-uncoverable dtype sets fail with PK903."""
+    op = OpDef("pk903_fixture", lambda *a: ([], []))
+    with pytest.raises(mx.MXNetError, match="PK903"):
+        op.add_variant("pallas", _dummy_variant, kernel_spec={
+            "tiles": [((8, 128), "float32")], "dtypes": ()})
+    with pytest.raises(mx.MXNetError, match="PK903"):
+        op.add_variant("pallas", _dummy_variant, kernel_spec={
+            "tiles": [((8, 128), "float32")],
+            "dtypes": ("float64",)})
+
+
+def test_registered_pallas_variants_all_declare_specs():
+    """Every shipped production Pallas variant carries a validated
+    kernel_spec — an infeasible production kernel can no longer
+    register. (User rtc kernels may omit the spec.)"""
+    from mxnet_tpu.analysis.kernelcheck import validate_kernel_spec
+    from mxnet_tpu.ops.registry import get_op
+    shipped = ["SoftmaxOutput", "FusedConvBNReLU", "LayerNorm",
+               "FusedBiasGeLU", "Embedding", "sgd_mom_update",
+               "adam_update", "QuantizedFullyConnected",
+               "QuantizedConvolution", "pallas_sgd_mom_update",
+               "pallas_flash_attention", "attention"]
+    for name in shipped:
+        rec = get_op(name).variants["pallas"]
+        spec = rec.get("kernel_spec")
+        assert spec is not None, f"{name}:pallas has no kernel_spec"
+        validate_kernel_spec(name, "pallas", spec)    # idempotent
+
+
+def test_valid_kernel_spec_registers():
+    op = OpDef("pk_ok_fixture", lambda *a: ([], []))
+    op.add_variant("pallas", _dummy_variant, kernel_spec={
+        "tiles": [((256, 128), "float32"), ((32, 128), "int8")],
+        "dtypes": ("float32", "int8")})
+    assert op.variants["pallas"]["kernel_spec"]["dtypes"] == (
+        "float32", "int8")
+
+
+# ------------------------------------------- env-var doc-sync audit
+def test_env_audit_in_sync():
+    """MXNET_* env reads and docs/env_var.md rows match (the CI gate
+    behind ``mxlint --env-audit``)."""
+    import os
+    from mxnet_tpu.analysis import envaudit
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    result = envaudit.audit(repo)
+    assert not result["undocumented"], result["undocumented"]
+    assert not result["dead"], result["dead"]
+    # sanity: the scan actually sees the surface, both spellings
+    assert "MXNET_GRAPH_VALIDATE" in result["code_vars"]
+    assert any(p.startswith("MXNET_RETRY_")
+               for p in result["code_prefixes"])
+
+
+def test_env_audit_detects_drift(tmp_path):
+    """A synthetic tree with an undocumented read and a dead row."""
+    from mxnet_tpu.analysis import envaudit
+    pkg = tmp_path / "mxnet_tpu"
+    pkg.mkdir()
+    (pkg / "m.py").write_text(
+        "import os\nX = os.environ.get('MXNET_SECRET_KNOB', '')\n")
+    docs = tmp_path / "docs"
+    docs.mkdir()
+    (docs / "env_var.md").write_text("* `MXNET_GHOST_KNOB` — unused\n")
+    result = envaudit.audit(str(tmp_path))
+    assert result["undocumented"] == ["MXNET_SECRET_KNOB"]
+    assert result["dead"] == ["MXNET_GHOST_KNOB"]
+
+
+# --------------------------------------- cost-metadata consistency
+def test_every_flops_estimator_has_bytes():
+    """The planner and the roofline both fold per-op byte counts: an
+    op with flops but no bytes (or vice versa) under-counts one axis
+    while looking covered. The registry must have none."""
+    from mxnet_tpu.ops.cost import partial_cost_ops
+    assert partial_cost_ops() == []
+
+
+def test_planner_per_op_bytes_cover_cost_ops():
+    """The planner's per-op byte table names the ops that dominate the
+    resnet20 residual bill, and they all carry cost metadata."""
+    from mxnet_tpu import models
+    from mxnet_tpu.analysis import memplan
+    from mxnet_tpu.ops.registry import get_op
+    plan = memplan.plan_symbol(
+        models.resnet.get_symbol(10, 20, "3,32,32"),
+        {"data": (4, 3, 32, 32)}, policy="none")
+    assert plan["per_op_bytes"]
+    assert "BatchNorm" in plan["per_op_bytes"]
+    for op in plan["per_op_bytes"]:
+        assert get_op(op).has_cost(), op
 
 
 # -------------------------------- registration-time infer validation (S2)

@@ -118,6 +118,43 @@ def _lint_section(counters, lint_records):
     return lines
 
 
+def _memplan_section(gauges, records):
+    """Static memory-plan report from the memplan.* gauges
+    (analysis/memplan.py: mxlint --memory-plan, exec_group.
+    static_memory_plan, or the armed memory_planner lint pass) plus the
+    memplan.plan flight-ring notes. Rendered only when plans exist."""
+    plans = {}
+    for name, labels, val in gauges:
+        if not name.startswith("memplan."):
+            continue
+        key = (labels.get("model", ""), labels.get("policy", "?"))
+        plans.setdefault(key, {})[name[len("memplan."):]] = val
+    if not plans and not records:
+        return []
+    lines = ["memory plan (static, pre-compile):"]
+    for (model, policy), rec in sorted(plans.items()):
+        tag = f"{model} " if model else ""
+        parts = [f"{tag}policy={policy}"]
+        if "peak_bytes_per_device" in rec:
+            parts.append(
+                f"peak {_fmt_bytes(rec['peak_bytes_per_device'])}/dev")
+        if "residual_bytes" in rec:
+            parts.append(f"residuals {_fmt_bytes(rec['residual_bytes'])}")
+        if "param_bytes" in rec:
+            parts.append(f"params {_fmt_bytes(rec['param_bytes'])}")
+        if "batch_bytes" in rec:
+            parts.append(f"batch {_fmt_bytes(rec['batch_bytes'])}")
+        lines.append("  " + ", ".join(parts))
+    for r in (records or [])[-3:]:
+        lines.append(f"  planned: {r.get('model') or 'binding'} "
+                     f"policy={r.get('policy', '?')} "
+                     f"batch={r.get('batch', '?')} -> peak "
+                     f"{_fmt_bytes(r.get('peak_bytes', 0))}")
+    lines.append("  (predict OOM before compile: "
+                 "python tools/mxlint.py --memory-plan <model>)")
+    return lines
+
+
 def _fmt_flops(f):
     f = float(f)
     for unit in ("FLOP/s", "kFLOP/s", "MFLOP/s", "GFLOP/s", "TFLOP/s"):
@@ -514,6 +551,9 @@ def render_crash(report, top=10):
     out += _roofline_section(
         _gauge_triples_from_series(metrics.get("gauges") or {}),
         [r for r in ring if r.get("kind") == "span"], top=top)
+    out += _memplan_section(
+        _gauge_triples_from_series(metrics.get("gauges") or {}),
+        [r for r in ring if r.get("kind") == "memplan.plan"])
     out += _serving_section(
         metrics.get("counters") or {},
         _gauge_triples_from_series(metrics.get("gauges") or {}),
@@ -641,6 +681,10 @@ def render_jsonl(lines, top=10):
         [(name, dict(labels), val)
          for (name, labels), val in gauges.items()],
         spans, top=top)
+    out += _memplan_section(
+        [(name, dict(labels), val)
+         for (name, labels), val in gauges.items()],
+        [e for e in events if e.get("kind") == "memplan.plan"])
     out += _serving_section(
         counters,
         [(name, dict(labels), val)

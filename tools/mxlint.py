@@ -1,26 +1,45 @@
 #!/usr/bin/env python
 """mxlint: lint symbol JSON files and bundled models for graph hazards.
 
-The CLI face of ``mxnet_tpu.analysis`` — the same five static-analysis
+The CLI face of ``mxnet_tpu.analysis`` — the same static-analysis
 passes that run at ``bind(validate=...)`` time (graph verifier,
-donation/aliasing, collective order, retrace churn, host sync), pointed
-at artifacts instead of live bindings:
+donation/aliasing, collective order, retrace churn, host sync,
+precision flow), pointed at artifacts instead of live bindings:
 
 * a saved symbol JSON (``model-symbol.json``) — structural rules
   (dangling inputs, dead nodes) plus the full pass set over the loaded
   graph, optionally seeded with ``--shape name=1,3,224,224``;
 * ``--check`` — the CI gate: lints every bundled ``mxnet_tpu/models/``
   symbol and the two ``examples/dcgan.py`` graphs under their canonical
-  input shapes, expecting zero findings.
+  input shapes (expecting zero findings), runs the precision audit over
+  the bundled models at bf16 AND int8-quantized tiers, plans resnet20's
+  memory at two remat policies, and runs the env-var doc-sync audit;
+* ``--precision-audit`` — the QT7xx precision-flow pass alone over the
+  bundled models, at f32 and simulated-bf16 compute plus the int8
+  quant-rewritten variants (``--compute-dtype`` overrides);
+* ``--memory-plan <model>`` — the static memory planner: peak-HBM
+  components for one bundled model with ``--policy`` (repeatable),
+  ``--batch``, ``--num-devices``/``--zero``, ``--optimizer``; ME801/802
+  findings against ``--capacity-gb`` (default: the current device's
+  HBM table entry, when known);
+* ``--env-audit`` — MXNET_* env reads vs docs/env_var.md rows, both
+  directions (the CI doc-sync gate);
+* ``--mfu-audit`` — registry cost-metadata coverage, plus the memory
+  planner's per-op byte sizes over resnet20 (the shared byte table the
+  roofline and the planner both consume).
 
 Exit status: 0 = no error-severity findings (``--strict``: no findings
-at all), 1 = findings at the failing severity, 2 = usage/IO trouble.
-Suppress rules with ``MXNET_LINT_DISABLE=GV107,HS501,...``.
+at all), 1 = findings at the failing severity (or audit drift), 2 =
+usage/IO trouble. Suppress rules with
+``MXNET_LINT_DISABLE=GV107,HS501,...``.
 
 Usage:
     python tools/mxlint.py model-symbol.json --shape data=1,3,224,224
     python tools/mxlint.py --check
     python tools/mxlint.py --rules
+    python tools/mxlint.py --precision-audit
+    python tools/mxlint.py --memory-plan resnet20 --policy dots --batch 256
+    python tools/mxlint.py --env-audit
 """
 from __future__ import annotations
 
@@ -86,6 +105,140 @@ def _check_corpus():
     return corpus
 
 
+def _model_by_name(name):
+    """(build, canonical_shapes) for one bundled-model short name."""
+    for target, build, shapes in _check_corpus():
+        if target.split("/", 1)[-1] == name or target == name:
+            return build, shapes
+    raise KeyError(name)
+
+
+def _with_batch(shapes, batch):
+    if not batch:
+        return dict(shapes)
+    return {nm: (batch,) + tuple(s[1:]) for nm, s in shapes.items()}
+
+
+def _quantized(build, shapes):
+    """Int8 quant-rewrite of one corpus model (convnets/mlps only)."""
+    import numpy as np
+    from mxnet_tpu.ndarray import NDArray
+    from mxnet_tpu.ops.quant import quantize_symbol
+    import jax.numpy as jnp
+    sym = build()
+    arg_shapes, _o, _a = sym.infer_shape(**shapes)
+    # zero weights quantize on the scale-1.0 path — the rewrite and the
+    # lint surface are shape/dtype-driven, so cheap params suffice even
+    # for the vgg16-sized corpus entries
+    args = {nm: NDArray(jnp.zeros(s, np.float32))
+            for nm, s in zip(sym.list_arguments(), arg_shapes)
+            if nm not in shapes}
+    return quantize_symbol(sym, args)[0]
+
+
+def run_precision_audit(out, compute_dtypes=("float32", "bfloat16"),
+                        as_json=False, quiet=False):
+    """QT7xx pass over the bundled models per compute tier, plus the
+    int8 quant-rewritten variants; returns the findings list."""
+    from mxnet_tpu import analysis
+
+    findings = []
+    for name, build, shapes in _check_corpus():
+        variants = [(f"{name}@{cd}", lambda b=build: b(), cd)
+                    for cd in compute_dtypes]
+        if name.startswith("models/"):
+            variants.append((f"{name}@int8",
+                             lambda b=build, s=shapes: _quantized(b, s),
+                             None))
+        for target, make, cd in variants:
+            try:
+                report = analysis.run_passes(analysis.AnalysisContext(
+                    symbol=make(), known_shapes=shapes,
+                    compute_dtype=cd), passes=["precision_flow"])
+            except Exception as e:  # noqa: BLE001
+                findings.append({"target": target, "rule": "XX001",
+                                 "severity": "error", "node": None,
+                                 "hint": None,
+                                 "message": f"could not build/audit: "
+                                            f"{type(e).__name__}: {e}"})
+                continue
+            for d in report:
+                rec = d.as_dict()
+                rec["target"] = target
+                findings.append(rec)
+            if not as_json and not quiet:
+                status = "ok" if not len(report) else \
+                    f"{len(report)} finding(s)"
+                print(f"  {target:<40} {status}", file=out)
+    return findings
+
+
+def run_memory_plan(model, out, policies=("none",), batch=None,
+                    capacity_gb=None, optimizer="sgd_mom", n_data=1,
+                    zero=False, as_json=False, quiet=False):
+    """Plan one bundled model's memory per policy; ME8xx findings."""
+    from mxnet_tpu.analysis import memplan
+    from mxnet_tpu.telemetry.mfu import device_hbm_bytes
+
+    build, shapes = _model_by_name(model)
+    shapes = _with_batch(shapes, batch)
+    capacity = int(capacity_gb * (1 << 30)) if capacity_gb else \
+        device_hbm_bytes()
+    buckets = (32, 64, 128, 256, 512)
+    findings = []
+    plans = {}
+    for policy in policies:
+        plan = memplan.plan_symbol(build(), shapes, policy=policy,
+                                   optimizer=optimizer, n_data=n_data,
+                                   zero=zero)
+        memplan.record_plan(plan, model=model)
+        plans[policy] = plan
+        for d in memplan.plan_findings(plan, capacity_bytes=capacity,
+                                       buckets=buckets, where=model):
+            rec = d.as_dict()
+            rec["target"] = f"{model}@{policy}"
+            findings.append(rec)
+        if not as_json and not quiet:
+            print(memplan.format_plan(plan, model=model,
+                                      capacity_bytes=capacity),
+                  file=out)
+    if as_json:
+        json.dump({"model": model, "plans": plans,
+                   "findings": findings}, out, indent=2)
+        print(file=out)
+    return findings
+
+
+def run_env_audit(out, as_json=False, quiet=False):
+    """Doc-sync audit; returns error-severity findings on drift."""
+    from mxnet_tpu.analysis import envaudit
+
+    result = envaudit.audit(_REPO_ROOT)
+    findings = []
+    for name in result["undocumented"]:
+        findings.append({"target": "env-audit", "rule": "XX001",
+                         "severity": "error", "node": name,
+                         "hint": "add a docs/env_var.md row",
+                         "message": f"{name} is read by mxnet_tpu/ but "
+                                    "has no docs/env_var.md row"})
+    for name in result["dead"]:
+        findings.append({"target": "env-audit", "rule": "XX001",
+                         "severity": "error", "node": name,
+                         "hint": "drop the dead row (or wire the knob)",
+                         "message": f"{name} is documented in "
+                                    "docs/env_var.md but nothing in "
+                                    "mxnet_tpu/ reads it"})
+    if as_json:
+        json.dump(result, out, indent=2)
+        print(file=out)
+    elif not quiet:
+        print(f"  env-audit: {len(result['code_vars'])} vars read, "
+              f"{len(result['doc_vars'])} documented, "
+              f"{len(result['undocumented'])} undocumented, "
+              f"{len(result['dead'])} dead rows", file=out)
+    return findings
+
+
 def run_check(out, as_json=False):
     """Lint the bundled corpus; returns the merged findings list."""
     from mxnet_tpu import analysis
@@ -147,8 +300,40 @@ def main(argv=None):
                    help="print the rule catalog and exit")
     p.add_argument("--mfu-audit", action="store_true", dest="mfu_audit",
                    help="list registry ops missing flops/bytes cost "
-                        "metadata (MFU coverage gaps; rule MF601) and "
-                        "exit")
+                        "metadata (MFU coverage gaps; rule MF601) plus "
+                        "the planner's per-op byte sizes, and exit")
+    p.add_argument("--precision-audit", action="store_true",
+                   dest="precision_audit",
+                   help="run the QT7xx precision-flow pass over the "
+                        "bundled models (f32 + bf16 + int8-quantized)")
+    p.add_argument("--compute-dtype", dest="compute_dtype", default=None,
+                   help="compute dtype(s) for --precision-audit, comma-"
+                        "separated (default: float32,bfloat16)")
+    p.add_argument("--memory-plan", dest="memory_plan", metavar="MODEL",
+                   help="static peak-HBM plan for one bundled model "
+                        "(e.g. resnet20); ME801/802 findings against "
+                        "--capacity-gb")
+    p.add_argument("--policy", action="append", dest="policies",
+                   choices=["none", "dots", "all"],
+                   help="remat policy for --memory-plan (repeatable; "
+                        "default none)")
+    p.add_argument("--batch", type=int, default=None,
+                   help="batch size override for --memory-plan")
+    p.add_argument("--capacity-gb", type=float, dest="capacity_gb",
+                   default=None,
+                   help="device HBM capacity for ME801/802 (default: "
+                        "the current device's table entry, if known)")
+    p.add_argument("--optimizer", default="sgd_mom",
+                   help="optimizer for --memory-plan state sizing "
+                        "(default sgd_mom)")
+    p.add_argument("--num-devices", type=int, dest="num_devices",
+                   default=1,
+                   help="data-parallel shard count for --memory-plan")
+    p.add_argument("--zero", action="store_true",
+                   help="ZeRO-1 state sharding for --memory-plan")
+    p.add_argument("--env-audit", action="store_true", dest="env_audit",
+                   help="audit MXNET_* env reads against "
+                        "docs/env_var.md (both directions)")
     p.add_argument("--json", action="store_true", dest="as_json",
                    help="emit findings as one JSON document")
     p.add_argument("--strict", action="store_true",
@@ -167,23 +352,44 @@ def main(argv=None):
     if args.mfu_audit:
         # registry-wide coverage audit (MF601's graph-level cousin):
         # every op here is invisible to MFU/roofline accounting
-        from mxnet_tpu.ops.cost import uncovered_ops
+        from mxnet_tpu.ops.cost import uncovered_ops, partial_cost_ops
         from mxnet_tpu.ops.registry import OP_REGISTRY
         missing = uncovered_ops()
+        partial = partial_cost_ops()
         covered = len({id(o) for o in OP_REGISTRY.values()}) - len(missing)
+        # the planner's per-op byte sizes over the resnet20 reference
+        # graph: the byte table the roofline AND the memory planner
+        # consume, surfaced side by side with the coverage gaps
+        from mxnet_tpu.analysis import memplan
+        build, shapes = _model_by_name("resnet20")
+        plan = memplan.plan_symbol(build(), shapes, policy="none")
+        planner_bytes = dict(sorted(plan["per_op_bytes"].items(),
+                                    key=lambda kv: -kv[1]))
         if args.as_json:
             json.dump({"covered_ops": covered,
-                       "uncovered_ops": missing}, out, indent=2)
+                       "uncovered_ops": missing,
+                       "partial_cost_ops": partial,
+                       "planner_op_bytes": planner_bytes}, out, indent=2)
             print(file=out)
         else:
             for name in missing:
                 print(f"  MF601 [info] op {name!r} has no flops/bytes "
                       "cost metadata", file=out)
+            for name in partial:
+                print(f"  MF601 [warning] op {name!r} has only one of "
+                      "flops/bytes (half-seeded estimator)", file=out)
+            print("  planner per-op residual/output bytes (resnet20 "
+                  "b4, policy none):", file=out)
+            for op, nb in planner_bytes.items():
+                print(f"    {op:<24} {nb / (1 << 20):8.2f} MiB",
+                      file=out)
             print(f"mxlint: {covered} ops covered, {len(missing)} "
                   "missing cost metadata (seed ops/cost.py)", file=out)
-        return 0
+        return 1 if partial else 0
 
-    if not args.check and not args.paths:
+    audit_mode = args.precision_audit or args.memory_plan or \
+        args.env_audit
+    if not args.check and not args.paths and not audit_mode:
         p.print_usage(file=sys.stderr)
         print("mxlint: nothing to lint (pass symbol JSON paths or "
               "--check)", file=sys.stderr)
@@ -199,6 +405,37 @@ def main(argv=None):
     try:
         if args.check:
             findings += run_check(out, as_json=args.as_json)
+            # the CI gate also covers the precision tiers, a resnet20
+            # memory plan at two policies (plan construction must
+            # succeed; ME findings only fire against a real capacity),
+            # and the env-var doc sync
+            findings += run_precision_audit(out, quiet=args.as_json)
+            findings += run_memory_plan(
+                "resnet20", out, policies=("none", "dots"),
+                capacity_gb=args.capacity_gb, quiet=args.as_json)
+            findings += run_env_audit(out, quiet=args.as_json)
+        if args.precision_audit:
+            dtypes = tuple(
+                d.strip() for d in
+                (args.compute_dtype or "float32,bfloat16").split(",")
+                if d.strip())
+            findings += run_precision_audit(out, compute_dtypes=dtypes,
+                                            as_json=args.as_json)
+        if args.memory_plan:
+            try:
+                findings += run_memory_plan(
+                    args.memory_plan, out,
+                    policies=tuple(args.policies or ("none",)),
+                    batch=args.batch, capacity_gb=args.capacity_gb,
+                    optimizer=args.optimizer, n_data=args.num_devices,
+                    zero=args.zero, as_json=args.as_json)
+            except KeyError:
+                print(f"mxlint: unknown model {args.memory_plan!r} "
+                      "(bundled: mlp, lenet, alexnet, vgg16, resnet20, "
+                      "inception_bn, inception_v3)", file=sys.stderr)
+                return 2
+        if args.env_audit:
+            findings += run_env_audit(out, as_json=args.as_json)
         for path in args.paths:
             findings += lint_path(path, shapes, out, as_json=args.as_json)
     except FileNotFoundError as e:
