@@ -356,6 +356,113 @@ def measure_quant_serve_variant():
         return {"error": f"{type(e).__name__}: {e}"}
 
 
+def measure_lm_variant():
+    """The ``lm`` variant row: the transformer workload's three axes
+    (ROADMAP 1) — training tokens/s + step time through the fused
+    Module.fit path, incremental KV-cache decode tokens/s, and a
+    max-context-length sweep that walks the context up until the static
+    memory planner's ME801 predicted-OOM trips against the device HBM
+    capacity. Also attaches the kernel-tier selection table filtered to
+    the attention family, so the xla/flash/ring pick per shape lands in
+    the payload. Small model on CPU, bench-scale on TPU; never sinks
+    the run."""
+    import time
+    import numpy as np
+    import jax
+    import mxnet_tpu as mx
+
+    try:
+        from mxnet_tpu.models import transformer as tfm
+        from mxnet_tpu import kernel_tier
+        from mxnet_tpu.analysis import memplan
+        from mxnet_tpu.telemetry.mfu import device_hbm_bytes
+
+        on_tpu = jax.default_backend() == "tpu"
+        V, D, L, H = (32000, 512, 8, 8) if on_tpu else (128, 64, 2, 4)
+        T, B = (1024, 8) if on_tpu else (32, 8)
+        n_batches = 8
+
+        sym = tfm.get_symbol(vocab_size=V, d_model=D, n_layer=L,
+                             n_head=H, seq_len=T)
+        it = tfm.SyntheticLMIter(V, B, T, n_batches=n_batches, seed=0)
+        mod = mx.mod.Module(sym)
+        steps = []
+
+        def cb(param):
+            steps.append(time.perf_counter())
+
+        mod.fit(it, num_epoch=2, optimizer="sgd",
+                optimizer_params=(("learning_rate", 0.05),),
+                initializer=mx.initializer.Xavier(),
+                batch_end_callback=cb)
+        # steady state: the second epoch's inter-batch gaps
+        laps = np.diff(steps[n_batches:])
+        step_s = float(np.median(laps)) if len(laps) else None
+        train_tok_s = (B * T / step_s) if step_s else None
+
+        # incremental decode tokens/s through the KV cache
+        args, _ = mod.get_params()
+        dec_sym = tfm.get_decode_symbol(vocab_size=V, d_model=D,
+                                        n_layer=L, n_head=H, capacity=T)
+        dec = mx.mod.Module(dec_sym, label_names=[])
+        dec.bind([("data", (B, 1))], None, for_training=False)
+        dec.init_params(initializer=None, arg_params=args, aux_params={},
+                        allow_missing=True)
+        drv = tfm.KVCacheDecoder(dec, capacity=T)
+        tokens = np.random.RandomState(0).randint(0, V, (B, T))
+        drv.step(tokens[:, :1]).asnumpy()          # compile + warm
+        drv.reset()
+        n_dec = min(T, 64)
+        tic = time.perf_counter()
+        for t in range(n_dec):
+            out = drv.step(tokens[:, t:t + 1])
+        out.asnumpy()
+        dec_s = time.perf_counter() - tic
+        decode_tok_s = B * n_dec / dec_s if dec_s else None
+
+        # max-context sweep: double the context until ME801 trips
+        capacity = device_hbm_bytes() or (16 << 30)
+        sweep, max_ctx = [], None
+        ctx = T
+        while ctx <= (1 << 20):
+            plan = memplan.plan_symbol(
+                tfm.get_symbol(vocab_size=V, d_model=D, n_layer=L,
+                               n_head=H, seq_len=ctx),
+                {"data": (B, ctx), "softmax_label": (B * ctx,)},
+                policy="dots")
+            fits = plan["peak_bytes_per_device"] <= capacity
+            sweep.append({"context": ctx,
+                          "peak_gb": round(
+                              plan["peak_bytes_per_device"] / 2**30, 3),
+                          "fits": fits})
+            if not fits:
+                break
+            max_ctx = ctx
+            ctx *= 2
+
+        attn_rows = [
+            {k: d.get(k) for k in ("op", "variant", "reason", "xla_ms",
+                                   "pallas_ms", "source", "shapes")}
+            for d in kernel_tier.decisions()
+            if "attention" in str(d.get("op", ""))]
+        return {
+            "model": {"vocab": V, "d_model": D, "layers": L, "heads": H,
+                      "seq_len": T, "batch": B},
+            "train_tokens_per_sec": None if train_tok_s is None
+            else round(train_tok_s, 1),
+            "step_ms": None if step_s is None else round(step_s * 1e3, 2),
+            "decode_tokens_per_sec": None if decode_tok_s is None
+            else round(decode_tok_s, 1),
+            "max_context": max_ctx,
+            "max_context_policy": "dots",
+            "hbm_capacity_gb": round(capacity / 2**30, 1),
+            "context_sweep": sweep,
+            "attention_selection": attn_rows,
+        }
+    except Exception as e:          # the variant must never sink the run
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
 def measure_remat_memory_variant():
     """Residual-byte delta per remat policy at the resnet20 bench point
     (benchmarks/remat_memory.py): the roofline-side record of what
@@ -479,6 +586,7 @@ def run_cpu_fallback():
         "quant": measure_quant_serve_variant(),
         "ckpt": measure_ckpt_variant(),
         "remat_memory": measure_remat_memory_variant(),
+        "lm": measure_lm_variant(),
         "kernel_tier_selection": kernel_tier_selection_table(),
         "note": "accelerator backend unavailable; ours-only fused-step "
                 "throughput on the XLA CPU backend at a CIFAR-scale "
@@ -703,6 +811,11 @@ def main():
     _log("remat variant (residual bytes per policy)")
     remat_variant = measure_remat_memory_variant()
 
+    # lm variant: transformer tokens/s + KV-decode + max-context sweep
+    # (ROADMAP 1) — the attention xla/flash/ring selection table rides in
+    _log("lm variant (transformer train/decode/max-context)")
+    lm_variant = measure_lm_variant()
+
     # per-op MFU attribution + roofline from the registry cost metadata
     # (telemetry/mfu.py): coverage is attributed FLOPs over the XLA
     # compiled-program count — the honesty check on the per-op numbers
@@ -773,6 +886,7 @@ def main():
         "quant": quant_variant,
         "ckpt": ckpt_variant,
         "remat_memory": remat_variant,
+        "lm": lm_variant,
         "kernel_tier_selection": kernel_tier_selection_table(),
         "mfu_ours": mfu(ours_img_s, ours_flops),
         "mfu_flax": mfu(flax_img_s, flax_flops),

@@ -79,6 +79,10 @@ _SAVE_NOTHING = frozenset({
     "BlockGrad", "stop_gradient", "Concat", "concat", "SliceChannel",
     "split", "slice", "slice_axis", "expand_dims", "Embedding",
     "one_hot", "_zeros", "_ones", "_arange", "add_n",
+    # RoPE is linear in x (fixed-angle rotation): its vjp is the inverse
+    # rotation, no activation saved beyond the (T, D/2) trig tables;
+    # attention_decode is inference-only (never differentiated)
+    "RoPE", "attention_decode",
 })
 
 
@@ -173,6 +177,31 @@ def plan_symbol(symbol, shapes, policy="none", for_training=True,
     batch_bytes = sum(entry_bytes((id(n), 0)) for n in nodes
                       if n.is_variable and n.name in batch_names)
     aux_bytes = sum(_nelems(s) * 4 for s in aux_shapes if s is not None)
+    # KV-cache accounting: a stateful-inference op's aux states (the
+    # fixed-capacity K/V cache + cursor) are the decode path's dominant
+    # resident bytes — charge them into the per-op table so the plan
+    # names WHERE the HBM goes, not just that aux is big
+    kv_charges = []
+    for n in nodes:
+        if n.is_variable:
+            continue
+        try:
+            opdef = n.opdef()
+        except Exception:
+            continue
+        if not getattr(opdef, "stateful_infer", False):
+            continue
+        aux_n = len(opdef.aux_names(n.attrs))
+        if not aux_n:
+            continue
+        nb = 0
+        for inp, idx in n.inputs[len(n.inputs) - aux_n:]:
+            store = known.get(inp.name)
+            if store is not None and 0 not in tuple(store):
+                nb += _nelems(store) * _itemsize(
+                    dtypes.get((id(inp), idx), "float32"))
+        kv_charges.append((n.op, nb))
+    kv_cache_bytes = sum(nb for _, nb in kv_charges)
     output_bytes = sum(_nelems(s) * 4 for s in out_shapes
                        if s is not None)
 
@@ -181,6 +210,9 @@ def plan_symbol(symbol, shapes, policy="none", for_training=True,
     def charge(op, nbytes):
         if nbytes:
             per_op_bytes[op] = per_op_bytes.get(op, 0) + int(nbytes)
+
+    for _op, _nb in kv_charges:
+        charge(_op, _nb)
 
     residual = 0
     if for_training:
@@ -227,6 +259,7 @@ def plan_symbol(symbol, shapes, policy="none", for_training=True,
         "state_bytes": int(state_bytes),
         "state_bytes_per_device": int(state_dev),
         "aux_bytes": int(aux_bytes),
+        "kv_cache_bytes": int(kv_cache_bytes),
         "batch_bytes": int(batch_bytes),
         "residual_bytes": int(residual),
         "output_bytes": int(output_bytes),

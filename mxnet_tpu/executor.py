@@ -90,7 +90,8 @@ class _LazyOutputs:
 
 
 def _build_graph_runner(symbol, shape_overrides=None, tap=None, mp_plan=None,
-                        compute_dtype=None, remat_segments=0):
+                        compute_dtype=None, remat_segments=0,
+                        spmd_plan=None):
     """Close the symbol graph into run(arg_vals, aux_vals, is_train, rng).
 
     Returns (runner, arg_names, aux_names, loss_mask). The runner is pure:
@@ -221,7 +222,8 @@ def _build_graph_runner(symbol, shape_overrides=None, tap=None, mp_plan=None,
                 regular = [_layout.to_nchw(x) if t else x
                            for x, t in zip(regular, in_tags)]
                 outs, aux_out = _kernel_tier.dispatch(
-                    opdef, attrs, regular, aux, is_train, krng)
+                    opdef, attrs, regular, aux, is_train, krng,
+                    spmd_plan=spmd_plan)
                 out_tags = [False] * len(outs)
         for j, t in enumerate(out_tags):
             entry_tags[(i, j)] = t
@@ -229,7 +231,11 @@ def _build_graph_runner(symbol, shape_overrides=None, tap=None, mp_plan=None,
             outs = mp_plan.constrain(id(node), outs)
         if tap is not None:
             tap(node, outs)
-        if aux_n and is_train:
+        # training aux (BatchNorm moving stats) updates only under
+        # is_train; a stateful_infer op (KV-cache decode) reads AND
+        # writes its aux on inference forwards too — the cache advance
+        # IS the inference step's side effect
+        if aux_n and (is_train or opdef.stateful_infer):
             for (inp, _), new_val in zip(
                     node.inputs[len(node.inputs) - aux_n:], aux_out):
                 new_aux[inp.name] = new_val
@@ -358,9 +364,13 @@ class Executor:
     def __init__(self, symbol, ctx, args, args_grad=None, grad_req="write",
                  aux_states=None, group2ctx=None, shared_exec=None,
                  compute_dtype=None, mirror=None, validate=None,
-                 mesh_token=None):
+                 mesh_token=None, spmd_plan=None):
         self._symbol = symbol
         self._ctx = ctx
+        # the binding's SpmdPlan (spmd exec groups): threaded into the
+        # kernel tier so plan-dependent lowerings (the attention op's
+        # sequence-sharded ring variant) can be selected at trace time
+        self._spmd_plan = spmd_plan
         # device-topology token for the program-cache key: compiled
         # programs bake in their mesh's collective structure (psum /
         # reduce-scatter shard counts), so a binding over a different
@@ -432,7 +442,8 @@ class Executor:
                 _build_graph_runner(symbol, shape_overrides,
                                     mp_plan=self._mp_plan,
                                     compute_dtype=compute_dtype,
-                                    remat_segments=self._remat_segments)
+                                    remat_segments=self._remat_segments,
+                                    spmd_plan=spmd_plan)
         self.aux_arrays = self._normalize_args(aux_states, self.aux_names,
                                                "aux_states", allow_none=True)
         self.grad_req = self._normalize_req(grad_req)
@@ -907,7 +918,10 @@ class Executor:
         grads = {nm: nd_zeros(s, ctx=ctx, dtype=type_dict.get(nm, np.float32))
                  for nm, s in zip(arg_names, arg_shapes)
                  if req.get(nm, "null") != "null"}
-        aux = {nm: nd_zeros(s, ctx=ctx)
+        # aux cells honor a declared dtype too (attention_decode's int32
+        # cache cursor) — same GV105 discipline as the arg cells
+        aux = {nm: nd_zeros(s, ctx=ctx,
+                            dtype=declared.get(nm, np.float32))
                for nm, s in zip(aux_names, aux_shapes)}
         return Executor(symbol, ctx, args, grads, grad_req, aux, group2ctx,
                         mirror=mirror, validate=validate)

@@ -319,9 +319,50 @@ def _note_decision(rec, source):
 
 
 # -------------------------------------------------------------- selection
-def resolve(opdef, attrs, shapes, dtypes, is_train):
+_ring_noted = set()       # (op, shapes) keys already audit-logged
+
+
+def _resolve_ring(opdef, attrs, shapes, dtypes, spmd_plan):
+    """Plan-driven lowering: when the binding's SpmdPlan carries a
+    nonempty ``seq`` mesh axis and the op registers a ``ring`` variant
+    that is eligible at these shapes, the sequence-sharded ring
+    lowering wins — the whole point of sharding the sequence axis.
+    ``MXNET_KERNEL_TIER=xla`` still forces compositions everywhere
+    (the bit-exact contract), handled by the caller."""
+    if spmd_plan is None or "ring" not in opdef.variants:
+        return None
+    try:
+        n_seq = int(spmd_plan.n_seq_shards())
+    except Exception:
+        return None
+    if n_seq <= 1:
+        return None
+    from .parallel import spmd as _spmd_mod
+    with _spmd_mod.plan_scope(spmd_plan):
+        if not opdef.variant_eligible("ring", attrs, shapes, dtypes):
+            return None
+    note_key = (opdef.name, tuple(tuple(s) for s in shapes),
+                tuple(dtypes))
+    if note_key not in _ring_noted:
+        _ring_noted.add(note_key)
+        _note_decision(
+            {"op": opdef.name, "variant": "ring",
+             "shapes": [list(s) for s in shapes],
+             "dtypes": [str(d) for d in dtypes],
+             "backend": _backend(),
+             "reason": f"sequence-sharded plan (seq={n_seq}): ring "
+                       "attention over lax.ppermute"},
+            source="plan")
+    return "ring"
+
+
+def resolve(opdef, attrs, shapes, dtypes, is_train, spmd_plan=None):
     """Variant name for one (op, attrs, shapes, dtypes, train) site."""
     m = mode()
+    if m != "xla":
+        ring = _resolve_ring(opdef, attrs, shapes, dtypes, spmd_plan)
+        if ring is not None:
+            return ring
     if m == "xla" or not opdef.variants or "pallas" not in opdef.variants:
         return "xla"
     if m == "pallas":
@@ -364,17 +405,26 @@ def resolve(opdef, attrs, shapes, dtypes, is_train):
     return winner
 
 
-def dispatch(opdef, attrs, inputs, aux, is_train, rng):
+def dispatch(opdef, attrs, inputs, aux, is_train, rng, spmd_plan=None):
     """Run one op through the tier; the single choke point both the
     executor's graph runner and imperative invoke call instead of
-    ``opdef.forward``. Zero-variant ops pass straight through."""
+    ``opdef.forward``. Zero-variant ops pass straight through.
+    ``spmd_plan`` (the binding's SpmdPlan, threaded from the executor)
+    arms plan-driven lowerings — the ring variant runs inside a
+    ``plan_scope`` so it can read the mesh/axes."""
     if not opdef.variants:
         return opdef.forward(attrs, inputs, aux, is_train, rng)
     shapes = [tuple(v.shape) for v in inputs] + \
         [tuple(v.shape) for v in aux]
     dtypes = [str(v.dtype) for v in inputs] + [str(v.dtype) for v in aux]
-    variant = resolve(opdef, attrs, shapes, dtypes, is_train)
-    return opdef.variant_fn(variant)(attrs, inputs, aux, is_train, rng)
+    variant = resolve(opdef, attrs, shapes, dtypes, is_train,
+                      spmd_plan=spmd_plan)
+    fn = opdef.variant_fn(variant)
+    if variant == "ring" and spmd_plan is not None:
+        from .parallel import spmd as _spmd_mod
+        with _spmd_mod.plan_scope(spmd_plan):
+            return fn(attrs, inputs, aux, is_train, rng)
+    return fn(attrs, inputs, aux, is_train, rng)
 
 
 # ------------------------------------------------------------- inspection
@@ -399,4 +449,5 @@ def clear():
         _selection.clear()
         del _decisions[:]
         _persist.clear()
+        _ring_noted.clear()
     _persist_loaded = False

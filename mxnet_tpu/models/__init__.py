@@ -7,3 +7,4 @@ from . import alexnet
 from . import vgg
 from . import inception_bn
 from . import inception_v3
+from . import transformer

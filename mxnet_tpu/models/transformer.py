@@ -1,0 +1,309 @@
+"""Decoder-only transformer LM + KV-cache incremental decoder.
+
+The model-zoo keystone (ROADMAP 1): a pre-LN, tied-embedding language
+model composed entirely from the framework's fused ops — ``Embedding``
+(fused-gather tier), ``LayerNorm`` (fused row-pass tier), ``attention``
+(three gated lowerings: xla composition / Pallas flash / sequence-
+sharded ring over the mesh's ``seq`` axis), ``FusedBiasGeLU`` (fused
+dense epilogue) — so every hot op rides the kernel tier's numerics-gated
+autotune, and ``Module.fit(spmd=True)`` on a (data x seq) mesh trains it
+data+sequence-parallel with activations sharded ``P('data', 'seq')``.
+
+Two graphs, one parameter set:
+
+* ``get_symbol`` — the training/full-sequence forward: data ``(B, T)``
+  token ids, label ``(B*T,)`` next-token ids (flat so the loss head's
+  label slot is fed directly by the variable — exact class ids under
+  mixed precision), softmax-CE loss over the tied embedding.
+* ``get_decode_symbol`` — the inference decoder: ``(B, S)`` new tokens
+  per step (S=1 for autoregressive generation), attention replaced by
+  ``attention_decode`` whose fixed-capacity K/V cache rides executor
+  AUX state (read+written on inference forwards), so N incremental
+  steps reproduce the length-N full forward.
+
+``KVCacheDecoder`` drives a bound decode module: host-side position
+tracking (capacity overflow raises before the program clamps), learned-
+position id feeding, cache reset. ``SyntheticLMIter`` is the synthetic
+next-token data source bench.py and the tests train against.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from .. import symbol as sym
+from ..base import MXNetError
+
+__all__ = ["get_symbol", "get_decode_symbol", "SyntheticLMIter",
+           "KVCacheDecoder", "default_cache_capacity"]
+
+
+def default_cache_capacity():
+    """Decode cache capacity default: ``MXNET_LM_CACHE_CAPACITY``
+    (docs/env_var.md), else 256 positions."""
+    try:
+        return int(os.environ.get("MXNET_LM_CACHE_CAPACITY", "256"))
+    except ValueError:
+        return 256
+
+
+def _proj(x, num_hidden, name, no_bias=False):
+    """FullyConnected over the flattened (B*T, D) token axis: the
+    reference FC contracts all non-batch dims, so sequence models fold
+    (B, T) into rows first and unfold after."""
+    flat = sym.Reshape(x, shape=(-3, 0), name=f"{name}_fold")
+    return sym.FullyConnected(flat, num_hidden=num_hidden, name=name,
+                              no_bias=no_bias)
+
+
+def _block(x, *, i, seq_len, d_model, n_head, dropout, pos_embed,
+           rope_base, name, decode=False, capacity=None):
+    """One pre-LN transformer block; ``decode=True`` swaps the full
+    ``attention`` for the KV-cache ``attention_decode`` path (same
+    parameter names either way, so one trained parameter set serves
+    both graphs)."""
+    pfx = f"{name}_l{i}"
+    dh = d_model // n_head
+    T = seq_len
+
+    ln1 = sym.LayerNorm(x, name=f"{pfx}_ln1")
+    qkv = _proj(ln1, 3 * d_model, f"{pfx}_qkv")          # (B*T, 3D)
+    qkv = sym.Reshape(qkv, shape=(-1, T, 3 * n_head, dh),
+                      name=f"{pfx}_qkv_split")
+    qkv = sym.transpose(qkv, axes=(0, 2, 1, 3),
+                        name=f"{pfx}_qkv_t")             # (B, 3H, T, dh)
+    q = sym.slice_axis(qkv, axis=1, begin=0, end=n_head,
+                       name=f"{pfx}_q")
+    k = sym.slice_axis(qkv, axis=1, begin=n_head, end=2 * n_head,
+                       name=f"{pfx}_k")
+    v = sym.slice_axis(qkv, axis=1, begin=2 * n_head, end=3 * n_head,
+                       name=f"{pfx}_v")
+    if decode:
+        att = sym.attention_decode(
+            q, k, v, capacity=capacity, rope=(pos_embed == "rotary"),
+            rope_base=rope_base, name=f"{pfx}_attn")
+    else:
+        if pos_embed == "rotary":
+            q = sym.RoPE(q, base=rope_base, name=f"{pfx}_rope_q")
+            k = sym.RoPE(k, base=rope_base, name=f"{pfx}_rope_k")
+        att = sym.attention(q, k, v, causal=True, name=f"{pfx}_attn")
+    att = sym.transpose(att, axes=(0, 2, 1, 3),
+                        name=f"{pfx}_attn_t")            # (B, T, H, dh)
+    att = sym.Reshape(att, shape=(-3, -3), name=f"{pfx}_attn_merge")
+    proj = sym.FullyConnected(att, num_hidden=d_model,
+                              name=f"{pfx}_proj")        # (B*T, D)
+    proj = sym.Reshape(proj, shape=(-1, T, d_model),
+                       name=f"{pfx}_proj_unfold")
+    if dropout:
+        proj = sym.Dropout(proj, p=dropout, name=f"{pfx}_drop1")
+    x = x + proj
+
+    ln2 = sym.LayerNorm(x, name=f"{pfx}_ln2")
+    # dense -> GeLU as the fused epilogue pair: the matmul emits raw
+    # rows (no_bias) and FusedBiasGeLU folds bias+erf-GeLU in one pass
+    h = _proj(ln2, 4 * d_model, f"{pfx}_ffn1", no_bias=True)
+    h = sym.FusedBiasGeLU(h, name=f"{pfx}_ffn_gelu")
+    h = sym.FullyConnected(h, num_hidden=d_model, name=f"{pfx}_ffn2")
+    h = sym.Reshape(h, shape=(-1, T, d_model), name=f"{pfx}_ffn_unfold")
+    if dropout:
+        h = sym.Dropout(h, p=dropout, name=f"{pfx}_drop2")
+    return x + h
+
+
+def _validate(vocab_size, d_model, n_head, pos_embed):
+    if d_model % n_head:
+        raise MXNetError(f"d_model {d_model} must divide n_head {n_head}")
+    if (d_model // n_head) % 2:
+        raise MXNetError("head dim must be even (RoPE rotates pairs)")
+    if pos_embed not in ("rotary", "learned"):
+        raise MXNetError(f"pos_embed {pos_embed!r}: 'rotary' or 'learned'")
+
+
+def _embed(data, tok_w, *, seq_len, vocab_size, d_model, pos_embed,
+           max_seq_len, name, pos_ids=None):
+    """Token embedding (scaled by sqrt(D), transformer convention) plus
+    the learned position table when ``pos_embed='learned'``."""
+    x = sym.Embedding(data=data, weight=tok_w, input_dim=vocab_size,
+                      output_dim=d_model,
+                      scale=float(np.sqrt(d_model)),
+                      name=f"{name}_tok_embed")          # (B, T, D)
+    if pos_embed == "learned":
+        if pos_ids is None:
+            pos_ids = sym._arange(start=0, stop=float(seq_len),
+                                  name=f"{name}_pos_ids")
+        pos_w = sym.var(f"{name}_pos_embed_weight")
+        pos = sym.Embedding(data=pos_ids, weight=pos_w,
+                            input_dim=max_seq_len, output_dim=d_model,
+                            name=f"{name}_pos_embed")    # (T, D)
+        pos = sym.expand_dims(pos, axis=0, name=f"{name}_pos_b")
+        x = sym.broadcast_add(x, pos, name=f"{name}_add_pos")
+    return x
+
+
+def get_symbol(vocab_size=256, d_model=64, n_layer=2, n_head=4,
+               seq_len=32, pos_embed="rotary", rope_base=10000.0,
+               dropout=0.0, include_loss=True, normalization="batch",
+               max_seq_len=None, name="lm"):
+    """Training/full-sequence graph.
+
+    data: ``(B, seq_len)`` token ids (bind the data iter with an int32
+    ``DataDesc`` for vocabularies past bf16's exact-integer range);
+    label: ``(B*seq_len,)`` next-token ids fed straight into the loss
+    head (flat on purpose — the label variable keeps its exact dtype
+    under mixed precision only when it feeds the loss slot directly).
+
+    ``include_loss=False`` returns logits ``(B, seq_len, vocab)`` — the
+    decode-parity reference the KV-cache gates compare against.
+    """
+    _validate(vocab_size, d_model, n_head, pos_embed)
+    max_seq_len = max_seq_len or seq_len
+    T = seq_len
+
+    data = sym.var("data")
+    tok_w = sym.var(f"{name}_tok_embed_weight")
+    x = _embed(data, tok_w, seq_len=T, vocab_size=vocab_size,
+               d_model=d_model, pos_embed=pos_embed,
+               max_seq_len=max_seq_len, name=name)
+    for i in range(n_layer):
+        x = _block(x, i=i, seq_len=T, d_model=d_model, n_head=n_head,
+                   dropout=dropout, pos_embed=pos_embed,
+                   rope_base=rope_base, name=name)
+    x = sym.LayerNorm(x, name=f"{name}_ln_f")
+    flat = sym.Reshape(x, shape=(-3, 0), name=f"{name}_head_fold")
+    # tied-embedding softmax head: logits = x @ E^T over the SAME
+    # variable the token embedding reads (one weight, two gradients)
+    logits = sym.dot(flat, tok_w, transpose_b=True,
+                     name=f"{name}_logits")              # (B*T, V)
+    if not include_loss:
+        return sym.Reshape(logits, shape=(-1, T, vocab_size),
+                           name=f"{name}_logits_btv")
+    return sym.SoftmaxOutput(logits, name="softmax",
+                             normalization=normalization)
+
+
+def get_decode_symbol(vocab_size=256, d_model=64, n_layer=2, n_head=4,
+                      pos_embed="rotary", rope_base=10000.0,
+                      capacity=None, step_len=1, max_seq_len=None,
+                      name="lm"):
+    """Incremental KV-cache decoder: ``(B, step_len)`` new token ids in,
+    logits ``(B, step_len, vocab)`` out, per-layer K/V caches of
+    ``capacity`` positions riding executor aux state. Parameter names
+    match ``get_symbol``'s exactly, so a trained parameter set loads
+    unchanged. ``pos_embed='learned'`` adds a ``pos_ids`` input
+    (``(step_len,)`` absolute positions — ``KVCacheDecoder`` feeds it).
+    """
+    _validate(vocab_size, d_model, n_head, pos_embed)
+    capacity = capacity or default_cache_capacity()
+    max_seq_len = max_seq_len or capacity
+    S = step_len
+
+    data = sym.var("data")
+    tok_w = sym.var(f"{name}_tok_embed_weight")
+    pos_ids = sym.var("pos_ids") if pos_embed == "learned" else None
+    x = _embed(data, tok_w, seq_len=S, vocab_size=vocab_size,
+               d_model=d_model, pos_embed=pos_embed,
+               max_seq_len=max_seq_len, name=name, pos_ids=pos_ids)
+    for i in range(n_layer):
+        x = _block(x, i=i, seq_len=S, d_model=d_model, n_head=n_head,
+                   dropout=0.0, pos_embed=pos_embed, rope_base=rope_base,
+                   name=name, decode=True, capacity=capacity)
+    x = sym.LayerNorm(x, name=f"{name}_ln_f")
+    flat = sym.Reshape(x, shape=(-3, 0), name=f"{name}_head_fold")
+    logits = sym.dot(flat, tok_w, transpose_b=True,
+                     name=f"{name}_logits")
+    return sym.Reshape(logits, shape=(-1, S, vocab_size),
+                       name=f"{name}_logits_bsv")
+
+
+class SyntheticLMIter:
+    """Synthetic next-token LM batches: data ``(B, T)`` int32 ids,
+    label ``(B*T,)`` float ids (the shifted-by-one stream), matching
+    ``get_symbol``'s flat-label loss contract."""
+
+    def __init__(self, vocab_size, batch_size, seq_len, n_batches,
+                 seed=0):
+        from ..io import DataDesc
+        from .. import ndarray as nd
+        rs = np.random.RandomState(seed)
+        stream = rs.randint(
+            0, vocab_size,
+            (n_batches * batch_size, seq_len + 1)).astype(np.int32)
+        self._data = [nd.array(stream[i * batch_size:(i + 1) * batch_size,
+                                      :seq_len])
+                      for i in range(n_batches)]
+        self._label = [nd.array(
+            stream[i * batch_size:(i + 1) * batch_size, 1:]
+            .reshape(-1).astype(np.float32)) for i in range(n_batches)]
+        self.provide_data = [DataDesc("data", (batch_size, seq_len),
+                                      np.int32)]
+        self.provide_label = [DataDesc("softmax_label",
+                                       (batch_size * seq_len,))]
+        self.batch_size = batch_size
+        self._i = 0
+
+    def reset(self):
+        self._i = 0
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        from ..io import DataBatch
+        if self._i >= len(self._data):
+            raise StopIteration
+        b = DataBatch(data=[self._data[self._i]],
+                      label=[self._label[self._i]],
+                      provide_data=self.provide_data,
+                      provide_label=self.provide_label)
+        self._i += 1
+        return b
+
+    next = __next__
+
+
+class KVCacheDecoder:
+    """Host-side driver for a bound decode module.
+
+    Owns what the jitted program cannot check: the absolute position
+    cursor (capacity overflow raises HERE, before dynamic_update_slice
+    would clamp the write), the ``pos_ids`` feed for learned positions,
+    and cache reset between sequences. The module must be bound
+    ``for_training=False`` over ``get_decode_symbol``'s graph.
+    """
+
+    def __init__(self, module, capacity, pos_embed="rotary"):
+        self._mod = module
+        self.capacity = int(capacity)
+        self.pos_embed = pos_embed
+        self.pos = 0
+
+    def reset(self):
+        """Zero every decode cache (aux cells) and rewind the cursor."""
+        import jax.numpy as jnp
+        exe = self._mod._exec_group.executor
+        for nm, cell in exe.aux_dict.items():
+            cell._set(jnp.zeros(cell.shape, cell.asjax().dtype))
+        self.pos = 0
+
+    def step(self, tokens):
+        """Decode one window: tokens ``(B, S)`` -> logits ``(B, S, V)``
+        NDArray. Advances the device-side caches and the host cursor."""
+        from .. import ndarray as nd
+        from ..io import DataBatch
+        tokens = np.asarray(tokens)
+        if tokens.ndim == 1:
+            tokens = tokens[:, None]
+        S = tokens.shape[1]
+        if self.pos + S > self.capacity:
+            raise MXNetError(
+                f"KV cache overflow: position {self.pos} + {S} new "
+                f"tokens exceeds capacity {self.capacity}; reset() or "
+                "re-bind with a larger capacity")
+        data = [nd.array(tokens.astype(np.int32))]
+        if self.pos_embed == "learned":
+            data.append(nd.array(
+                np.arange(self.pos, self.pos + S, dtype=np.float32)))
+        self._mod.forward(DataBatch(data=data, label=[]), is_train=False)
+        self.pos += S
+        return self._mod.get_outputs()[0]

@@ -161,7 +161,13 @@ class DataParallelExecutorGroup:
         if self._mesh is None:
             return jax.device_put(arr, self.contexts[0].jax_device())
         if kind == "data":
-            sharding = self._data_sharding
+            if self._spmd_plan is not None:
+                # shape-aware spec: P(data, seq) on (batch, sequence)
+                # when the plan carries a nonempty seq axis (the
+                # long-context layout ring attention consumes)
+                sharding = self._spmd_plan.data_sharding_for(arr.shape)
+            else:
+                sharding = self._data_sharding
         elif self._spmd_plan is not None and name is not None:
             sharding = self._spmd_plan.param_sharding(name)
         else:
@@ -223,10 +229,18 @@ class DataParallelExecutorGroup:
         if shared_group is not None:
             shared_aux = dict(zip(shared_group.aux_names,
                                   shared_group.executor.aux_arrays))
+        # aux cells honor a declared dtype (attention_decode's int32
+        # cache cursor; the KV-cache arrays stay f32 master width)
+        aux_types = {n.name: np.dtype(n._extra["__dtype__"])
+                     for n in self.symbol._topo_nodes()
+                     if n.is_variable and n._extra.get("__is_aux__")
+                     and n._extra.get("__dtype__")}
         for name, shape in zip(self.aux_names, aux_shapes):
             aux[name] = shared_aux.get(name) or NDArray(
-                self._place(jnp.zeros(shape, dtype=np.float32), "param",
-                            name))
+                self._place(jnp.zeros(shape,
+                                      dtype=aux_types.get(name,
+                                                          np.float32)),
+                            "param", name))
 
         # device-topology token for the program-cache keys: a compiled
         # program bakes its mesh's collective structure in, so a mesh
@@ -241,7 +255,8 @@ class DataParallelExecutorGroup:
         self.executor = Executor(self.symbol, self.contexts[0], args, grads,
                                  self.grad_req, aux,
                                  compute_dtype=self.compute_dtype,
-                                 mesh_token=mesh_token)
+                                 mesh_token=mesh_token,
+                                 spmd_plan=self._spmd_plan)
         self.execs = [self.executor]  # reference-compat alias
 
         # flat layout — one logical sharded executor, so one array per
@@ -853,6 +868,10 @@ class DataParallelExecutorGroup:
         stays unsharded, the batch axis shards over the mesh."""
         if self._mesh is None:
             return jax.device_put(arr, self.contexts[0].jax_device())
+        if self._spmd_plan is not None:
+            return jax.device_put(
+                arr, self._spmd_plan.data_sharding_for(arr.shape,
+                                                       stacked=True))
         return jax.device_put(arr, self._stacked_sharding)
 
     def _stack_window(self, window, K):

@@ -229,6 +229,32 @@ def _attention_bytes(attrs, in_shapes):
     return _B * 2.0 * _sum_elems(in_shapes)
 
 
+def _attention_decode_flops(attrs, in_shapes):
+    # S query tokens against the full C-capacity cache: qk^T + pv
+    b, h, s, d = in_shapes[0]
+    c = parse_int(attrs.get("capacity", 256))
+    return 4.0 * b * h * s * c * d
+
+
+def _attention_decode_bytes(attrs, in_shapes):
+    # q/k/v/out move once; the K/V cache is read AND written (the
+    # dominant term — decode is memory-bound by construction)
+    b, h, s, d = in_shapes[0]
+    c = parse_int(attrs.get("capacity", 256))
+    return _B * (4.0 * b * h * s * d + 4.0 * b * h * c * d)
+
+
+def _rope_cost():
+    # per element: 2 muls + 1 add on each half plus the trig tables
+    def flops(attrs, in_shapes):
+        return 8.0 * _elems(in_shapes)
+
+    def nbytes(attrs, in_shapes):
+        return _B * 2.0 * _elems(in_shapes)
+
+    return flops, nbytes
+
+
 def _dot_flops(attrs, in_shapes):
     a, b = in_shapes[0], in_shapes[1]
     ta = parse_bool(attrs.get("transpose_a", False))
@@ -332,6 +358,8 @@ _SPECIFIC = {
     "QuantizedFullyConnected": (_qfc_flops, _qfc_bytes),
     "QuantizedConvolution": (_qconv_flops, _qconv_bytes),
     "attention": (_attention_flops, _attention_bytes),
+    "attention_decode": (_attention_decode_flops, _attention_decode_bytes),
+    "RoPE": _rope_cost(),
     "InstanceNorm": _ew(10.0),
     "L2Normalization": _ew(4.0),
     "LRN": _ew(8.0),

@@ -540,6 +540,43 @@ register("LayerNorm", inputs=("data", "gamma", "beta"), full=_ln_fwd,
          infer_shape=_ln_infer)
 
 
+# --------------------------------------------------------------------------
+# RoPE — rotary position embedding (the transformer workload's position
+# encoding; the reference predates attention entirely). Split-half
+# (GPT-NeoX) convention: head dim D splits into (x1, x2) halves and each
+# pair (x1[i], x2[i]) rotates by angle pos * base^(-2i/D). Linear in x,
+# so the VJP needs no saved activations beyond the (T, D/2) trig tables.
+# --------------------------------------------------------------------------
+def rope_apply(x, positions, base=10000.0):
+    """Rotate ``x`` (..., T, D) by rotary angles at absolute
+    ``positions`` (T,) — traced positions are fine (the KV-cache decode
+    path rotates at the cache cursor). Trig in float32, cast back."""
+    dh = x.shape[-1]
+    half = dh // 2
+    inv = jnp.asarray(base, jnp.float32) ** (
+        -jnp.arange(0, half, dtype=jnp.float32) * (2.0 / dh))
+    ang = positions.astype(jnp.float32)[:, None] * inv[None, :]  # (T, half)
+    cos, sin = jnp.cos(ang), jnp.sin(ang)
+    x32 = x.astype(jnp.float32)
+    x1, x2 = x32[..., :half], x32[..., half:]
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin],
+                          axis=-1)
+    return out.astype(x.dtype)
+
+
+@register("RoPE", inputs=("data",), shape_passthrough=True,
+          attr_spec={"base": (parse_float, 10000.0),
+                     "offset": (parse_int, 0)})
+def _rope(attrs, x):
+    """x: (B, H, T, D) — rotate every (t, pair) by its absolute position
+    ``offset + t``. D must be even (pairs rotate)."""
+    if x.shape[-1] % 2:
+        raise ValueError(f"RoPE needs an even head dim, got {x.shape[-1]}")
+    t_axis = x.shape[-2]
+    positions = parse_int(attrs.get("offset", 0)) + jnp.arange(t_axis)
+    return rope_apply(x, positions, parse_float(attrs.get("base", 10000.0)))
+
+
 @register("L2Normalization", inputs=("data",),
           attr_spec={"eps": (parse_float, 1e-10), "mode": (None, "instance")},
           infer_shape=_ID_INFER)

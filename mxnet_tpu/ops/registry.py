@@ -86,6 +86,16 @@ class OpDef:
     mutate_inputs : names of inputs the op writes (optimizer update ops;
         reference: FMutateInputs). Imperative invoke swaps the new buffer
         into the corresponding NDArray handle.
+    stateful_infer : the op's aux states are read-AND-written during
+        inference forwards too (the KV-cache decode contract) — the
+        executor writes ``new_aux`` back even when ``is_train=False``.
+        Training aux (BatchNorm moving stats) keeps the train-only rule.
+    aux_dtypes : dict aux name -> dtype for aux states that must NOT
+        bind as the default float32 cell (a KV cache's int32 position
+        cursor). ``symbol._create`` stamps the declaration onto the
+        auto-created aux variable (``__dtype__``), and the executor
+        binds a cell of that dtype — which also exempts integer aux
+        from the mixed-precision entry cast.
     """
 
     def __init__(self, name, forward, inputs=("data",), aux=(),
@@ -93,7 +103,8 @@ class OpDef:
                  infer_shape=None, infer_type=None, need_rng=False,
                  is_loss=False, mutate_inputs=(), num_visible=None,
                  shape_passthrough=False, variants=None, flops=None,
-                 bytes_moved=None, doc=""):
+                 bytes_moved=None, stateful_infer=False, aux_dtypes=None,
+                 doc=""):
         self.name = name
         self.forward = forward
         self.variants = {}
@@ -117,6 +128,8 @@ class OpDef:
         self.need_rng = need_rng
         self.is_loss = is_loss
         self.mutate_inputs = tuple(mutate_inputs)
+        self.stateful_infer = bool(stateful_infer)
+        self.aux_dtypes = dict(aux_dtypes or {})
         self.shape_passthrough = bool(shape_passthrough)
         self.doc = doc
         # arity check up front (it used to happen lazily at the first

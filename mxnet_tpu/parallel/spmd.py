@@ -27,6 +27,9 @@ meshes or spec sets (program_cache key discipline).
 """
 from __future__ import annotations
 
+import contextlib
+import threading
+
 import numpy as np
 import jax
 from jax.sharding import NamedSharding, PartitionSpec as P
@@ -35,17 +38,42 @@ from .mesh import MeshConfig, build_mesh, mesh_token
 from .placement import param_partition_specs
 from .zero import FlatShardLayout
 
-__all__ = ["SpmdPlan"]
+__all__ = ["SpmdPlan", "active_plan", "plan_scope"]
+
+# the plan "ambient" during a traced op dispatch: kernel_tier enters
+# this scope around plan-dependent variants (the attention op's ring
+# lowering reads the mesh/axes from here — the variant signature has no
+# plan slot). Thread-local: traces are single-threaded per program.
+_TLS = threading.local()
+
+
+def active_plan():
+    """The SpmdPlan armed for the op dispatch currently tracing (or
+    None outside a plan scope)."""
+    return getattr(_TLS, "plan", None)
+
+
+@contextlib.contextmanager
+def plan_scope(plan):
+    """Install ``plan`` as the active plan for the duration."""
+    prev = getattr(_TLS, "plan", None)
+    _TLS.plan = plan
+    try:
+        yield plan
+    finally:
+        _TLS.plan = prev
 
 
 class SpmdPlan:
     """Mesh + PartitionSpecs for one SPMD binding."""
 
     def __init__(self, mesh, param_specs=None, unsharded_tagged=None,
-                 data_axis="data", model_axis="model", batch_axis=0):
+                 data_axis="data", model_axis="model", batch_axis=0,
+                 seq_axis="seq"):
         self.mesh = mesh
         self.data_axis = data_axis
         self.model_axis = model_axis
+        self.seq_axis = seq_axis
         self.batch_axis = batch_axis
         #: name -> PartitionSpec for params that are NOT fully replicated
         self.param_specs = dict(param_specs or {})
@@ -111,6 +139,29 @@ class SpmdPlan:
         if stacked:
             spec = [None] + spec
         return NamedSharding(self.mesh, P(*spec))
+
+    def data_spec_for(self, shape, stacked=False):
+        """Shape-aware batch spec: ``P(data)`` on the batch axis and —
+        when the mesh carries a nonempty ``seq`` axis and the next dim
+        divides — ``P(data, seq)`` on (batch, sequence). This is the
+        long-context activation layout (SNIPPETS [2]/[3] shape): token
+        batches shard both ways, ring attention consumes the seq
+        shards in place."""
+        nd0 = 1 if stacked else 0
+        spec = [None] * len(shape)
+        b = nd0 + self.batch_axis
+        if b < len(shape):
+            spec[b] = self.data_axis
+        n_seq = self.n_seq_shards()
+        s = b + 1
+        if n_seq > 1 and s < len(shape) and shape[s] >= n_seq and \
+                shape[s] % n_seq == 0:
+            spec[s] = self.seq_axis
+        return P(*spec)
+
+    def data_sharding_for(self, shape, stacked=False):
+        return NamedSharding(self.mesh,
+                             self.data_spec_for(shape, stacked=stacked))
 
     def state_spec(self, name):
         """Optimizer-state spec for one watched param's leaves: the
@@ -182,6 +233,9 @@ class SpmdPlan:
 
     def n_data_shards(self):
         return int(self.mesh.shape.get(self.data_axis, 1))
+
+    def n_seq_shards(self):
+        return int(self.mesh.shape.get(self.seq_axis, 1))
 
     def n_devices(self):
         return int(np.prod([self.mesh.shape[a]

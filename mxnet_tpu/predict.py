@@ -101,11 +101,45 @@ def export_model(path, symbol, arg_params, aux_params, data_shapes,
     params = {n: _val(arg_params[n]) for n in param_names}
     params.update(zeros)
     param_names = param_names + zero_names
-    aux = {n: _val((aux_params or {})[n]) for n in aux_names}
+    # aux entries with no trained value (a decoder's KV-cache arrays +
+    # cursor) zero-fill at their inferred shapes and declared dtypes —
+    # the empty cache IS the correct exported snapshot
+    aux_params = aux_params or {}
+    aux = {}
+    if any(n not in aux_params for n in aux_names):
+        _, _, aux_shapes = symbol.infer_shape(**data_shapes)
+        aux_shape_by_name = dict(zip(symbol.list_auxiliary_states(),
+                                     aux_shapes))
+        aux_dtype_by_name = {
+            n.name: np.dtype(n._extra["__dtype__"])
+            for n in symbol._topo_nodes()
+            if n.is_variable and n._extra.get("__is_aux__")
+            and n._extra.get("__dtype__")}
+    for n in aux_names:
+        if n in aux_params:
+            aux[n] = _val(aux_params[n])
+        else:
+            s = aux_shape_by_name.get(n)
+            if s is None:
+                raise MXNetError(
+                    f"export_model: no value and no inferable shape "
+                    f"for aux state {n!r}")
+            aux[n] = jnp.zeros(
+                s, aux_dtype_by_name.get(n, np.float32))
+
+    # stateful-inference graphs (KV-cache decoders): the exported
+    # program must RETURN the advanced aux so the Predictor can carry
+    # the cache between calls — jax.export has no mutable state
+    stateful = any(
+        not n.is_variable
+        and getattr(n.opdef(), "stateful_infer", False)
+        for n in symbol._topo_nodes())
 
     def infer(params, aux, data):
         args = {**params, **data}
-        outs, _ = runner(args, aux, False, jax.random.PRNGKey(0))
+        outs, new_aux = runner(args, aux, False, jax.random.PRNGKey(0))
+        if stateful:
+            return outs, {**aux, **new_aux}
         return outs
 
     data_example = {n: jnp.zeros(s, data_dtypes[n])
@@ -126,6 +160,7 @@ def export_model(path, symbol, arg_params, aux_params, data_shapes,
         np.dtype(compute_dtype).name,
         "quantize": quantize,
         "quantized_weights": quantized_weights,
+        "stateful": stateful,
     }
 
     with tempfile.TemporaryDirectory() as td:
@@ -181,6 +216,9 @@ class Predictor:
                         for n in self._manifest["param_names"]}
         self._aux = {n: put(loaded[f"aux:{n}"])
                      for n in self._manifest["aux_names"]}
+        # stateful artifacts (KV-cache decoders) advance their aux per
+        # forward; keep the as-exported snapshot for reset_state()
+        self._aux0 = dict(self._aux) if self.stateful else None
         self._outputs = None
 
     @property
@@ -196,6 +234,19 @@ class Predictor:
         """The artifact's PTQ mode (``"int8"``) or None for float
         exports (pre-quantization artifacts included)."""
         return self._manifest.get("quantize")
+
+    @property
+    def stateful(self):
+        """True for stateful-inference artifacts (KV-cache decoders):
+        each ``forward`` advances the carried aux state (the cache);
+        ``reset_state()`` rewinds to the exported snapshot."""
+        return bool(self._manifest.get("stateful"))
+
+    def reset_state(self):
+        """Rewind a stateful artifact's carried aux (the KV cache) to
+        its exported snapshot. No-op for stateless artifacts."""
+        if self._aux0 is not None:
+            self._aux = dict(self._aux0)
 
     @property
     def input_dtypes(self):
@@ -229,7 +280,12 @@ class Predictor:
                     f"input {n!r}: shape {tuple(v.shape)} != exported "
                     f"{shape} (re-export to reshape, like MXPredReshape)")
             data[n] = v
-        outs = self._exported.call(self._params, self._aux, data)
+        res = self._exported.call(self._params, self._aux, data)
+        if self.stateful:
+            outs, new_aux = res
+            self._aux = dict(new_aux)
+        else:
+            outs = res
         self._outputs = [NDArray(o) for o in outs]
         return self._outputs
 

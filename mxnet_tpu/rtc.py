@@ -545,14 +545,72 @@ def _register_flash():
                                       _ATTENTION_KSPEC)})
 
 
+def _attention_ring_variant(attrs, inputs, aux, is_train, rng):
+    """Sequence-sharded lowering: ring attention over the active
+    SpmdPlan's ``seq`` mesh axis (parallel/ring_attention.py — K/V
+    shards rotate over ``lax.ppermute``, flash-style online softmax).
+    Runs inside ``kernel_tier``'s plan_scope, so the mesh and axis
+    names come from the binding's plan; the shard_map composes inside
+    the jitted program and XLA partitions everything around it."""
+    import functools
+    from .base import parse_bool
+    from .parallel import spmd as _spmd
+    from .parallel.collectives import shard_map as _shard_map
+    from .parallel.ring_attention import ring_attention
+    from jax.sharding import PartitionSpec as P
+
+    plan = _spmd.active_plan()
+    if plan is None:
+        raise MXNetError("attention ring variant dispatched without an "
+                         "active SpmdPlan (kernel_tier arms the scope)")
+    q, k, v = inputs
+    causal = parse_bool(attrs.get("causal", False))
+    seq_ax = plan.seq_axis
+    batch_ax = plan.data_axis if (plan.n_data_shards() > 1 and
+                                  q.shape[0] % plan.n_data_shards() == 0) \
+        else None
+    spec = P(batch_ax, None, seq_ax, None)
+    run = _shard_map(
+        functools.partial(ring_attention, axis_name=seq_ax, causal=causal),
+        mesh=plan.mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    return [run(q, k, v)], []
+
+
+def _attention_ring_eligible(attrs, in_shapes, in_dtypes):
+    """Eligible only under an active seq-sharded plan whose shard counts
+    divide (B, T); self-attention shapes only (q/k/v agree)."""
+    from .parallel import spmd as _spmd
+    plan = _spmd.active_plan()
+    if plan is None:
+        return False
+    n_seq = plan.n_seq_shards()
+    if n_seq <= 1:
+        return False
+    if len(in_shapes) < 3 or len(in_shapes[0]) != 4:
+        return False
+    if not (tuple(in_shapes[0]) == tuple(in_shapes[1])
+            == tuple(in_shapes[2])):
+        return False
+    b, _h, t, _d = in_shapes[0]
+    if t < n_seq or t % n_seq:
+        return False
+    nd = plan.n_data_shards()
+    return not (nd > 1 and b % nd)
+
+
 def _register_attention():
     """``attention``: the graph-level attention OpDef the transformer
-    workload (ROADMAP 1) binds. Forward is the exact XLA composition
-    (``parallel.ring_attention.attention``); its *fused* lowering is the
-    flash kernel already registered on the tier — giving ring_attention's
-    flash machinery a first-class registered consumer. The sequence-
-    sharded lowering (ring attention over the mesh's ``seq`` axis) rides
-    the same OpDef when the transformer Module lands."""
+    workload (ROADMAP 1) binds, with THREE gated lowerings:
+
+    * ``xla`` — the exact composition (``parallel.ring_attention
+      .attention``), always present, always correct;
+    * ``pallas`` — the flash kernel (fused lowering), numerics-gated and
+      autotuned per shape by kernel_tier on TPU;
+    * ``ring`` — the sequence-sharded lowering: when the binding's
+      SpmdPlan carries a nonempty ``seq`` mesh axis, the op lowers to
+      ring attention over ``lax.ppermute`` (kernel_tier selects it from
+      the plan; ``MXNET_KERNEL_TIER=xla`` still forces the composition).
+    """
     if "attention" in OP_REGISTRY:
         return
     _register_op("attention", inputs=("q", "k", "v"),
@@ -561,11 +619,94 @@ def _register_attention():
                  attr_spec=dict(_ATTENTION_ATTRS),
                  variants={"pallas": (_attention_pallas_variant,
                                       _attention_eligible,
-                                      _ATTENTION_KSPEC)})
+                                      _ATTENTION_KSPEC),
+                           "ring": (_attention_ring_variant,
+                                    _attention_ring_eligible)})
+
+
+# --------------------------------------------------------------------------
+# attention_decode: the KV-cache inference path. The cache is op AUX
+# state carried through the executor (fixed capacity, f32/compute-width
+# K/V arrays + an int32 cursor), read AND written on inference forwards
+# (OpDef.stateful_infer) — N incremental single-token steps reproduce
+# the length-N full-sequence forward.
+# --------------------------------------------------------------------------
+def _attention_decode_fwd(attrs, inputs, aux, is_train, rng):
+    from .base import parse_bool, parse_float
+    from .ops.nn import rope_apply
+
+    q, k, v = inputs                       # (B, H, S, Dh), S new tokens
+    k_cache, v_cache, cursor = aux         # (B,H,C,Dh) x2 + (1,) int32
+    if is_train:
+        raise MXNetError("attention_decode is an inference op (train "
+                         "with the full-sequence `attention` graph)")
+    B, H, S, Dh = q.shape
+    capacity = k_cache.shape[2]
+    pos = cursor.reshape(()).astype(jnp.int32)
+    # overflow raises cleanly whenever the cursor is concrete (eager
+    # dispatch); jitted paths enforce it host-side via the decode driver
+    # (models.transformer.KVCacheDecoder) — dynamic_update_slice would
+    # otherwise silently clamp the write
+    if not isinstance(pos, jax.core.Tracer) and int(pos) + S > capacity:
+        raise MXNetError(
+            f"attention_decode: cache overflow (pos {int(pos)} + {S} new "
+            f"tokens > capacity {capacity}); re-bind with a larger "
+            "capacity= or reset the cache")
+    scale = 1.0 / float(np.sqrt(Dh))
+    if parse_bool(attrs.get("rope", False)):
+        base = parse_float(attrs.get("rope_base", 10000.0))
+        positions = pos + jnp.arange(S)
+        q = rope_apply(q, positions, base)
+        k = rope_apply(k, positions, base)
+    k_cache = jax.lax.dynamic_update_slice(
+        k_cache, k.astype(k_cache.dtype), (0, 0, pos, 0))
+    v_cache = jax.lax.dynamic_update_slice(
+        v_cache, v.astype(v_cache.dtype), (0, 0, pos, 0))
+    # same numerics shape as the full forward (ring_attention.attention):
+    # f32 logits at HIGHEST precision, -inf causal mask, f32 softmax
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k_cache.astype(q.dtype),
+                        precision=jax.lax.Precision.HIGHEST,
+                        preferred_element_type=jnp.float32) * scale
+    key_pos = jnp.arange(capacity)[None, :]
+    q_pos = (pos + jnp.arange(S))[:, None]
+    mask = key_pos <= q_pos                           # (S, C)
+    logits = jnp.where(mask[None, None], logits, -jnp.inf)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs,
+                     v_cache.astype(jnp.float32),
+                     precision=jax.lax.Precision.HIGHEST,
+                     preferred_element_type=jnp.float32)
+    new_cursor = (pos + S).reshape((1,)).astype(jnp.int32)
+    return [out.astype(q.dtype)], [k_cache, v_cache, new_cursor]
+
+
+def _attention_decode_infer(attrs, in_shapes):
+    q_s = in_shapes[0]
+    c = int(attrs.get("capacity", 256))
+    if q_s is None:
+        return in_shapes, [None], [None, None, (1,)]
+    b, h, _s, dh = q_s
+    cache = (b, h, c, dh)
+    return [q_s, q_s, q_s], [q_s], [cache, cache, (1,)]
+
+
+def _register_attention_decode():
+    if "attention_decode" in OP_REGISTRY:
+        return
+    _register_op("attention_decode", inputs=("q", "k", "v"),
+                 aux=("k_cache", "v_cache", "cache_pos"),
+                 full=_attention_decode_fwd,
+                 stateful_infer=True,
+                 aux_dtypes={"cache_pos": "int32"},
+                 infer_shape=_attention_decode_infer,
+                 attr_spec={"capacity": (int, 256),
+                            "rope": (None, False),
+                            "rope_base": (float, 10000.0)})
 
 
 _register_flash()
 _register_attention()
+_register_attention_decode()
 
 # rtc's ops register after ops/cost.py's import-time pass — re-seed so
 # pallas_sgd_mom_update / pallas_flash_attention carry their estimators

@@ -317,6 +317,16 @@ class PredictorEngine(_EngineBase):
                              f"{self.ladder.max}, got bucket {bucket}")
         return self._pred.forward(**values)
 
+    def warmup(self, clock):
+        """Base warmup, then rewind a stateful artifact's carried state:
+        the warmup forwards advance a KV-cache decoder's cache with
+        zero-token garbage, and served decode steps must start from the
+        exported snapshot."""
+        est = super().warmup(clock)
+        if getattr(self._pred, "stateful", False):
+            self._pred.reset_state()
+        return est
+
     @property
     def output_names(self):
         return self._pred.output_names

@@ -619,8 +619,14 @@ def _create(op_name, input_syms, name=None, attr=None, **params):
             vnode = Node(None, f"{node_name}_{inm}", extra=dict(extra))
             inputs.append((vnode, 0))
     for anm in aux_names:
-        vnode = Node(None, f"{node_name}_{anm}",
-                     extra={**extra, "__is_aux__": True})
+        aux_extra = {**extra, "__is_aux__": True}
+        # an op may declare a non-f32 aux cell (attention_decode's int32
+        # cache cursor): stamp it onto the auto-created variable so
+        # binding honors it (and the mixed-precision cast exempts it)
+        adt = opdef.aux_dtypes.get(anm)
+        if adt is not None:
+            aux_extra["__dtype__"] = str(np.dtype(adt))
+        vnode = Node(None, f"{node_name}_{anm}", extra=aux_extra)
         inputs.append((vnode, 0))
 
     node = Node(op_name, node_name, attrs, inputs, extra)

@@ -86,6 +86,13 @@ def _check_corpus():
          {"data": (1, 3, 224, 224)}),
         ("models/inception_v3", lambda: _models.inception_v3.get_symbol(10),
          {"data": (1, 3, 299, 299)}),
+        ("models/transformer", lambda: _models.transformer.get_symbol(
+            vocab_size=64, d_model=32, n_layer=1, n_head=2, seq_len=8),
+         {"data": (4, 8)}),
+        ("models/transformer_decode",
+         lambda: _models.transformer.get_decode_symbol(
+             vocab_size=64, d_model=32, n_layer=1, n_head=2, capacity=16),
+         {"data": (4, 1)}),
     ]
 
     def _dcgan(which):
