@@ -164,6 +164,64 @@ def main():
         tm_flight.note("bench.note", i=1)
     note_ns = (time.perf_counter() - t0) / reps * 1e9
 
+    # ---- 4. ARMED step-time attribution A/B (the trace plane's cost
+    # when it is actually recording: per-step phase clocks, histograms,
+    # the straggler detector, and the window-boundary block). The
+    # GATED lap runs the K=8 scan path — attribution is per *window
+    # boundary* by design (the ISSUE's "don't de-async the scan fast
+    # path"), so its cost amortizes over K batches exactly like the
+    # dispatch it instruments. The K=1 per-step figures are recorded
+    # unasserted: there every step IS a boundary, and the block's
+    # serialization is the cost the design accepts for full-resolution
+    # attribution (on real >1ms production steps it is noise; against
+    # THIS benchmark's sub-ms micro-batches it reads in the tens of
+    # percent — that is the micro-step, not the instrument).
+    from mxnet_tpu.telemetry import stepattr as tm_step
+
+    def fit_epoch_timed(K):
+        it.reset()
+        t0 = time.perf_counter()
+        mod.fit(it, num_epoch=1, steps_per_dispatch=K,
+                optimizer_params={"learning_rate": 0.05})
+        return time.perf_counter() - t0
+
+    armed = {}
+    for K in (8, 1):
+        all_armed, all_unarmed = [], []
+        fit_epoch_timed(K)                  # settle / compile
+        for _ in range(REPEATS):
+            try:
+                tm_step.configure(armed=True)
+                all_armed.append(fit_epoch_timed(K))
+            finally:
+                tm_step.configure(armed=False)
+            all_unarmed.append(fit_epoch_timed(K))
+        tm_step.configure(armed=None)
+        tm_step.reset()
+        armed[K] = (min(all_armed), min(all_unarmed),
+                    all_armed, all_unarmed)
+    armed_ab_pct = (armed[8][0] / armed[8][1] - 1.0) * 100.0
+    armed_k1_ab_pct = (armed[1][0] / armed[1][1] - 1.0) * 100.0
+
+    # analytic bound: one begin/note/end bookkeeping cycle per window
+    # (5 histogram observes + the amortized straggler check) over the
+    # K=8 window time
+    tm_step.configure(armed=True)
+    t0 = time.perf_counter()
+    for i in range(20_000):
+        tm_step.step_begin(0, i)
+        tm_step.note("assemble", 0.0)
+        tm_step.note("dispatch", 0.0)
+        tm_step.note("device", 0.0)
+        tm_step.step_end(steps=8)
+    step_cycle_ns = (time.perf_counter() - t0) / 20_000 * 1e9
+    tm_step.configure(armed=None)
+    tm_step.reset()
+    tm.reset()
+    windows_per_epoch = nb / 8.0
+    armed_analytic_pct = (windows_per_epoch * step_cycle_ns / 1e9
+                          / armed[8][1]) * 100.0
+
     # notes per batch, counted against a ring large enough not to wrap
     tm_flight.configure(capacity=1_000_000)
     tm_flight.clear()
@@ -203,6 +261,26 @@ def main():
             "notes_per_batch": notes_per_batch,
             "analytic_overhead_pct": flight_analytic_pct,
         },
+        "armed_tracing": {
+            "gate_pct": GATE_PCT,
+            "gated_path": "K=8 scan (window-boundary attribution)",
+            "epoch_s_armed": armed[8][0],
+            "epoch_s_unarmed": armed[8][1],
+            "epoch_s_armed_all": armed[8][2],
+            "epoch_s_unarmed_all": armed[8][3],
+            "ab_overhead_pct": armed_ab_pct,
+            "step_cycle_ns": step_cycle_ns,
+            "analytic_overhead_pct": armed_analytic_pct,
+            "k1_per_step": {
+                "note": "K=1: every step is a window boundary — the "
+                        "per-step block serializes dispatch; recorded "
+                        "unasserted (full-resolution attribution cost "
+                        "against sub-ms micro-batches)",
+                "epoch_s_armed": armed[1][0],
+                "epoch_s_unarmed": armed[1][1],
+                "ab_overhead_pct": armed_k1_ab_pct,
+            },
+        },
     }
     out_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                            "results")
@@ -229,10 +307,22 @@ def main():
         raise AssertionError(
             f"flight-recorder A/B overhead {flight_ab_pct:.3f}% "
             f">= {GATE_PCT}% gate")
+    # armed tracing pays real work per step (phase clocks + histograms
+    # + the boundary block); the same noise discipline applies — the
+    # analytic bound is the hard gate, A/B corroborates
+    assert armed_analytic_pct < GATE_PCT, (
+        f"armed step-attribution analytic overhead "
+        f"{armed_analytic_pct:.3f}% >= {GATE_PCT}% gate")
+    if armed_ab_pct > GATE_PCT and armed_analytic_pct > GATE_PCT / 2:
+        raise AssertionError(
+            f"armed step-attribution A/B overhead {armed_ab_pct:.3f}% "
+            f">= {GATE_PCT}% gate")
     print(f"OK: analytic {analytic_pct:.4f}% | A/B {ab_overhead_pct:+.2f}%"
           f" (< {GATE_PCT}% gate)")
     print(f"OK: flight ring analytic {flight_analytic_pct:.4f}% | "
           f"A/B {flight_ab_pct:+.2f}% (< {GATE_PCT}% gate)")
+    print(f"OK: armed tracing analytic {armed_analytic_pct:.4f}% | "
+          f"A/B {armed_ab_pct:+.2f}% (< {GATE_PCT}% gate)")
 
 
 if __name__ == "__main__":

@@ -277,20 +277,34 @@ class KVCacheDecoder:
         self.capacity = int(capacity)
         self.pos_embed = pos_embed
         self.pos = 0
+        self._new_session_trace()
+
+    def _new_session_trace(self):
+        """One trace per decode session (telemetry.trace): every step
+        records a child span under the session root, so an N-token
+        decode reconstructs to a single parented span tree keyed by
+        ``self.trace.trace_id``."""
+        from ..telemetry import trace as _trace
+        self.trace = _trace.new_trace(session=True)
+        self.trace.root = _trace.next_span_id()
 
     def reset(self):
-        """Zero every decode cache (aux cells) and rewind the cursor."""
+        """Zero every decode cache (aux cells), rewind the cursor and
+        rotate the session trace (a new sequence = a new trace)."""
         import jax.numpy as jnp
         exe = self._mod._exec_group.executor
         for nm, cell in exe.aux_dict.items():
             cell._set(jnp.zeros(cell.shape, cell.asjax().dtype))
         self.pos = 0
+        self._new_session_trace()
 
     def step(self, tokens):
         """Decode one window: tokens ``(B, S)`` -> logits ``(B, S, V)``
         NDArray. Advances the device-side caches and the host cursor."""
+        import time
         from .. import ndarray as nd
         from ..io import DataBatch
+        from ..telemetry import trace as _trace
         tokens = np.asarray(tokens)
         if tokens.ndim == 1:
             tokens = tokens[:, None]
@@ -304,6 +318,17 @@ class KVCacheDecoder:
         if self.pos_embed == "learned":
             data.append(nd.array(
                 np.arange(self.pos, self.pos + S, dtype=np.float32)))
+        t0 = time.perf_counter()
+        if self.trace.start_s is None:
+            self.trace.start_s = t0
         self._mod.forward(DataBatch(data=data, label=[]), is_train=False)
         self.pos += S
+        t1 = time.perf_counter()
+        _trace.record(self.trace, "lm.decode.step", t0, t1,
+                      parent=self.trace.root, pos=self.pos - S, tokens=S)
+        # the session root grows with every step: same span id, longer
+        # duration — consumers dedupe keeping the last record
+        _trace.record(self.trace, "lm.decode.session",
+                      self.trace.start_s, t1, span_id=self.trace.root,
+                      capacity=self.capacity, pos=self.pos)
         return self._mod.get_outputs()[0]

@@ -118,21 +118,48 @@ class BaseModule:
         scan-fused arrangement (module.fit steps_per_dispatch)."""
         return 1
 
+    @staticmethod
+    def _iter_with_data_wait(train_data):
+        """Iterate ``train_data``, banking the time each ``next()``
+        blocks (the PrefetchingIter handoff) into the step-attribution
+        plane as the upcoming step's ``data_wait`` phase. One branch
+        per batch when attribution is off."""
+        it = iter(train_data)
+        sa = _telemetry.stepattr
+        while True:
+            if sa.armed():
+                t0 = sa.clock()
+                try:
+                    batch = next(it)
+                except StopIteration:
+                    return
+                sa.note_data_wait(sa.clock() - t0)
+            else:
+                try:
+                    batch = next(it)
+                except StopIteration:
+                    return
+            yield batch
+
     def _fit_epoch(self, epoch, train_data, eval_metric, batch_end_callback,
                    monitor, skip=0):
         K = self._scan_window_size()
         if K > 1 and monitor is None:
             return self._fit_epoch_scan(epoch, train_data, eval_metric,
                                         batch_end_callback, K, skip=skip)
-        for nbatch, batch in enumerate(train_data):
+        sa = _telemetry.stepattr
+        for nbatch, batch in enumerate(
+                self._iter_with_data_wait(train_data)):
             if nbatch < skip:
                 # resume fast-forward: these batches already trained
                 # before the kill; consuming them keeps the data stream
                 # (and any restored shuffle rng) aligned with the
                 # uninterrupted run
+                sa.clear_pending_wait()
                 continue
             if monitor is not None:
                 monitor.tic()
+            sa.step_begin(epoch, nbatch)
             batch_span = _telemetry.span(
                 "module.fit.batch", _hist="module.fit.batch.seconds",
                 epoch=epoch, nbatch=nbatch)
@@ -156,6 +183,7 @@ class BaseModule:
                     dur_us=(time.perf_counter_ns() - t0) // 1000,
                     batch_size=getattr(train_data, "batch_size", 0))
             self.update_metric(eval_metric, batch.label)
+            sa.step_end()
             if monitor is not None:
                 monitor.toc_print()
             if batch_end_callback is not None:
@@ -181,13 +209,16 @@ class BaseModule:
         nbatch = 0
         to_skip = int(skip)
         batch_size = getattr(train_data, "batch_size", 0)
+        sa = _telemetry.stepattr
 
         def run_single(batch):
             nonlocal nbatch, to_skip
             if to_skip > 0:
                 to_skip -= 1
                 nbatch += 1
+                sa.clear_pending_wait()
                 return
+            sa.step_begin(epoch, nbatch)
             t0 = time.perf_counter_ns()
             batch_span = _telemetry.span(
                 "module.fit.batch", _hist="module.fit.batch.seconds",
@@ -199,6 +230,7 @@ class BaseModule:
                              (time.perf_counter_ns() - t0) // 1000,
                              batch_size)
             self.update_metric(eval_metric, batch.label)
+            sa.step_end()
             if batch_end_callback is not None:
                 _fire(batch_end_callback,
                       BatchEndParam(epoch=epoch, nbatch=nbatch,
@@ -212,6 +244,7 @@ class BaseModule:
             if to_skip >= steps:
                 to_skip -= steps
                 nbatch += steps
+                sa.clear_pending_wait()
                 return
             if to_skip > 0:
                 # cursor inside this window: fast-forward the remainder
@@ -222,6 +255,7 @@ class BaseModule:
                 for b in singles:
                     run_single(b)
                 return
+            sa.step_begin(epoch, nbatch)
             t0 = time.perf_counter_ns()
             win_span = _telemetry.span(
                 "module.fit.window", _hist="module.fit.window.seconds",
@@ -240,12 +274,15 @@ class BaseModule:
                                         eval_metric=eval_metric,
                                         locals=locals()))
                 nbatch += 1
+            # one attribution record per window: phases divide over the
+            # K logical batches it retired
+            sa.step_end(steps=steps)
             # checkpoint/dead-node boundary once per retired window —
             # the only consistent cursor under scan dispatch
             self._ckpt_tick(epoch, nbatch - 1)
 
         pending = []
-        for batch in train_data:
+        for batch in self._iter_with_data_wait(train_data):
             if isinstance(batch, StackedDataBatch):
                 if batch.steps == K:
                     run_window(batch, K)

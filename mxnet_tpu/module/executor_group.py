@@ -738,8 +738,18 @@ class DataParallelExecutorGroup:
     def fused_step(self, data_batch, lrs, wds):
         """Run one fused train step; swap new params/state/outputs in
         (gradients are emitted and written back only under
-        ``MXNET_FUSED_KEEP_GRADS=1`` — they cost ~5% of the step)."""
+        ``MXNET_FUSED_KEEP_GRADS=1`` — they cost ~5% of the step).
+
+        Step attribution (telemetry/stepattr.py, armed fit loops only):
+        host batch staging counts as ``assemble``, the async program
+        call as ``dispatch``, and — every single step being its own
+        window boundary — a block-until-ready on the advanced params as
+        ``device``."""
         from .. import random as _random
+        _sa = _telemetry.stepattr
+        sa_on = _sa.active()
+        if sa_on:
+            sa_t0 = _sa.clock()
         exe = self.executor
         self._load_batch(data_batch)
         if self._fused_rng_gen != _random.generation():
@@ -761,10 +771,18 @@ class DataParallelExecutorGroup:
                 lrwd_key, jnp.asarray(lrwd_key[0], jnp.float32),
                 jnp.asarray(lrwd_key[1], jnp.float32))
         _, lr_arr, wd_arr = self._fused_lrwd
+        if sa_on:
+            sa_t1 = _sa.clock()
+            _sa.note("assemble", sa_t1 - sa_t0)
         (outs, new_aux, new_w, new_states, grads, self._fused_key,
          mets) = self._fused_prog(w, arg_vals, exe._aux_vals(),
                                   self._fused_key, self._fused_states,
                                   lr_arr, wd_arr)
+        if sa_on:
+            sa_t2 = _sa.clock()
+            _sa.note("dispatch", sa_t2 - sa_t1)
+            jax.block_until_ready(new_w)
+            _sa.note("device", _sa.clock() - sa_t2)
         self._fused_states = new_states
         self._fused_metric_scalars = [
             (m, int(np.prod(arg_vals[nm].shape)))
@@ -914,6 +932,10 @@ class DataParallelExecutorGroup:
         counts for ``advance_scan_step`` so the fit loop can still do
         per-batch bookkeeping."""
         from .. import random as _random
+        _sa = _telemetry.stepattr
+        sa_on = _sa.active()
+        if sa_on:
+            sa_t0 = _sa.clock()
         exe = self.executor
         K = len(lrs_list)
         if not self.scan_ready(K):
@@ -943,10 +965,21 @@ class DataParallelExecutorGroup:
         w = {nm: arg_vals.pop(nm) for nm in self._fused_watched}
         rest_static = {nm: v for nm, v in arg_vals.items()
                        if nm not in xs_in}
+        if sa_on:
+            sa_t1 = _sa.clock()
+            _sa.note("assemble", sa_t1 - sa_t0)
         (new_w, new_states, self._fused_key, new_aux, outs_s,
          mets_s) = self._scan_prog(
             w, self._fused_states, self._fused_key, exe._aux_vals(),
             rest_static, {"in": xs_in, "lr": lr_arr, "wd": wd_arr})
+        if sa_on:
+            sa_t2 = _sa.clock()
+            _sa.note("dispatch", sa_t2 - sa_t1)
+            # the window boundary IS the step-attribution sync point:
+            # one block per K batches, so the scan fast path keeps its
+            # async pipeline shape while device time still attributes
+            jax.block_until_ready(new_w)
+            _sa.note("device", _sa.clock() - sa_t2)
         self._fused_states = new_states
         ad = exe.arg_dict
         for nm in self._fused_watched:

@@ -75,6 +75,12 @@ def dump_profile():
     """Serialize collected spans to chrome://tracing JSON at the
     configured filename and return that path (reference: MXDumpProfile).
 
+    Besides the executor/fit spans, the dump carries the request trace
+    plane (``serve.trace/<id>`` tracks, one per traced request/decode
+    session) and the training step-phase breakdown (``step.phase``
+    track) whenever those planes recorded anything — docs/telemetry.md
+    "Trace plane" / "Step-time attribution".
+
     Always returns the written file's path — including when no trace was
     ever started (the file then just carries an empty/partial span set),
     never a silent None. The JAX xplane trace dir (when one ran) is

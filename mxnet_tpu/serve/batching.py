@@ -48,6 +48,7 @@ class QueueFullError(MXNetError):
     """
 
     retry_after_ms = None
+    trace_id = None
 
 
 class ShedError(MXNetError):
@@ -56,9 +57,11 @@ class ShedError(MXNetError):
     deadline even if dispatched immediately (already doomed — serving
     it would only waste a bucket slot another request could use).
     Counted under ``serve.shed``, distinct from ``serve.rejected``
-    (admission-time rejections)."""
+    (admission-time rejections). ``trace_id`` names the shed request's
+    trace, whose root span carries the queue state that doomed it."""
 
     retry_after_ms = None
+    trace_id = None
 
 
 def default_ladder():
@@ -144,18 +147,28 @@ def slice_rows(outputs, start, rows):
 
 class Request:
     """One admitted unit of work: inputs (name -> rows-leading numpy
-    array), row count, arrival/deadline in scheduler-clock seconds."""
+    array), row count, arrival/deadline in scheduler-clock seconds.
+
+    ``trace``/``root_sid``: the request's trace identity when sampled
+    (telemetry.trace) — every scheduling stage it crosses records a
+    span under ``root_sid`` so the request reconstructs to one span
+    tree. A decode-session request shares the session's trace and its
+    root span becomes a child of the session root.
+    """
 
     __slots__ = ("id", "model", "inputs", "rows", "arrival", "deadline",
-                 "handle")
+                 "handle", "trace", "root_sid")
 
-    def __init__(self, model, inputs, rows, arrival, deadline):
+    def __init__(self, model, inputs, rows, arrival, deadline,
+                 trace=None):
         self.id = next(_req_ids)
         self.model = model
         self.inputs = inputs
         self.rows = rows
         self.arrival = arrival
         self.deadline = deadline
+        self.trace = trace
+        self.root_sid = None
         self.handle = ResponseHandle(self)
 
 
@@ -183,6 +196,13 @@ class ResponseHandle:
 
     def done(self):
         return self._event.is_set()
+
+    @property
+    def trace_id(self):
+        """The request's trace id (None when sampling skipped it) — the
+        key into ``telemetry.trace.tree()`` for its span tree."""
+        tr = self.request.trace
+        return tr.trace_id if tr is not None else None
 
     @property
     def latency(self):

@@ -321,11 +321,24 @@ class PredictorEngine(_EngineBase):
         """Base warmup, then rewind a stateful artifact's carried state:
         the warmup forwards advance a KV-cache decoder's cache with
         zero-token garbage, and served decode steps must start from the
-        exported snapshot."""
+        exported snapshot. A stateful engine also opens its decode
+        *session trace* here: every submit against it joins ONE trace
+        (telemetry.trace), so an N-token decode reconstructs to a
+        single span tree under the session root."""
         est = super().warmup(clock)
         if getattr(self._pred, "stateful", False):
             self._pred.reset_state()
+            from ..telemetry import trace as _trace
+            self.session_trace = _trace.new_trace(session=True)
         return est
+
+    def reset_session(self):
+        """Rewind the decoder state AND rotate the session trace — the
+        next submit starts a fresh decode session/tree."""
+        if getattr(self._pred, "stateful", False):
+            self._pred.reset_state()
+            from ..telemetry import trace as _trace
+            self.session_trace = _trace.new_trace(session=True)
 
     @property
     def output_names(self):

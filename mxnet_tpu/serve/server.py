@@ -50,6 +50,7 @@ import numpy as np
 from .. import faults as _faults
 from .. import program_cache as _progcache
 from .. import telemetry as _telemetry
+from ..telemetry import trace as _trace
 from ..base import MXNetError
 from ..faults import CircuitOpenError
 from .batching import Request, ShedError, pad_rows, slice_rows
@@ -119,6 +120,7 @@ class InferenceServer:
         self._thread = None
         self._running = False
         self._warm_mark = None
+        self._slowest = {}      # model -> (trace_id, latency_s)
 
     # ------------------------------------------------------------- registry
     def register(self, name, model=None, symbol=None, arg_params=None,
@@ -203,7 +205,7 @@ class InferenceServer:
         return self._registry.engine(name or self._registry.sole_name())
 
     # ------------------------------------------------------------ admission
-    def submit(self, inputs, model=None, deadline_ms=None):
+    def submit(self, inputs, model=None, deadline_ms=None, trace=None):
         """Admit one request; returns its ``ResponseHandle``.
 
         ``inputs``: dict input name -> array with a leading row dim
@@ -211,6 +213,12 @@ class InferenceServer:
         relative to now (default ``MXNET_SERVE_DEADLINE_MS``); the
         scheduler flushes the request's batch no later than
         deadline - estimated bucket execution time.
+
+        ``trace``: join an existing ``telemetry.trace.Trace`` (a decode
+        session spanning N submits keeps ONE trace; the request's root
+        span parents under the session root). Default: a fresh trace
+        per request under ``MXNET_TRACE_SAMPLE``, or the engine's
+        session trace for a stateful (KV-cache decoder) model.
         """
         name = model or self._registry.sole_name()
         engine = self._registry.engine(name)
@@ -219,15 +227,39 @@ class InferenceServer:
         now = self._clock.now()
         deadline_s = (deadline_ms if deadline_ms is not None
                       else self._default_deadline_s * 1000.0) / 1000.0
-        req = Request(name, vals, rows, now, now + deadline_s)
+        tr = trace
+        if tr is None:
+            tr = getattr(engine, "session_trace", None)
+        if tr is None and _trace.sample():
+            tr = _trace.new_trace()
+        req = Request(name, vals, rows, now, now + deadline_s, trace=tr)
+        if tr is not None:
+            req.root_sid = _trace.next_span_id()
         with self._cond:
             entry = self._registry.entry(name)
             if not entry.breaker.admit_allowed(now):
                 # breaker open: reject fast instead of queueing work
                 # onto a model that is structurally failing
                 _telemetry.counter("serve.rejected", model=name).inc()
-                raise CircuitOpenError(name,
+                exc = CircuitOpenError(name,
                                        entry.breaker.retry_after(now))
+                if tr is not None:
+                    # the rejected request still leaves a trace: a
+                    # zero-length root span naming the breaker state,
+                    # and the ring record carries the trace id so the
+                    # rejection is joinable to the trace after the fact
+                    exc.trace_id = tr.trace_id
+                    _trace.record(
+                        tr, "serve.request", now, now,
+                        span_id=req.root_sid,
+                        parent=tr.root if tr.session else None,
+                        model=name, error="circuit_open",
+                        breaker=entry.breaker.state)
+                _telemetry.flightrec.note(
+                    "serve.breaker.reject", model=name,
+                    trace=tr.trace_id if tr is not None else None,
+                    retry_after_ms=exc.retry_after_ms)
+                raise exc
             if len(entry.queue) >= self._shed_depth:
                 self._shed_doomed(entry, now)
             try:
@@ -235,6 +267,8 @@ class InferenceServer:
             except MXNetError as exc:
                 _telemetry.counter("serve.rejected", model=name).inc()
                 exc.retry_after_ms = self._retry_after_ms(entry)
+                if tr is not None:
+                    exc.trace_id = tr.trace_id
                 raise
             depth = len(entry.queue)
             self._cond.notify_all()
@@ -269,15 +303,33 @@ class InferenceServer:
         if not doomed:
             return
         retry_after = self._retry_after_ms(entry)
+        depth = len(entry.queue)
         _telemetry.counter("serve.shed", model=name).inc(len(doomed))
-        _telemetry.flightrec.note("serve.shed", model=name,
-                                  n=len(doomed),
-                                  retry_after_ms=retry_after)
+        _telemetry.flightrec.note(
+            "serve.shed", model=name, n=len(doomed),
+            retry_after_ms=retry_after,
+            # the shed decision is joinable to its victims' traces —
+            # and each victim's root span (below) carries the queue
+            # state that doomed it
+            trace_ids=[r.trace.trace_id for r in doomed[:8]
+                       if r.trace is not None])
         for r in doomed:
             err = ShedError(
                 f"model {name!r}: request {r.id} shed at queue depth "
                 f"watermark — deadline unreachable before dispatch")
             err.retry_after_ms = retry_after
+            if r.trace is not None:
+                err.trace_id = r.trace.trace_id
+                _trace.record(
+                    r.trace, "serve.queue.wait", r.arrival, now,
+                    parent=r.root_sid)
+                _trace.record(
+                    r.trace, "serve.request", r.arrival, now,
+                    span_id=r.root_sid,
+                    parent=r.trace.root if r.trace.session else None,
+                    model=name, rows=r.rows, error="shed",
+                    queue_depth=depth, shed_depth=self._shed_depth,
+                    retry_after_ms=retry_after)
             r.handle._complete(error=err, now=now)
 
     def _depth_total(self):
@@ -304,6 +356,12 @@ class InferenceServer:
             depth = len(entry.queue)
         bucket = engine.ladder.bucket_for(rows)
         wait_s = self._clock.now() - min(r.arrival for r in reqs)
+        traced = [r for r in reqs if r.trace is not None]
+        # batched requests share ONE dispatch span id: the span is
+        # mirrored into each member's trace under that member's root,
+        # so every request reconstructs alone and batch-mates join on
+        # the shared id
+        shared_sid = _trace.next_span_id() if traced else None
 
         # the flush break-even must cover the WHOLE dispatch cost the
         # tail request pays, so t0 starts before batch assembly
@@ -313,6 +371,7 @@ class InferenceServer:
                 np.concatenate([r.inputs[nm] for r in reqs], axis=0)
                 if len(reqs) > 1 else reqs[0].inputs[nm], bucket)
             for nm in engine.data_names}
+        asm_end = self._clock.now()
         try:
             _faults.point("serve.dispatch", model=name, bucket=bucket)
             outs = engine.forward(bucket, values)
@@ -323,16 +382,26 @@ class InferenceServer:
             now = self._clock.now()
             entry.breaker.record_failure(now)
             for r in reqs:
+                if r.trace is not None:
+                    _trace.record(
+                        r.trace, "serve.request", r.arrival, now,
+                        span_id=r.root_sid,
+                        parent=r.trace.root if r.trace.session else None,
+                        model=name, rows=r.rows, bucket=bucket,
+                        error=type(exc).__name__)
                 r.handle._complete(error=exc, now=now)
             _telemetry.counter("serve.errors", model=name).inc()
-            _telemetry.flightrec.note("serve.dispatch.error", model=name,
-                                      bucket=bucket, error=repr(exc),
-                                      breaker=entry.breaker.state)
+            _telemetry.flightrec.note(
+                "serve.dispatch.error", model=name,
+                bucket=bucket, error=repr(exc),
+                breaker=entry.breaker.state,
+                trace_ids=[r.trace.trace_id for r in traced[:8]])
             self.logger.exception("serve: dispatch failed for %r", name)
             return len(reqs)
         entry.breaker.record_success(self._clock.now())
         exec_s = self._clock.now() - t0
         engine.note_exec(bucket, exec_s)
+        exec_end = self._clock.now()
 
         now = self._clock.now()
         off = 0
@@ -343,9 +412,17 @@ class InferenceServer:
             r.handle._complete(outputs=slice_rows(outs, off, r.rows),
                                bucket=bucket, now=now)
             off += r.rows
-            lat_hist.observe(now - r.arrival)
+            lat_hist.observe(now - r.arrival,
+                             exemplar=r.trace.trace_id
+                             if r.trace is not None else None)
             if now > r.deadline:
                 misses += 1
+        resp_end = self._clock.now()
+        for r in traced:
+            self._record_request_trace(r, name, bucket, len(reqs),
+                                       shared_sid, t0, asm_end,
+                                       exec_end, resp_end,
+                                       missed=resp_end > r.deadline)
 
         _telemetry.histogram("serve.batch.exec.seconds",
                              model=name).observe(exec_s)
@@ -372,8 +449,61 @@ class InferenceServer:
             "serve.dispatch", model=name, bucket=bucket, rows=rows,
             n_requests=len(reqs), occupancy=round(rows / bucket, 3),
             wait_us=int(wait_s * 1e6), exec_us=int(exec_s * 1e6),
-            deadline_misses=misses, compiles_since_warmup=compiles)
+            deadline_misses=misses, compiles_since_warmup=compiles,
+            trace_ids=[r.trace.trace_id for r in traced[:8]])
         return len(reqs)
+
+    def _record_request_trace(self, r, name, bucket, n_requests,
+                              shared_sid, t0, asm_end, exec_end,
+                              resp_end, missed=False):
+        """Record one served request's span tree (telemetry.trace):
+
+        ::
+
+            serve.request                arrival -> respond
+            ├─ serve.queue.wait          arrival -> drain
+            └─ serve.dispatch (shared)   drain   -> exec done
+               ├─ serve.assemble         pad / coalesce
+               ├─ serve.exec             bucket program + block
+               └─ serve.respond          slice + complete
+
+        The dispatch span id is shared across the batch; its children
+        are mirrored per member trace so each tree stands alone. For a
+        decode session the request root parents under the session root,
+        which is re-recorded (same span id, growing duration) so the
+        whole N-step decode stays ONE tree.
+        """
+        tr = r.trace
+        parent = None
+        if tr.session:
+            if tr.root is None:
+                tr.root = _trace.next_span_id()
+            if tr.start_s is None:
+                tr.start_s = r.arrival
+            parent = tr.root
+        _trace.record(tr, "serve.queue.wait", r.arrival, t0,
+                      parent=r.root_sid)
+        _trace.record(tr, "serve.dispatch", t0, exec_end,
+                      span_id=shared_sid, parent=r.root_sid,
+                      bucket=bucket, n_requests=n_requests, shared=True)
+        _trace.record(tr, "serve.assemble", t0, asm_end,
+                      parent=shared_sid)
+        _trace.record(tr, "serve.exec", asm_end, exec_end,
+                      parent=shared_sid)
+        _trace.record(tr, "serve.respond", exec_end, resp_end,
+                      parent=shared_sid)
+        _trace.record(tr, "serve.request", r.arrival, resp_end,
+                      span_id=r.root_sid, parent=parent, model=name,
+                      rows=r.rows, bucket=bucket,
+                      deadline_miss=bool(missed))
+        if tr.session:
+            _trace.record(tr, "serve.decode.session", tr.start_s,
+                          resp_end, span_id=tr.root, model=name)
+        # the per-model slowest completed trace (stats() surfaces it)
+        lat = resp_end - r.arrival
+        worst = self._slowest.get(name)
+        if worst is None or lat > worst[1]:
+            self._slowest[name] = (tr.trace_id, lat)
 
     # ----------------------------------------------------------- drive modes
     def pump(self, max_dispatches=None):
@@ -486,6 +616,13 @@ class InferenceServer:
                     "p99": round((h.quantile(0.99) or 0) * 1e3, 3),
                     "mean": round(h.mean * 1e3, 3),
                     "max": round((h.max or 0) * 1e3, 3)},
+                # exemplars: concrete traces behind the aggregates — a
+                # p99 number links to a request you can reconstruct
+                # with telemetry.trace.tree()
+                "p99_trace": None if h is None else h.exemplar(0.99),
+                "slowest_trace": None if name not in self._slowest else {
+                    "trace": self._slowest[name][0],
+                    "latency_ms": round(self._slowest[name][1] * 1e3, 3)},
                 "batch_occupancy": round(rows_v / pad_v, 4)
                 if pad_v else None,
                 "padding_waste_pct": round(100 * (1 - rows_v / pad_v), 2)

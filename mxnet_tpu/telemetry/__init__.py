@@ -52,6 +52,8 @@ from . import flightrec
 from . import memory
 from . import mfu
 from . import sentinel
+from . import trace
+from . import stepattr
 from . import chrome_trace
 from . import prometheus
 from . import jsonl
@@ -60,7 +62,7 @@ __all__ = ["span", "event", "record_event", "enable", "disable", "enabled",
            "clear", "get_spans", "get_events", "null_span", "wrap_dispatch",
            "Counter", "Gauge", "Histogram", "counter", "gauge", "histogram",
            "get_metric", "snapshot", "reset", "NanSentinel", "AnomalyError",
-           "flightrec", "memory", "mfu", "sentinel",
+           "flightrec", "memory", "mfu", "sentinel", "trace", "stepattr",
            "chrome_trace", "prometheus", "jsonl"]
 
 
@@ -75,11 +77,14 @@ def snapshot():
 
 
 def reset():
-    """Clear spans, events, the metrics registry, and the flight-recorder
-    ring; drop memory peak watermarks to current live (live accounting
-    tracks real handles and is never cleared). The enabled/disabled
-    switch is left as-is."""
+    """Clear spans, events, the metrics registry, the flight-recorder
+    ring, the trace-plane buffer and the step-attribution records; drop
+    memory peak watermarks to current live (live accounting tracks real
+    handles and is never cleared). The enabled/disabled switch is left
+    as-is."""
     core.clear()
     metrics.reset()
     flightrec.clear()
+    trace.clear()
+    stepattr.reset()
     memory.reset_peak()

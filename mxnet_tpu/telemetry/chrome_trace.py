@@ -13,25 +13,40 @@ import json
 import os
 
 from . import core
+from . import stepattr as _stepattr
+from . import trace as _trace
 
 __all__ = ["trace_events", "render", "dump"]
 
+# synthetic lane bases for the non-thread tracks: request traces get one
+# lane per trace (spans of different requests overlap in time, and
+# chrome nests "X" events per tid), step phases share one lane (phases
+# of a step are laid out sequentially inside the step interval)
+_STEP_TID = 0x5E70000
+_TRACE_TID = 0x7ACE000
 
-def trace_events(spans=None, events=None):
+
+def trace_events(spans=None, events=None, traces=True, steps=True):
     """Build the traceEvents list: one metadata event per (pid, tid)
-    lane, one "X" complete event per span, one "i" instant per event."""
+    lane, one "X" complete event per span, one "i" instant per event —
+    plus, when present, the serve trace plane (``serve.trace/*`` lanes,
+    one per request trace) and the training step-phase breakdown
+    (``step.phase`` lane), so ``profiler.dump_profile()`` shows where a
+    request or a train step spent its time next to the executor spans.
+    """
     spans = core.get_spans() if spans is None else spans
     events = core.get_events() if events is None else events
     out = []
+    pid = os.getpid()
     lanes = {}
     for s in spans:
         lanes.setdefault((s.pid, s.tid), None)
     for e in events:
         lanes.setdefault((e["pid"], e["tid"]), None)
-    for i, (pid, tid) in enumerate(sorted(lanes)):
-        out.append({"name": "process_name", "ph": "M", "pid": pid,
+    for i, (lpid, tid) in enumerate(sorted(lanes)):
+        out.append({"name": "process_name", "ph": "M", "pid": lpid,
                     "args": {"name": "mxnet_tpu"}})
-        out.append({"name": "thread_name", "ph": "M", "pid": pid,
+        out.append({"name": "thread_name", "ph": "M", "pid": lpid,
                     "tid": tid, "args": {"name": f"thread-{i}"}})
     for s in spans:
         args = dict(s.args)
@@ -44,6 +59,49 @@ def trace_events(spans=None, events=None):
         out.append({"name": e["kind"], "cat": "event", "ph": "i",
                     "ts": e["ts_us"], "pid": e["pid"], "tid": e["tid"],
                     "s": "t", "args": dict(e["payload"])})
+
+    if traces:
+        by_trace = {}
+        for rec in _trace.spans():
+            by_trace.setdefault(rec["trace"], []).append(rec)
+        for i, (tid_str, recs) in enumerate(sorted(by_trace.items())):
+            lane = _TRACE_TID + i
+            out.append({"name": "thread_name", "ph": "M", "pid": pid,
+                        "tid": lane,
+                        "args": {"name": f"serve.trace/{tid_str}"}})
+            for rec in recs:
+                args = {k: v for k, v in rec.items()
+                        if k not in ("name", "ts_us", "dur_us")}
+                out.append({"name": rec["name"], "cat": "trace",
+                            "ph": "X", "ts": rec["ts_us"],
+                            "dur": rec["dur_us"], "pid": pid,
+                            "tid": lane, "args": args})
+
+    if steps:
+        recs = _stepattr.records()
+        if recs:
+            out.append({"name": "thread_name", "ph": "M", "pid": pid,
+                        "tid": _STEP_TID,
+                        "args": {"name": "step.phase"}})
+        for rec in recs:
+            out.append({"name": "step", "cat": "step", "ph": "X",
+                        "ts": rec["ts_us"], "dur": rec["wall_us"],
+                        "pid": pid, "tid": _STEP_TID,
+                        "args": {"epoch": rec["epoch"],
+                                 "nbatch": rec["nbatch"],
+                                 "steps": rec["steps"],
+                                 "straggler": rec["straggler"]}})
+            # phases laid out sequentially inside the step interval in
+            # their real order (wait -> assemble -> dispatch -> device)
+            cursor = rec["ts_us"]
+            for phase in _stepattr.PHASES:
+                dur = rec["phases_us"].get(phase, 0)
+                if dur <= 0:
+                    continue
+                out.append({"name": f"step.phase.{phase}", "cat": "step",
+                            "ph": "X", "ts": cursor, "dur": dur,
+                            "pid": pid, "tid": _STEP_TID, "args": {}})
+                cursor += dur
     return out
 
 

@@ -19,13 +19,15 @@ import os
 
 from . import core
 from . import metrics as _metrics
+from . import trace as _trace
 
 __all__ = ["lines", "render", "dump"]
 
 
-def lines(spans=True, events=True, metrics=True):
+def lines(spans=True, events=True, metrics=True, traces=True):
     """Yield the log as dicts, events first (they are what log consumers
-    key on), then spans in completion order, then the registry."""
+    key on), then spans in completion order, then the trace plane's
+    request span-tree records, then the registry."""
     if events:
         for e in core.get_events():
             rec = {"type": "event", "kind": e["kind"], "ts_us": e["ts_us"]}
@@ -37,6 +39,11 @@ def lines(spans=True, events=True, metrics=True):
             yield {"type": "span", "name": s.name, "ts_us": s.ts,
                    "dur_us": s.dur, "pid": s.pid, "tid": s.tid,
                    "parent": s.parent, "args": dict(s.args)}
+    if traces:
+        # one line per (trace, span): tools/diagnose.py rebuilds the
+        # request span trees from exactly these records
+        for rec in _trace.spans():
+            yield {"type": "trace", **rec}
     if metrics:
         for m in _metrics.all_metrics():
             labels = dict(m.labels)

@@ -91,9 +91,20 @@ class Gauge(_Metric):
 
 
 class Histogram(_Metric):
-    """Fixed-bucket histogram: cumulative bucket counts + sum/min/max."""
+    """Fixed-bucket histogram: cumulative bucket counts + sum/min/max.
 
-    __slots__ = ("buckets", "bucket_counts", "count", "sum", "min", "max")
+    ``observe(v, exemplar=...)`` optionally attaches an exemplar (a
+    trace id) to the bucket the observation lands in — the OpenMetrics
+    exemplar concept, so a p99 latency bucket links to one concrete
+    trace. Exemplars are pure side metadata: bucket counts, ``sum``,
+    ``quantile`` and the default Prometheus text rendering are
+    byte-identical with or without them (the golden-output test pins
+    this); ``prometheus.render(openmetrics=True)`` opts into emitting
+    them.
+    """
+
+    __slots__ = ("buckets", "bucket_counts", "count", "sum", "min", "max",
+                 "exemplars")
 
     def __init__(self, name, labels, buckets=None):
         super().__init__(name, labels)
@@ -103,17 +114,42 @@ class Histogram(_Metric):
         self.sum = 0.0
         self.min = None
         self.max = None
+        self.exemplars = {}     # bucket index (len = +Inf) -> (id, value)
 
-    def observe(self, v):
+    def observe(self, v, exemplar=None):
         v = float(v)
         self.count += 1
         self.sum += v
         self.min = v if self.min is None else min(self.min, v)
         self.max = v if self.max is None else max(self.max, v)
+        landed = len(self.buckets)          # +Inf overflow slot
         for i, le in enumerate(self.buckets):
             if v <= le:
                 self.bucket_counts[i] += 1
+                landed = min(landed, i)
+        if exemplar is not None:
+            self.exemplars[landed] = (str(exemplar), v)
         return self
+
+    def exemplar(self, q):
+        """The exemplar nearest the q-quantile: the one attached to the
+        quantile's bucket, else the closest bucket above it (a trace
+        that is at least as slow). None when no exemplar applies."""
+        if not self.count or not self.exemplars:
+            return None
+        rank = q * self.count
+        cum = 0
+        idx = len(self.buckets)             # default: overflow slot
+        for i, c in enumerate(self.bucket_counts):
+            cum = c                         # counts are cumulative
+            if c >= rank:
+                idx = i
+                break
+        for i in range(idx, len(self.buckets) + 1):
+            if i in self.exemplars:
+                return self.exemplars[i][0]
+        # nothing at or above: fall back to the slowest exemplar seen
+        return self.exemplars[max(self.exemplars)][0]
 
     @property
     def mean(self):

@@ -45,8 +45,16 @@ def _fmt(v):
     return str(int(f)) if f == int(f) else repr(f)
 
 
-def render():
-    """The registry as exposition text."""
+def render(openmetrics=False):
+    """The registry as exposition text.
+
+    ``openmetrics=True`` additionally emits histogram exemplars in the
+    OpenMetrics form — ``..._bucket{le="0.1"} 5 # {trace_id="t00002a"}
+    0.093`` — on the buckets that carry one. The default (plain
+    Prometheus 0.0.4 text) is byte-identical to the pre-exemplar
+    format: scrapers and ``parse()`` never see the annotation unless
+    asked for (the trace-plane golden-output test pins this).
+    """
     lines = []
     seen_types = set()
 
@@ -66,13 +74,24 @@ def render():
             lines.append(f"{fam}{_labels_text(m.labels)} {_fmt(m.value)}")
         elif isinstance(m, _metrics.Histogram):
             header(fam, "histogram")
-            for le, c in m.cumulative():
+
+            def _ex(idx):
+                if not openmetrics:
+                    return ""
+                ex = m.exemplars.get(idx)
+                if ex is None:
+                    return ""
+                return f' # {{trace_id="{ex[0]}"}} {_fmt(ex[1])}'
+
+            for i, (le, c) in enumerate(m.cumulative()):
                 lines.append(
                     f"{fam}_bucket"
-                    f"{_labels_text(m.labels, [('le', _fmt(le))])} {c}")
+                    f"{_labels_text(m.labels, [('le', _fmt(le))])} {c}"
+                    f"{_ex(i)}")
             lines.append(
                 f"{fam}_bucket"
-                f"{_labels_text(m.labels, [('le', '+Inf')])} {m.count}")
+                f"{_labels_text(m.labels, [('le', '+Inf')])} {m.count}"
+                f"{_ex(len(m.buckets))}")
             lines.append(f"{fam}_sum{_labels_text(m.labels)} {_fmt(m.sum)}")
             lines.append(f"{fam}_count{_labels_text(m.labels)} {m.count}")
     return "\n".join(lines) + ("\n" if lines else "")

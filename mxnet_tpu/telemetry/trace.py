@@ -1,0 +1,237 @@
+"""Request-scoped trace plane: trace ids, span trees, bounded buffer.
+
+The span tracer (core.py) answers "what did this *process* spend time
+on"; it cannot answer "where did *this request* spend its time" — a
+served request crosses the admission queue, batch coalescing, a shared
+bucket dispatch and the response slice, interleaved with every other
+request in flight. The trace plane adds the missing identity: a
+``TraceContext`` (one ``trace_id`` + process-wide unique span ids)
+rides the request from ``submit`` to its ``ResponseHandle``, and every
+stage records a ``(trace, span, parent)`` triple, so the request
+reconstructs to a single parented span tree after the fact — the same
+shape Dapper/OpenTelemetry give a multi-service RPC, scoped to the
+in-process serving stack.
+
+Record discipline:
+
+* spans are recorded at *finish* with explicit start/end times from the
+  caller's clock — the serving scheduler passes its ``MonotonicClock``/
+  ``FakeClock`` seconds, so traces are deterministic under the fake
+  clock (tier-1's scripted runs assert exact trees);
+* a span id may be recorded more than once (a decoder *session* root
+  span grows across N token steps); consumers dedupe by ``(trace,
+  span)`` keeping the last record — ``spans()``/``tree()`` do this;
+* batched requests share ONE dispatch span id: the span is mirrored
+  into each member request's trace under that request's root, so every
+  tree is complete on its own and batch-mates are joinable on the
+  shared id.
+
+Storage is a bounded deque (``MXNET_TRACE_CAPACITY``, default 4096
+records) and every record is also mirrored into the flight-recorder
+ring as a ``trace.span`` record — counted under the ring's own
+``MXNET_FLIGHT_RECORDER_CAPACITY`` bound like any other record, so an
+always-on trace plane cannot grow memory unbounded. Sampling
+(``MXNET_TRACE_SAMPLE``, fraction of requests traced, default 1.0) is
+counter-based and deterministic: request k is traced iff
+``floor(k*rate) > floor((k-1)*rate)`` — no rng, same decisions every
+run.
+
+Pure stdlib; any layer can import this module without ordering
+constraints.
+"""
+from __future__ import annotations
+
+import collections
+import itertools
+import os
+import threading
+
+from . import flightrec as _flightrec
+
+__all__ = ["Trace", "new_trace", "next_span_id", "record", "sample",
+           "spans", "tree", "trace_ids", "roots", "clear", "configure"]
+
+_DEFAULT_CAPACITY = 4096
+
+_lock = threading.Lock()
+
+
+def _env_capacity():
+    try:
+        return max(1, int(os.environ.get("MXNET_TRACE_CAPACITY", "")
+                          or _DEFAULT_CAPACITY))
+    except ValueError:
+        return _DEFAULT_CAPACITY
+
+
+def _env_sample():
+    try:
+        rate = float(os.environ.get("MXNET_TRACE_SAMPLE", "") or 1.0)
+    except ValueError:
+        rate = 1.0
+    return min(1.0, max(0.0, rate))
+
+
+_buf = collections.deque(maxlen=_env_capacity())
+_sample_rate = _env_sample()
+_trace_seq = itertools.count(1)
+_span_seq = itertools.count(1)
+_sample_count = 0
+
+
+class Trace:
+    """One trace identity: the ``trace_id`` plus the root span id once
+    the root has been recorded (consumers parent follow-on spans —
+    e.g. a decode session's per-step requests — under ``root``).
+    Session traces track their start time so the growing session root
+    span can be re-recorded (same span id, longer dur) per step."""
+
+    __slots__ = ("trace_id", "root", "session", "start_s")
+
+    def __init__(self, trace_id, session=False):
+        self.trace_id = trace_id
+        self.root = None
+        self.session = session      # a long-lived multi-request trace
+        self.start_s = None
+
+    def __repr__(self):
+        return f"Trace({self.trace_id!r}, root={self.root})"
+
+
+def new_trace(session=False):
+    """Allocate a fresh trace identity (cheap: one counter bump)."""
+    return Trace(f"t{next(_trace_seq):06x}", session=session)
+
+
+def next_span_id():
+    """Process-wide unique span id (shared-dispatch spans allocate one
+    and mirror it into several traces)."""
+    return next(_span_seq)
+
+
+def sample():
+    """Deterministic sampling decision for the next request: True iff
+    the cumulative sampled count should advance at MXNET_TRACE_SAMPLE.
+    Rate 1.0 always samples; 0.0 never."""
+    global _sample_count
+    rate = _sample_rate
+    if rate >= 1.0:
+        return True
+    if rate <= 0.0:
+        return False
+    with _lock:
+        k = _sample_count = _sample_count + 1
+    return int(k * rate) > int((k - 1) * rate)
+
+
+def record(trace, name, start_s, end_s, span_id=None, parent=None,
+           **args):
+    """Record one finished span into the buffer + flight ring.
+
+    ``trace``: a Trace or a bare trace-id string. ``start_s``/``end_s``
+    are caller-clock seconds (the serve scheduler clock, perf_counter,
+    ...). Returns the span id used (allocating one when not given).
+    """
+    tid = trace.trace_id if isinstance(trace, Trace) else str(trace)
+    sid = span_id if span_id is not None else next_span_id()
+    rec = {"trace": tid, "span": sid,
+           "parent": parent, "name": name,
+           "ts_us": round(start_s * 1e6),
+           "dur_us": max(0, round((end_s - start_s) * 1e6)), **args}
+    _buf.append(rec)
+    if isinstance(trace, Trace) and parent is None and trace.root is None:
+        trace.root = sid
+    _flightrec.note("trace.span", **rec)
+    return sid
+
+
+def spans(trace_id=None):
+    """Recorded spans (deduped by (trace, span), last record wins),
+    optionally restricted to one trace, in record order."""
+    with _lock:
+        raw = list(_buf)
+    out = {}
+    for rec in raw:
+        if trace_id is not None and rec["trace"] != trace_id:
+            continue
+        out[(rec["trace"], rec["span"])] = rec
+    return list(out.values())
+
+
+def trace_ids():
+    """Distinct trace ids still in the buffer, oldest first."""
+    seen = []
+    with _lock:
+        raw = list(_buf)
+    for rec in raw:
+        if rec["trace"] not in seen:
+            seen.append(rec["trace"])
+    return seen
+
+
+def roots(trace_id=None):
+    """Root spans (parent is None) in the buffer, deduped."""
+    return [r for r in spans(trace_id) if r["parent"] is None]
+
+
+def tree(trace_id):
+    """Reconstruct one trace as a nested tree.
+
+    Returns the root node ``{.., "children": [...]}`` (children in
+    start-time order), or None when the trace has no spans / no root.
+    Orphan spans (parent evicted from the bounded buffer) attach under
+    the root so the tree stays connected.
+    """
+    recs = spans(trace_id)
+    if not recs:
+        return None
+    nodes = {r["span"]: dict(r, children=[]) for r in recs}
+    root = None
+    for r in recs:
+        node = nodes[r["span"]]
+        if r["parent"] is None and root is None:
+            root = node
+        elif r["parent"] in nodes and r["parent"] != r["span"]:
+            nodes[r["parent"]]["children"].append(node)
+    if root is None:
+        return None
+    for n in nodes.values():
+        n["children"].sort(key=lambda c: c["ts_us"])
+    # orphans: recorded parent missing (evicted) — keep them reachable
+    attached = set()
+
+    def mark(n):
+        attached.add(n["span"])
+        for c in n["children"]:
+            mark(c)
+    mark(root)
+    for r in recs:
+        if r["span"] not in attached and r["parent"] is not None:
+            root["children"].append(nodes[r["span"]])
+            mark(nodes[r["span"]])
+    return root
+
+
+def clear():
+    """Drop buffered trace records (ids keep counting — uniqueness is
+    process-lifetime)."""
+    _buf.clear()
+
+
+def configure(capacity=None, sample=None, reset_ids=False):
+    """Adjust the trace plane (tests / long-lived servers).
+
+    ``capacity`` resizes the bounded buffer (newest records kept),
+    ``sample`` overrides MXNET_TRACE_SAMPLE, ``reset_ids`` rewinds the
+    trace/span id counters (deterministic-id tests only).
+    """
+    global _buf, _sample_rate, _trace_seq, _span_seq, _sample_count
+    if capacity is not None:
+        _buf = collections.deque(_buf, maxlen=max(1, int(capacity)))
+    if sample is not None:
+        _sample_rate = min(1.0, max(0.0, float(sample)))
+        _sample_count = 0
+    if reset_ids:
+        _trace_seq = itertools.count(1)
+        _span_seq = itertools.count(1)
+        _sample_count = 0

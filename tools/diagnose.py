@@ -489,6 +489,91 @@ def _faults_section(counters, gauge_triples, records):
     return lines
 
 
+def _traces_section(trace_recs, counters, hist_entries, straggler_recs,
+                    top=3):
+    """Trace-plane report (telemetry.trace + stepattr): the slowest
+    request span trees, the step-phase breakdown table, and the
+    straggler list — rendered only when trace/step data exists."""
+    # --- request trace trees: dedupe by (trace, span), last wins
+    by_key = {}
+    for r in trace_recs or []:
+        if r.get("trace") is None or r.get("span") is None:
+            continue
+        by_key[(r["trace"], r["span"])] = r
+    by_trace = {}
+    for r in by_key.values():
+        by_trace.setdefault(r["trace"], []).append(r)
+
+    phase_rows = []
+    for name, _labels, rec in hist_entries or []:
+        if name.startswith("step.phase.") and name.endswith(".seconds"):
+            phase_rows.append((name[len("step.phase."):-len(".seconds")],
+                               rec))
+    stragglers = int({_strip_labels(k)[0]: v
+                      for k, v in (counters or {}).items()}
+                     .get("step.stragglers", 0))
+
+    if not (by_trace or phase_rows or straggler_recs or stragglers):
+        return []
+    lines = ["traces:"]
+
+    roots = []
+    for tid, recs in by_trace.items():
+        spans = {r["span"] for r in recs}
+        for r in recs:
+            if r.get("parent") is None or r["parent"] not in spans:
+                roots.append((tid, r))
+                break
+    roots.sort(key=lambda tr: -(tr[1].get("dur_us") or 0))
+    if roots:
+        lines.append(f"  request traces: {len(by_trace)} in "
+                     f"buffer/ring; slowest:")
+
+    def render_node(recs, node, depth):
+        extra = ""
+        if node.get("error"):
+            extra = f"  ERROR={node['error']}"
+        elif node.get("deadline_miss"):
+            extra = "  DEADLINE MISS"
+        lines.append(f"  {'  ' * depth}{_fmt_us(node.get('dur_us', 0)):>10}"
+                     f"  {node.get('name', '?')}{extra}")
+        kids = sorted((r for r in recs
+                       if r.get("parent") == node["span"]),
+                      key=lambda r: r.get("ts_us", 0))
+        for k in kids:
+            render_node(recs, k, depth + 1)
+
+    for tid, root in roots[:top]:
+        lines.append(f"    {tid}:")
+        render_node(by_trace[tid], root, 2)
+
+    if phase_rows:
+        total = sum((rec.get("sum") or 0.0) for _p, rec in phase_rows)
+        lines.append("  step phases (per logical batch):")
+        for phase, rec in sorted(
+                phase_rows, key=lambda pr: -(pr[1].get("sum") or 0)):
+            mean = (rec.get("mean") or 0.0) * 1e3
+            share = 100.0 * (rec.get("sum") or 0.0) / total if total \
+                else 0.0
+            lines.append(f"    {phase:<10} mean {mean:8.2f} ms  "
+                         f"{share:5.1f}% of step  "
+                         f"(n={rec.get('count', 0)})")
+    if stragglers or straggler_recs:
+        n = stragglers or len(straggler_recs or [])
+        lines.append(f"  stragglers: {int(n)} step(s) flagged "
+                     f"(> k*MAD above rolling median)")
+        for r in (straggler_recs or [])[-3:]:
+            phases = {k[:-3]: _fmt_us(v) for k, v in r.items()
+                      if k.endswith("_us") and
+                      k not in ("ts_us", "wall_us", "median_us")}
+            lines.append(
+                f"    epoch {r.get('epoch', '?')} batch "
+                f"{r.get('nbatch', '?')}: {_fmt_us(r.get('wall_us', 0))}"
+                f" vs median {_fmt_us(r.get('median_us', 0))} — "
+                f"{phases}")
+    return lines
+
+
 def _anomaly_section(anoms):
     if not anoms:
         return ["anomalies: none recorded"]
@@ -567,6 +652,11 @@ def render_crash(report, top=10):
         metrics.get("counters") or {},
         _gauge_triples_from_series(metrics.get("gauges") or {}),
         ring)
+    out += _traces_section(
+        [r for r in ring if r.get("kind") == "trace.span"],
+        metrics.get("counters") or {},
+        _hist_entries_from_series(metrics.get("histograms") or {}),
+        [r for r in ring if r.get("kind") == "step.straggler"])
 
     # throughput from ring batch records
     batches = [r for r in ring if r.get("kind") == "module.fit.batch"
@@ -602,6 +692,7 @@ def render_crash(report, top=10):
 def render_jsonl(lines, top=10):
     """Telemetry jsonl lines -> health-report text."""
     events, spans, counters, gauges, hists = [], [], {}, {}, {}
+    traces = []                     # trace-plane span records
     hist_entries = []               # (name, labels, rec) — labels kept
     for line in lines:
         line = line.strip()
@@ -614,6 +705,8 @@ def render_jsonl(lines, top=10):
         t = rec.get("type")
         if t == "event":
             events.append(rec)
+        elif t == "trace":
+            traces.append(rec)
         elif t == "span":
             spans.append(rec)
         elif t == "counter":
@@ -701,6 +794,9 @@ def render_jsonl(lines, top=10):
         [(name, dict(labels), val)
          for (name, labels), val in gauges.items()],
         events)
+    out += _traces_section(
+        traces, counters, hist_entries,
+        [e for e in events if e.get("kind") == "step.straggler"])
     out += _slowest_spans(spans, top)
 
     h = hists.get("module.fit.batch.seconds")
