@@ -463,6 +463,87 @@ def measure_lm_variant():
         return {"error": f"{type(e).__name__}: {e}"}
 
 
+def measure_decode_batch_variant():
+    """The ``decode_batch`` variant row: aggregate KV-cache decode
+    tokens/s through the continuous-batching decode scheduler
+    (serve/decode.py) at slot counts {1, 4, 8} under open-loop
+    arrivals — the serving-throughput multiplier ROADMAP 3(b) names.
+    Each point runs a single-rung slot ladder so the figure isolates
+    the slot count; occupancy and the zero-compile contract ride along
+    (``compiles_since_warmup`` must be 0 at every point). Small model
+    on CPU, bench-scale on TPU; never sinks the run."""
+    import time
+    import numpy as np
+    import jax
+    import mxnet_tpu as mx
+
+    try:
+        from mxnet_tpu.models import transformer as tfm
+
+        on_tpu = jax.default_backend() == "tpu"
+        V, D, L, H = (32000, 512, 8, 8) if on_tpu else (128, 64, 2, 4)
+        CAP = 256 if on_tpu else 64
+        PROMPT, MAX_NEW = (16, 64) if on_tpu else (4, 16)
+        RATE = 200.0            # open-loop arrivals/s (saturating)
+
+        sym = tfm.get_symbol(vocab_size=V, d_model=D, n_layer=L,
+                             n_head=H, seq_len=8, include_loss=False,
+                             max_seq_len=CAP)
+        mod = mx.mod.Module(sym, label_names=[])
+        mod.bind([("data", (1, 8))], None, for_training=False)
+        mod.init_params(mx.initializer.Xavier(rnd_type="gaussian",
+                                              magnitude=2))
+        args, _ = mod.get_params()
+        dec_sym = tfm.get_decode_symbol(
+            vocab_size=V, d_model=D, n_layer=L, n_head=H, capacity=CAP,
+            per_slot=True, max_seq_len=CAP)
+
+        rows = {}
+        for slots in (1, 4, 8):
+            sched = mx.serve.serve_decoder(
+                dec_sym, args, name=f"decb{slots}", ladder=[slots],
+                start=True)
+            rs = np.random.RandomState(slots)
+            n_req = 3 * slots
+            gaps = rs.exponential(1.0 / RATE, size=n_req)
+            handles = []
+            t0 = time.perf_counter()
+            at = t0
+            for i in range(n_req):
+                at += gaps[i]
+                dt = at - time.perf_counter()
+                if dt > 0:
+                    time.sleep(dt)
+                handles.append(sched.submit(
+                    rs.randint(0, V, PROMPT).tolist(),
+                    max_new_tokens=MAX_NEW))
+            toks = sum(len(h.result(timeout=600)) for h in handles)
+            elapsed = time.perf_counter() - t0
+            stats = sched.stats()
+            sched.stop()
+            rows[f"slots{slots}_tokens_per_sec"] = round(
+                toks / elapsed, 1) if elapsed else None
+            rows[f"slots{slots}_occupancy_mean"] = round(
+                stats["tokens"] / (stats["iterations"] * slots), 3) \
+                if stats["iterations"] else None
+            rows[f"slots{slots}_compiles_since_warmup"] = \
+                stats["compiles_since_warmup"]
+        if rows.get("slots1_tokens_per_sec") and \
+                rows.get("slots8_tokens_per_sec"):
+            rows["speedup_8v1"] = round(
+                rows["slots8_tokens_per_sec"]
+                / rows["slots1_tokens_per_sec"], 2)
+        rows.update({
+            "model": {"vocab": V, "d_model": D, "layers": L, "heads": H,
+                      "capacity": CAP},
+            "prompt_len": PROMPT, "max_new_tokens": MAX_NEW,
+            "open_loop_rate_req_s": RATE,
+        })
+        return rows
+    except Exception as e:          # the variant must never sink the run
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
 def measure_remat_memory_variant():
     """Residual-byte delta per remat policy at the resnet20 bench point
     (benchmarks/remat_memory.py): the roofline-side record of what
@@ -587,6 +668,7 @@ def run_cpu_fallback():
         "ckpt": measure_ckpt_variant(),
         "remat_memory": measure_remat_memory_variant(),
         "lm": measure_lm_variant(),
+        "decode_batch": measure_decode_batch_variant(),
         "kernel_tier_selection": kernel_tier_selection_table(),
         "note": "accelerator backend unavailable; ours-only fused-step "
                 "throughput on the XLA CPU backend at a CIFAR-scale "
@@ -816,6 +898,11 @@ def main():
     _log("lm variant (transformer train/decode/max-context)")
     lm_variant = measure_lm_variant()
 
+    # decode_batch variant: continuous-batching aggregate decode
+    # tokens/s at slots {1, 4, 8} (ROADMAP 3b)
+    _log("decode_batch variant (slot-pooled continuous batching)")
+    decode_batch_variant = measure_decode_batch_variant()
+
     # per-op MFU attribution + roofline from the registry cost metadata
     # (telemetry/mfu.py): coverage is attributed FLOPs over the XLA
     # compiled-program count — the honesty check on the per-op numbers
@@ -887,6 +974,7 @@ def main():
         "ckpt": ckpt_variant,
         "remat_memory": remat_variant,
         "lm": lm_variant,
+        "decode_batch": decode_batch_variant,
         "kernel_tier_selection": kernel_tier_selection_table(),
         "mfu_ours": mfu(ours_img_s, ours_flops),
         "mfu_flax": mfu(flax_img_s, flax_flops),

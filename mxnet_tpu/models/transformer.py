@@ -36,7 +36,8 @@ from .. import symbol as sym
 from ..base import MXNetError
 
 __all__ = ["get_symbol", "get_decode_symbol", "SyntheticLMIter",
-           "KVCacheDecoder", "default_cache_capacity"]
+           "KVCacheDecoder", "BatchedKVCacheDecoder",
+           "default_cache_capacity"]
 
 
 def default_cache_capacity():
@@ -58,11 +59,14 @@ def _proj(x, num_hidden, name, no_bias=False):
 
 
 def _block(x, *, i, seq_len, d_model, n_head, dropout, pos_embed,
-           rope_base, name, decode=False, capacity=None):
+           rope_base, name, decode=False, capacity=None,
+           per_slot=False):
     """One pre-LN transformer block; ``decode=True`` swaps the full
     ``attention`` for the KV-cache ``attention_decode`` path (same
     parameter names either way, so one trained parameter set serves
-    both graphs)."""
+    both graphs). ``per_slot=True`` selects the slot-pooled decode
+    lowering: a (B, 1) cursor vector so every batch row decodes its own
+    sequence at its own position."""
     pfx = f"{name}_l{i}"
     dh = d_model // n_head
     T = seq_len
@@ -82,7 +86,8 @@ def _block(x, *, i, seq_len, d_model, n_head, dropout, pos_embed,
     if decode:
         att = sym.attention_decode(
             q, k, v, capacity=capacity, rope=(pos_embed == "rotary"),
-            rope_base=rope_base, name=f"{pfx}_attn")
+            rope_base=rope_base, per_slot=per_slot,
+            name=f"{pfx}_attn")
     else:
         if pos_embed == "rotary":
             q = sym.RoPE(q, base=rope_base, name=f"{pfx}_rope_q")
@@ -121,9 +126,12 @@ def _validate(vocab_size, d_model, n_head, pos_embed):
 
 
 def _embed(data, tok_w, *, seq_len, vocab_size, d_model, pos_embed,
-           max_seq_len, name, pos_ids=None):
+           max_seq_len, name, pos_ids=None, per_slot=False):
     """Token embedding (scaled by sqrt(D), transformer convention) plus
-    the learned position table when ``pos_embed='learned'``."""
+    the learned position table when ``pos_embed='learned'``. Per-slot
+    decode feeds ``pos_ids`` shaped (B, S) — every slot at its own
+    absolute position — so the looked-up table rows already align with
+    ``x`` and add elementwise."""
     x = sym.Embedding(data=data, weight=tok_w, input_dim=vocab_size,
                       output_dim=d_model,
                       scale=float(np.sqrt(d_model)),
@@ -135,9 +143,12 @@ def _embed(data, tok_w, *, seq_len, vocab_size, d_model, pos_embed,
         pos_w = sym.var(f"{name}_pos_embed_weight")
         pos = sym.Embedding(data=pos_ids, weight=pos_w,
                             input_dim=max_seq_len, output_dim=d_model,
-                            name=f"{name}_pos_embed")    # (T, D)
-        pos = sym.expand_dims(pos, axis=0, name=f"{name}_pos_b")
-        x = sym.broadcast_add(x, pos, name=f"{name}_add_pos")
+                            name=f"{name}_pos_embed")    # (T, D) /
+        if per_slot:                                     # (B, S, D)
+            x = x + pos
+        else:
+            pos = sym.expand_dims(pos, axis=0, name=f"{name}_pos_b")
+            x = sym.broadcast_add(x, pos, name=f"{name}_add_pos")
     return x
 
 
@@ -185,15 +196,26 @@ def get_symbol(vocab_size=256, d_model=64, n_layer=2, n_head=4,
 def get_decode_symbol(vocab_size=256, d_model=64, n_layer=2, n_head=4,
                       pos_embed="rotary", rope_base=10000.0,
                       capacity=None, step_len=1, max_seq_len=None,
-                      name="lm"):
+                      per_slot=False, name="lm"):
     """Incremental KV-cache decoder: ``(B, step_len)`` new token ids in,
     logits ``(B, step_len, vocab)`` out, per-layer K/V caches of
     ``capacity`` positions riding executor aux state. Parameter names
     match ``get_symbol``'s exactly, so a trained parameter set loads
     unchanged. ``pos_embed='learned'`` adds a ``pos_ids`` input
     (``(step_len,)`` absolute positions — ``KVCacheDecoder`` feeds it).
+
+    ``per_slot=True`` builds the slot-pooled continuous-batching graph
+    (``step_len`` must stay 1): every batch row is an independent decode
+    slot with its own (B, 1) cache cursor, so one pinned program
+    advances B sequences at B different positions per dispatch —
+    ``BatchedKVCacheDecoder`` drives it, ``serve.decode`` schedules it.
+    With learned positions the ``pos_ids`` input becomes ``(B, 1)``
+    per-slot absolute positions.
     """
     _validate(vocab_size, d_model, n_head, pos_embed)
+    if per_slot and step_len != 1:
+        raise MXNetError("per_slot decode advances one token per slot "
+                         f"per dispatch (step_len={step_len})")
     capacity = capacity or default_cache_capacity()
     max_seq_len = max_seq_len or capacity
     S = step_len
@@ -203,11 +225,13 @@ def get_decode_symbol(vocab_size=256, d_model=64, n_layer=2, n_head=4,
     pos_ids = sym.var("pos_ids") if pos_embed == "learned" else None
     x = _embed(data, tok_w, seq_len=S, vocab_size=vocab_size,
                d_model=d_model, pos_embed=pos_embed,
-               max_seq_len=max_seq_len, name=name, pos_ids=pos_ids)
+               max_seq_len=max_seq_len, name=name, pos_ids=pos_ids,
+               per_slot=per_slot)
     for i in range(n_layer):
         x = _block(x, i=i, seq_len=S, d_model=d_model, n_head=n_head,
                    dropout=0.0, pos_embed=pos_embed, rope_base=rope_base,
-                   name=name, decode=True, capacity=capacity)
+                   name=name, decode=True, capacity=capacity,
+                   per_slot=per_slot)
     x = sym.LayerNorm(x, name=f"{name}_ln_f")
     flat = sym.Reshape(x, shape=(-3, 0), name=f"{name}_head_fold")
     logits = sym.dot(flat, tok_w, transpose_b=True,
@@ -331,4 +355,103 @@ class KVCacheDecoder:
         _trace.record(self.trace, "lm.decode.session",
                       self.trace.start_s, t1, span_id=self.trace.root,
                       capacity=self.capacity, pos=self.pos)
+        return self._mod.get_outputs()[0]
+
+
+class BatchedKVCacheDecoder:
+    """Host-side driver for a bound SLOT-POOLED decode module.
+
+    The module must be bound ``for_training=False`` over
+    ``get_decode_symbol(per_slot=True)``'s graph at a fixed slot count
+    (the batch dim). Each slot is an independent sequence: ``join``
+    claims a slot (resets its device cursor), ``leave`` releases it
+    host-side only (the program keeps advancing the retired row
+    harmlessly — its writes stay inside its own slot and nothing
+    attends them), and ``step`` advances EVERY slot by one token in one
+    dispatch. Like ``KVCacheDecoder``, the driver owns what the pinned
+    program cannot check: per-slot cursors (capacity overflow raises
+    HERE, naming the offending slots, before the masked write would
+    no-op) and the per-slot ``pos_ids`` feed for learned positions.
+
+    ``serve.decode.DecodeScheduler`` builds the continuous-batching
+    front end (admission, retirement, streaming, rung ladder) on top of
+    one of these per slot rung.
+    """
+
+    def __init__(self, module, capacity, slots=None, pos_embed="rotary"):
+        self._mod = module
+        self.capacity = int(capacity)
+        self.pos_embed = pos_embed
+        if slots is None:
+            slots = module.data_shapes[0].shape[0]
+        self.slots = int(slots)
+        self.pos = np.zeros(self.slots, np.int64)    # device-cursor mirror
+        self.active = np.zeros(self.slots, bool)
+
+    def _cursor_cells(self):
+        exe = self._mod._exec_group.executor
+        return [cell for nm, cell in exe.aux_dict.items()
+                if nm.endswith("cache_pos")]
+
+    def free_slots(self):
+        """Slot indices with no active sequence."""
+        return [i for i in range(self.slots) if not self.active[i]]
+
+    def join(self, slot):
+        """Claim ``slot`` for a new sequence: rewind its device cursor
+        to 0 across every layer (one tiny in-place aux update per layer
+        — never a program-cache compile) and mark it active. The cache
+        rows are NOT zeroed: every position a fresh sequence attends is
+        rewritten by it first, and masked positions carry exactly zero
+        softmax weight, so reuse is bit-clean."""
+        import jax.numpy as jnp
+        slot = int(slot)
+        if self.active[slot]:
+            raise MXNetError(f"slot {slot} already holds an active "
+                             "sequence (leave() it first)")
+        for cell in self._cursor_cells():
+            cell._set(cell.asjax().at[slot, 0].set(jnp.int32(0)))
+        self.pos[slot] = 0
+        self.active[slot] = True
+        return slot
+
+    def leave(self, slot):
+        """Release ``slot`` host-side. No device work: the retired row
+        keeps advancing as a masked no-op until the next join."""
+        self.active[int(slot)] = False
+
+    def overflowing(self):
+        """Active slots whose NEXT step would pass capacity — the
+        scheduler retires these (alone) before dispatch."""
+        return [i for i in range(self.slots)
+                if self.active[i] and self.pos[i] + 1 > self.capacity]
+
+    def step(self, tokens):
+        """Advance every slot by one token: ``tokens`` (slots,) or
+        (slots, 1) int ids (retired slots ride any valid id, 0 by
+        convention) -> logits (slots, 1, V) NDArray. Raises per slot
+        BEFORE dispatch when an active slot would overflow its cache —
+        batchmates are untouched (nothing was dispatched)."""
+        from .. import ndarray as nd
+        from ..io import DataBatch
+        over = self.overflowing()
+        if over:
+            raise MXNetError(
+                f"KV cache overflow in slot(s) {over}: position "
+                f"{[int(self.pos[i]) for i in over]} + 1 exceeds "
+                f"capacity {self.capacity}; retire the sequence(s) or "
+                "re-bind with a larger capacity")
+        tokens = np.asarray(tokens)
+        if tokens.ndim == 1:
+            tokens = tokens[:, None]
+        if tokens.shape != (self.slots, 1):
+            raise MXNetError(f"step() wants ({self.slots}, 1) tokens, "
+                             f"got {tokens.shape}")
+        data = [nd.array(tokens.astype(np.int32))]
+        if self.pos_embed == "learned":
+            data.append(nd.array(
+                np.minimum(self.pos, self.capacity - 1)
+                .astype(np.float32)[:, None]))
+        self._mod.forward(DataBatch(data=data, label=[]), is_train=False)
+        self.pos += 1            # the program advances EVERY slot
         return self._mod.get_outputs()[0]

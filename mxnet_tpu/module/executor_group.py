@@ -236,11 +236,20 @@ class DataParallelExecutorGroup:
                      if n.is_variable and n._extra.get("__is_aux__")
                      and n._extra.get("__dtype__")}
         for name, shape in zip(self.aux_names, aux_shapes):
-            aux[name] = shared_aux.get(name) or NDArray(
-                self._place(jnp.zeros(shape,
-                                      dtype=aux_types.get(name,
-                                                          np.float32)),
-                            "param", name))
+            want = np.dtype(aux_types.get(name, np.float32))
+            cell = shared_aux.get(name)
+            # share an aux cell only when shape AND dtype agree: a
+            # slot-pooled decode ladder binds the SAME aux names at a
+            # different slot count per rung (the KV cache pool scales
+            # with the bucket key) — aliasing the leader's cell there
+            # would hand every rung a wrongly-shaped cache
+            if cell is not None and tuple(cell.shape) == tuple(shape) \
+                    and np.dtype(str(cell.asjax().dtype)) == want:
+                aux[name] = cell
+            else:
+                aux[name] = NDArray(
+                    self._place(jnp.zeros(shape, dtype=want),
+                                "param", name))
 
         # device-topology token for the program-cache keys: a compiled
         # program bakes its mesh's collective structure in, so a mesh

@@ -549,14 +549,20 @@ register("LayerNorm", inputs=("data", "gamma", "beta"), full=_ln_fwd,
 # --------------------------------------------------------------------------
 def rope_apply(x, positions, base=10000.0):
     """Rotate ``x`` (..., T, D) by rotary angles at absolute
-    ``positions`` (T,) — traced positions are fine (the KV-cache decode
-    path rotates at the cache cursor). Trig in float32, cast back."""
+    ``positions`` — (T,) shared across the batch, or (B, T) per-batch
+    positions (the slot-pooled decode path: every slot sits at its own
+    cursor). Traced positions are fine (the KV-cache decode path
+    rotates at the cache cursor). Trig in float32, cast back."""
     dh = x.shape[-1]
     half = dh // 2
     inv = jnp.asarray(base, jnp.float32) ** (
         -jnp.arange(0, half, dtype=jnp.float32) * (2.0 / dh))
-    ang = positions.astype(jnp.float32)[:, None] * inv[None, :]  # (T, half)
+    ang = positions.astype(jnp.float32)[..., :, None] * inv  # (..., T, half)
     cos, sin = jnp.cos(ang), jnp.sin(ang)
+    if positions.ndim == 2:
+        # per-slot positions broadcast against x (B, H, T, D): insert
+        # the head axis so (B, T, half) -> (B, 1, T, half)
+        cos, sin = cos[:, None], sin[:, None]
     x32 = x.astype(jnp.float32)
     x1, x2 = x32[..., :half], x32[..., half:]
     out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin],

@@ -248,6 +248,23 @@ class Predictor:
         if self._aux0 is not None:
             self._aux = dict(self._aux0)
 
+    def reset_slot(self, slot):
+        """Slot-pooled decode artifacts (``get_decode_symbol(
+        per_slot=True)`` exports): rewind ONE slot's cache cursors to
+        the exported snapshot, leaving every other slot's in-flight
+        state untouched — the join seam of continuous batching, with no
+        Symbol/Module stack in the process. Cursor aux cells are the
+        ``*cache_pos`` entries (the ``attention_decode`` contract); the
+        cache rows need no reset because positions beyond a slot's
+        cursor carry exactly zero attention weight. No-op for stateless
+        artifacts."""
+        if self._aux0 is None:
+            return
+        for n, snap in self._aux0.items():
+            if n.endswith("cache_pos") and snap.ndim == 2:
+                self._aux[n] = self._aux[n].at[int(slot)].set(
+                    snap[int(slot)])
+
     @property
     def input_dtypes(self):
         """Per-input dtypes recorded at export time (manifest

@@ -630,16 +630,37 @@ def _register_attention():
 # K/V arrays + an int32 cursor), read AND written on inference forwards
 # (OpDef.stateful_infer) — N incremental single-token steps reproduce
 # the length-N full-sequence forward.
+#
+# Two cursor layouts, one op:
+#
+# * scalar (default) — ONE (1,) cursor: all B rows decode the same
+#   sequence position (the single-session KVCacheDecoder path);
+# * ``per_slot=True`` — a (B, 1) int32 cursor VECTOR: each batch row is
+#   an independent decode *slot* at its own position in its own slice
+#   of the slot-pooled (B, H, C, Dh) cache. Writes land per slot
+#   through a one-hot select (bit-exact: untouched positions keep their
+#   cache value verbatim), the causal mask is per slot
+#   (key_pos <= cursor[b]), and the softmax runs over each slot's own
+#   prefix — so ONE pinned program advances B independent sequences by
+#   one token per dispatch. A retired slot keeps advancing harmlessly
+#   (its row is garbage nobody reads); rejoining resets only the
+#   cursor, because positions beyond a slot's prefix are exp(-inf)-
+#   masked to exactly zero weight and every attended position has been
+#   rewritten by the new sequence before its first read — slot reuse is
+#   bit-clean without touching the cache rows.
 # --------------------------------------------------------------------------
 def _attention_decode_fwd(attrs, inputs, aux, is_train, rng):
     from .base import parse_bool, parse_float
     from .ops.nn import rope_apply
 
     q, k, v = inputs                       # (B, H, S, Dh), S new tokens
-    k_cache, v_cache, cursor = aux         # (B,H,C,Dh) x2 + (1,) int32
+    k_cache, v_cache, cursor = aux         # (B,H,C,Dh) x2 + cursor
     if is_train:
         raise MXNetError("attention_decode is an inference op (train "
                          "with the full-sequence `attention` graph)")
+    if parse_bool(attrs.get("per_slot", False)):
+        return _attention_decode_per_slot(attrs, q, k, v, k_cache,
+                                          v_cache, cursor)
     B, H, S, Dh = q.shape
     capacity = k_cache.shape[2]
     pos = cursor.reshape(()).astype(jnp.int32)
@@ -680,14 +701,67 @@ def _attention_decode_fwd(attrs, inputs, aux, is_train, rng):
     return [out.astype(q.dtype)], [k_cache, v_cache, new_cursor]
 
 
+def _attention_decode_per_slot(attrs, q, k, v, k_cache, v_cache, cursor):
+    """The slot-pooled lowering: cursor (B, 1), one token per slot."""
+    from .base import parse_bool, parse_float
+    from .ops.nn import rope_apply
+
+    B, H, S, Dh = q.shape
+    if S != 1:
+        raise MXNetError(
+            f"attention_decode(per_slot=True) advances one token per "
+            f"slot per dispatch (got S={S}); iteration-level batching "
+            "feeds (B, 1) token windows")
+    capacity = k_cache.shape[2]
+    pos = cursor.reshape((B,)).astype(jnp.int32)          # (B,)
+    if not isinstance(pos, jax.core.Tracer):
+        over = [int(i) for i in np.nonzero(
+            np.asarray(pos) + S > capacity)[0]]
+        if over:
+            raise MXNetError(
+                f"attention_decode: cache overflow in slot(s) {over} "
+                f"(cursor + {S} > capacity {capacity}); retire the "
+                "sequence or re-bind with a larger capacity=")
+    scale = 1.0 / float(np.sqrt(Dh))
+    if parse_bool(attrs.get("rope", False)):
+        base = parse_float(attrs.get("rope_base", 10000.0))
+        positions = pos[:, None] + jnp.arange(S)[None, :]  # (B, S)
+        q = rope_apply(q, positions, base)
+        k = rope_apply(k, positions, base)
+    key_pos = jnp.arange(capacity)                         # (C,)
+    # one-hot per-slot write: jnp.where keeps untouched cache positions
+    # bit-identical and lands each slot's token at its own cursor; a
+    # cursor past capacity matches nothing (no clamped write)
+    write = (key_pos[None, :] == pos[:, None])[:, None, :, None]
+    k_cache = jnp.where(write, k.astype(k_cache.dtype), k_cache)
+    v_cache = jnp.where(write, v.astype(v_cache.dtype), v_cache)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k_cache.astype(q.dtype),
+                        precision=jax.lax.Precision.HIGHEST,
+                        preferred_element_type=jnp.float32) * scale
+    # per-slot prefix mask: slot b attends key_pos <= cursor[b]
+    mask = (key_pos[None, :] <= pos[:, None])[:, None, None, :]
+    logits = jnp.where(mask, logits, -jnp.inf)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs,
+                     v_cache.astype(jnp.float32),
+                     precision=jax.lax.Precision.HIGHEST,
+                     preferred_element_type=jnp.float32)
+    new_cursor = (pos + S).reshape((B, 1)).astype(jnp.int32)
+    return [out.astype(q.dtype)], [k_cache, v_cache, new_cursor]
+
+
 def _attention_decode_infer(attrs, in_shapes):
+    from .base import parse_bool
     q_s = in_shapes[0]
     c = int(attrs.get("capacity", 256))
+    per_slot = parse_bool(attrs.get("per_slot", False))
     if q_s is None:
-        return in_shapes, [None], [None, None, (1,)]
+        return in_shapes, [None], [None, None,
+                                   None if per_slot else (1,)]
     b, h, _s, dh = q_s
     cache = (b, h, c, dh)
-    return [q_s, q_s, q_s], [q_s], [cache, cache, (1,)]
+    cur = (b, 1) if per_slot else (1,)
+    return [q_s, q_s, q_s], [q_s], [cache, cache, cur]
 
 
 def _register_attention_decode():
@@ -701,7 +775,8 @@ def _register_attention_decode():
                  infer_shape=_attention_decode_infer,
                  attr_spec={"capacity": (int, 256),
                             "rope": (None, False),
-                            "rope_base": (float, 10000.0)})
+                            "rope_base": (float, 10000.0),
+                            "per_slot": (None, False)})
 
 
 _register_flash()

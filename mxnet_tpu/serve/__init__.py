@@ -43,6 +43,8 @@ from .registry import ModelRegistry
 from .server import InferenceServer, serve
 from .warm import restore_server, save_server, server_payload
 from .loadgen import PoissonLoadGen, run_scripted
+from .decode import (DecodeEngine, DecodeHandle, DecodeScheduler,
+                     default_slot_ladder, serve_decoder)
 
 __all__ = ["MonotonicClock", "FakeClock", "BucketLadder",
            "QueueFullError", "ShedError", "CircuitOpenError",
@@ -50,4 +52,6 @@ __all__ = ["MonotonicClock", "FakeClock", "BucketLadder",
            "default_ladder", "pad_rows", "slice_rows", "BucketEngine",
            "PredictorEngine", "ModelRegistry", "InferenceServer",
            "serve", "restore_server", "save_server", "server_payload",
-           "PoissonLoadGen", "run_scripted"]
+           "PoissonLoadGen", "run_scripted", "DecodeEngine",
+           "DecodeScheduler", "DecodeHandle", "default_slot_ladder",
+           "serve_decoder"]

@@ -1,0 +1,839 @@
+"""Continuous decode batching: iteration-level scheduling over a
+slot-pooled KV cache (the Orca-style serving path, ROADMAP 3b).
+
+``InferenceServer`` batches one-shot requests; a KV-cache decoder is a
+*sequence* — hundreds of single-token dispatches carrying device state
+between them — and serving it one sequence at a time pins decode
+throughput at batch 1. This module serves SLOTS sequences through ONE
+pinned program per iteration:
+
+* ``DecodeEngine`` — a slot-capacity rung ladder (``MXNET_SERVE_DECODE_
+  SLOTS``, default ``1,4,8``) over ``get_decode_symbol(per_slot=True)``
+  graphs: every rung is a Module bound at ``(slots, 1)`` sharing ONE
+  set of parameter cells (``BucketingModule``/shared_module, exactly
+  like the batch bucket ladder) with its own slot-pooled
+  ``(slots, H, C, Dh)`` KV-cache aux; ``warmup`` compiles and PINS
+  every rung, after which join/leave/rung-switches never mint a trace —
+  ``compiles_since_warmup()`` stays 0. Rung switches migrate the live
+  slots' cache rows + cursors between rung pools with eager per-row
+  copies (no program-cache entries).
+* ``DecodeScheduler`` — iteration-level continuous batching on the
+  ``submit`` seam: prefill admission into free slots (prompt tokens
+  ride the iteration stream, one per dispatch, so the program shape
+  never changes), per-iteration retirement (EOS / max-new-tokens /
+  deadline / per-slot cache overflow — an overflowing slot fails ALONE,
+  batchmates keep decoding), greedy sampling, and streaming token
+  delivery through ``DecodeHandle`` callbacks. Two drive modes, same as
+  the server: ``start()`` (dispatch thread, real clock) and ``pump()``
+  (explicit iterations, FakeClock-deterministic).
+
+Per-sequence traces survive being batched with strangers: every
+sequence keeps its own session trace (root span
+``serve.decode.sequence``), and each iteration records ONE shared
+``serve.decode.step`` span id mirrored into every active sequence's
+trace — the same shared-dispatch-span contract batched requests follow.
+
+Telemetry (always on, docs/serving.md has the catalog):
+``serve.decode.slots``/``active``/``occupancy``/``queue.depth`` gauges,
+``serve.decode.iterations``/``tokens``/``joins``/``leaves``/
+``migrations``/``requests``/``responses``/``errors`` counters,
+``serve.decode.step.seconds`` + ``serve.decode.request.latency.seconds``
+histograms, and one flight-ring record per iteration.
+"""
+from __future__ import annotations
+
+import itertools
+import logging
+import os
+import threading
+
+import numpy as np
+
+from .. import program_cache as _progcache
+from .. import telemetry as _telemetry
+from ..telemetry import trace as _trace
+from ..base import MXNetError
+from ..io import DataDesc
+from .batching import BucketLadder, QueueFullError
+from .clock import MonotonicClock
+
+__all__ = ["DecodeEngine", "DecodeScheduler", "DecodeHandle",
+           "default_slot_ladder", "serve_decoder"]
+
+log = logging.getLogger(__name__)
+
+_seq_ids = itertools.count()
+
+
+def _env_int(name, default):
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def default_slot_ladder():
+    """The slot-capacity rung ladder from ``MXNET_SERVE_DECODE_SLOTS``
+    (default ``1,4,8``): comma-separated concurrent-sequence capacities,
+    sorted ascending, duplicates dropped — the decode-side analog of
+    ``MXNET_SERVE_BUCKETS``."""
+    raw = os.environ.get("MXNET_SERVE_DECODE_SLOTS", "1,4,8")
+    try:
+        sizes = sorted({int(tok) for tok in raw.split(",") if tok.strip()})
+    except ValueError:
+        raise MXNetError(f"MXNET_SERVE_DECODE_SLOTS={raw!r}: expected "
+                         "comma-separated slot counts")
+    if not sizes or sizes[0] < 1:
+        raise MXNetError(f"MXNET_SERVE_DECODE_SLOTS={raw!r}: slot "
+                         "counts must be >= 1")
+    return sizes
+
+
+class _Sequence:
+    """One admitted decode request's scheduling state."""
+
+    __slots__ = ("id", "prompt", "max_new", "eos_id", "arrival",
+                 "deadline", "trace", "root_sid", "handle", "fed",
+                 "generated", "slot", "finish_reason")
+
+    def __init__(self, prompt, max_new, eos_id, arrival, deadline,
+                 trace=None):
+        self.id = next(_seq_ids)
+        self.prompt = prompt
+        self.max_new = max_new
+        self.eos_id = eos_id
+        self.arrival = arrival
+        self.deadline = deadline          # absolute clock s, or None
+        self.trace = trace
+        self.root_sid = None
+        self.fed = 0                      # prompt+generated tokens fed
+        self.generated = []
+        self.slot = None
+        self.finish_reason = None
+        self.handle = DecodeHandle(self)
+
+    def next_token(self):
+        """The token this sequence feeds THIS iteration: the next
+        prompt token while prefilling, else the last sampled one."""
+        if self.fed < len(self.prompt):
+            return int(self.prompt[self.fed])
+        return int(self.generated[-1])
+
+    def emitting(self):
+        """Does this iteration's output row carry a NEW token? True
+        once the last prompt token has been fed (its logits predict the
+        first generated position)."""
+        return self.fed >= len(self.prompt) - 1
+
+
+class DecodeHandle:
+    """Streaming sync+async result surface for one decode request.
+
+    Mirrors ``ResponseHandle`` (``done()``/``result()``/
+    ``add_done_callback``/``latency``) and adds the streaming half:
+    ``add_token_callback(fn)`` runs ``fn(handle, token, index)`` for
+    every generated token — already-emitted tokens replay immediately
+    on registration, so a late subscriber misses nothing. ``result()``
+    returns the generated ids as an int32 numpy array (EOS excluded);
+    ``finish_reason`` is ``"eos"``, ``"length"`` (max-new-tokens),
+    ``"deadline"`` (partial result, deadline passed mid-decode), or
+    None when the sequence errored (``exception()`` carries it).
+    """
+
+    def __init__(self, request):
+        self._event = threading.Event()
+        self._lock = threading.Lock()
+        self._done_callbacks = []
+        self._token_callbacks = []
+        self._tokens = []
+        self._error = None
+        self.request = request
+        self.completed_at = None        # scheduler-clock seconds
+        self.first_token_at = None
+
+    def done(self):
+        return self._event.is_set()
+
+    @property
+    def trace_id(self):
+        tr = self.request.trace
+        return tr.trace_id if tr is not None else None
+
+    @property
+    def tokens(self):
+        """Generated token ids so far (list copy — streaming-safe)."""
+        with self._lock:
+            return list(self._tokens)
+
+    @property
+    def finish_reason(self):
+        return self.request.finish_reason
+
+    @property
+    def latency(self):
+        """Admission-to-completion seconds (None until done)."""
+        if self.completed_at is None:
+            return None
+        return self.completed_at - self.request.arrival
+
+    @property
+    def ttft(self):
+        """Admission-to-first-token seconds (None before the first)."""
+        if self.first_token_at is None:
+            return None
+        return self.first_token_at - self.request.arrival
+
+    def missed_deadline(self):
+        return (self.completed_at is not None
+                and self.request.deadline is not None
+                and self.completed_at > self.request.deadline)
+
+    def result(self, timeout=None):
+        if not self._event.wait(timeout):
+            raise MXNetError(
+                f"decode request {self.request.id} not complete within "
+                f"{timeout}s (scheduler stopped or stuck?)")
+        if self._error is not None:
+            raise self._error
+        return np.asarray(self._tokens, np.int32)
+
+    def exception(self):
+        return self._error if self._event.is_set() else None
+
+    def add_done_callback(self, fn):
+        with self._lock:
+            if not self._event.is_set():
+                self._done_callbacks.append(fn)
+                return
+        fn(self)
+
+    def add_token_callback(self, fn):
+        """Stream generated tokens: ``fn(handle, token, index)`` per
+        token, starting with an immediate replay of any already
+        emitted."""
+        with self._lock:
+            replay = list(enumerate(self._tokens))
+            self._token_callbacks.append(fn)
+        for i, tok in replay:
+            self._safe(fn, tok, i)
+
+    def _safe(self, fn, *args):
+        try:
+            fn(self, *args)
+        except Exception:       # a client callback must not kill the
+            pass                # scheduler thread
+
+    def _emit(self, token, now=None):
+        with self._lock:
+            index = len(self._tokens)
+            self._tokens.append(int(token))
+            cbs = list(self._token_callbacks)
+        if index == 0:
+            self.first_token_at = now
+        for fn in cbs:
+            self._safe(fn, int(token), index)
+
+    def _complete(self, error=None, now=None):
+        with self._lock:
+            self._error = error
+            self.completed_at = now
+            callbacks, self._done_callbacks = self._done_callbacks, []
+            self._event.set()
+        for fn in callbacks:
+            self._safe(fn)
+
+
+class DecodeEngine:
+    """Slot-capacity rung ladder over a slot-pooled decode graph.
+
+    ``symbol`` must be a per-slot stateful decode graph (for the LM
+    workload: ``models.transformer.get_decode_symbol(per_slot=True)``)
+    whose batch dim is the slot count — the SAME symbol binds at every
+    rung, so all rungs share one parameter-cell set through the bucket
+    leader while each owns its rung-sized KV-cache pool. ``capacity``
+    defaults to the bound cache's (inferred from the aux shapes);
+    ``pos_embed`` is detected from the graph (a ``pos_ids`` argument =
+    learned positions, fed per slot by the drivers).
+    """
+
+    def __init__(self, name, symbol, arg_params, aux_params=None,
+                 capacity=None, ladder=None, context=None,
+                 compute_dtype=None, logger=None):
+        from ..context import current_context
+        from ..module import BucketingModule
+
+        self.name = name
+        self.ladder = ladder if isinstance(ladder, BucketLadder) \
+            else BucketLadder(ladder if ladder is not None
+                              else default_slot_ladder())
+        self.exec_est = {}              # rung -> EMA'd step seconds
+        self._warm_mark = None
+        self.warmup_compiles = None
+        self._symbol = symbol
+        self._context = context if context is not None \
+            else current_context()
+        self.pos_embed = "learned" \
+            if "pos_ids" in symbol.list_arguments() else "rotary"
+        self.data_names = ("data",) + (
+            ("pos_ids",) if self.pos_embed == "learned" else ())
+        if not any(getattr(n.opdef(), "stateful_infer", False)
+                   for n in symbol._topo_nodes() if not n.is_variable):
+            raise MXNetError(
+                f"DecodeEngine({name!r}): the symbol has no stateful "
+                "decode op (build it with get_decode_symbol("
+                "per_slot=True))")
+
+        self._bm = BucketingModule(
+            sym_gen=lambda slots: (symbol, list(self.data_names), []),
+            default_bucket_key=self.ladder.max,
+            logger=logger or log, context=self._context)
+        if compute_dtype is not None:
+            self._bm._module_kwargs["compute_dtype"] = compute_dtype
+        self._bm.bind(self._provide_data(self.ladder.max),
+                      label_shapes=None, for_training=False)
+        # straight to the leader with initializer=None: the decode
+        # graph's aux states (KV cache + cursor) are absent from any
+        # trained param set and must stay their bound zeros —
+        # BucketingModule.init_params would fall back to Uniform and
+        # trip over the cursor's name pattern
+        self._bm._leader.init_params(initializer=None,
+                                     arg_params=dict(arg_params or {}),
+                                     aux_params=dict(aux_params or {}),
+                                     allow_missing=True)
+        self._bm.params_initialized = True
+        self._bm._params_dirty = False
+        self._bm.warm_buckets(
+            [(s, self._provide_data(s), None) for s in self.ladder])
+
+        if capacity is None:
+            exe = self._bm._leader._exec_group.executor
+            caches = [cell for nm, cell in exe.aux_dict.items()
+                      if nm.endswith("k_cache")]
+            if not caches:
+                raise MXNetError(f"DecodeEngine({name!r}): no KV-cache "
+                                 "aux state in the bound graph")
+            capacity = caches[0].shape[2]
+        self.capacity = int(capacity)
+
+        from ..models.transformer import BatchedKVCacheDecoder
+        self._drivers = {
+            s: BatchedKVCacheDecoder(self._bm._buckets[s],
+                                     self.capacity, slots=s,
+                                     pos_embed=self.pos_embed)
+            for s in self.ladder}
+
+    def _provide_data(self, slots):
+        descs = [DataDesc("data", (slots, 1), np.int32)]
+        if self.pos_embed == "learned":
+            descs.append(DataDesc("pos_ids", (slots, 1), np.float32))
+        return descs
+
+    def driver(self, rung):
+        """The rung's ``BatchedKVCacheDecoder``."""
+        return self._drivers[rung]
+
+    # ------------------------------------------------------------- warmup
+    def warmup(self, clock):
+        """Compile every slot rung (two steps: first pays the trace,
+        second measures steady state on ``clock``), pin the programs,
+        record the compile delta. Warmup garbage stays harmless: the
+        drivers' slots are all free afterwards and a join rewinds the
+        slot's cursor."""
+        mark = _progcache.compile_count()
+        for rung in self.ladder:
+            drv = self._drivers[rung]
+            zeros = np.zeros((rung, 1), np.int32)
+            drv.step(zeros).asnumpy()            # trace + compile
+            t0 = clock.now()
+            drv.step(zeros).asnumpy()            # steady state
+            self.exec_est[rung] = max(0.0, clock.now() - t0)
+            drv.active[:] = False
+        self._pin_programs()
+        self._warm_mark = _progcache.compile_count()
+        self.warmup_compiles = self._warm_mark - mark
+        return dict(self.exec_est)
+
+    def note_exec(self, rung, seconds):
+        prev = self.exec_est.get(rung)
+        self.exec_est[rung] = seconds if prev is None else \
+            0.7 * prev + 0.3 * seconds
+
+    def exec_estimate(self, rung):
+        if rung in self.exec_est:
+            return self.exec_est[rung]
+        known = list(self.exec_est.values())
+        return max(known) if known else 0.0
+
+    def compiles_since_warmup(self):
+        if self._warm_mark is None:
+            return None
+        return _progcache.compile_count() - self._warm_mark
+
+    def program_keys(self):
+        keys = []
+        for rung, mod in self._bm._buckets.items():
+            key = mod._exec_group.executor.program_cache_key("fwd_infer")
+            if key is not None:
+                keys.append(key)
+        return keys
+
+    def _pin_programs(self):
+        for key in self.program_keys():
+            if not _progcache.pin(key):
+                log.warning(
+                    "decode %r: rung program not resident at pin time "
+                    "(cache capacity too small for the slot ladder? "
+                    "MXNET_PROGRAM_CACHE_SIZE)", self.name)
+
+    def programs_resident(self):
+        keys = self.program_keys()
+        return all(_progcache.contains(k) for k in keys) if keys else True
+
+    # ---------------------------------------------------------- migration
+    def migrate(self, src_rung, dst_rung, pairs):
+        """Carry live slots between rung pools: for every (src_row,
+        dst_row) pair, the slot's cache rows and cursor copy from the
+        ``src_rung`` aux arrays into ``dst_rung``'s, and the host
+        mirrors follow. Eager per-row gathers/scatters — nothing lands
+        in the program cache, so rung switches keep the zero-compile
+        contract."""
+        if src_rung == dst_rung:
+            return
+        sdrv, ddrv = self._drivers[src_rung], self._drivers[dst_rung]
+        s_exe = self._bm._buckets[src_rung]._exec_group.executor
+        d_exe = self._bm._buckets[dst_rung]._exec_group.executor
+        ddrv.active[:] = False
+        if pairs:
+            si = np.asarray([p[0] for p in pairs])
+            di = np.asarray([p[1] for p in pairs])
+            for nm, cell in s_exe.aux_dict.items():
+                dcell = d_exe.aux_dict[nm]
+                dcell._set(dcell.asjax().at[di].set(cell.asjax()[si]))
+            for s_row, d_row in pairs:
+                ddrv.pos[d_row] = sdrv.pos[s_row]
+                ddrv.active[d_row] = True
+        sdrv.active[:] = False
+
+
+class DecodeScheduler:
+    """Iteration-level continuous batching over one ``DecodeEngine``.
+
+    ``submit(prompt)`` admits a sequence (``QueueFullError`` past
+    ``MXNET_SERVE_DECODE_MAX_QUEUE``) and returns a streaming
+    ``DecodeHandle``. Each scheduler iteration retires finished
+    sequences (EOS / max-new / deadline / per-slot overflow), admits
+    queued ones into free slots (growing the rung when the ladder
+    allows), migrates live slots on rung switches, then advances every
+    slot one token through the rung's pinned program and streams the
+    sampled tokens. Greedy (argmax) sampling.
+    """
+
+    def __init__(self, engine, clock=None, max_queue=None,
+                 default_max_new=None, logger=None):
+        self.engine = engine
+        self._clock = clock if clock is not None else MonotonicClock()
+        self._max_queue = max_queue if max_queue is not None else \
+            _env_int("MXNET_SERVE_DECODE_MAX_QUEUE", 256)
+        self._default_max_new = default_max_new if default_max_new \
+            is not None else _env_int("MXNET_SERVE_DECODE_MAX_NEW", 64)
+        self.logger = logger or log
+        # reentrant: completion/token callbacks run with the scheduler
+        # lock held and may legitimately submit a follow-up sequence
+        self._lock = threading.RLock()
+        self._cond = threading.Condition(self._lock)
+        self._queue = []
+        self._rung = self.engine.ladder.sizes[0]
+        self._slots = [None] * self._rung
+        self._thread = None
+        self._running = False
+        self.iterations = 0
+        self.migrations = 0
+        with _telemetry.span("serve.decode.warmup",
+                             model=self.engine.name):
+            est = self.engine.warmup(self._clock)
+        self.logger.info(
+            "decode %r warmed — slot ladder %s, %d compiles, step est %s",
+            self.engine.name, self.engine.ladder.sizes,
+            self.engine.warmup_compiles,
+            {r: f"{s * 1e3:.2f}ms" for r, s in est.items()})
+        self._gauge("slots").set(self._rung)
+        self._gauge("active").set(0)
+        self._gauge("occupancy").set(0.0)
+        self._gauge("queue.depth").set(0)
+
+    def _gauge(self, key):
+        return _telemetry.gauge(f"serve.decode.{key}",
+                                model=self.engine.name)
+
+    def _counter(self, key):
+        return _telemetry.counter(f"serve.decode.{key}",
+                                  model=self.engine.name)
+
+    # ------------------------------------------------------------ admission
+    def submit(self, prompt, max_new_tokens=None, eos_id=None,
+               deadline_ms=None, trace=None):
+        """Admit one sequence: ``prompt`` is a 1-D int id sequence
+        (1 <= len <= cache capacity). ``max_new_tokens`` caps
+        generation (``MXNET_SERVE_DECODE_MAX_NEW`` default); ``eos_id``
+        retires the sequence when sampled (not emitted);
+        ``deadline_ms`` (relative to now) retires it mid-decode with a
+        partial result and ``finish_reason="deadline"``. Returns the
+        streaming ``DecodeHandle``."""
+        prompt = np.asarray(prompt, np.int64).reshape(-1)
+        if prompt.size < 1:
+            raise MXNetError("empty prompt")
+        if prompt.size > self.engine.capacity:
+            raise MXNetError(
+                f"prompt of {prompt.size} tokens exceeds the decode "
+                f"cache capacity {self.engine.capacity}")
+        max_new = int(max_new_tokens if max_new_tokens is not None
+                      else self._default_max_new)
+        if max_new < 1:
+            raise MXNetError("max_new_tokens must be >= 1")
+        now = self._clock.now()
+        deadline = None if deadline_ms is None \
+            else now + deadline_ms / 1000.0
+        tr = trace
+        if tr is None and _trace.sample():
+            tr = _trace.new_trace(session=True)
+        seq = _Sequence(prompt, max_new, eos_id, now, deadline, trace=tr)
+        if tr is not None:
+            seq.root_sid = _trace.next_span_id()
+            if tr.root is None:
+                tr.root = seq.root_sid
+            if tr.start_s is None:
+                tr.start_s = now
+        with self._cond:
+            if len(self._queue) >= self._max_queue:
+                exc = QueueFullError(
+                    f"decode {self.engine.name!r}: queue depth "
+                    f"{len(self._queue)} at MXNET_SERVE_DECODE_"
+                    f"MAX_QUEUE={self._max_queue}")
+                if tr is not None:
+                    exc.trace_id = tr.trace_id
+                _telemetry.counter("serve.rejected",
+                                   model=self.engine.name).inc()
+                raise exc
+            self._queue.append(seq)
+            depth = len(self._queue)
+            self._cond.notify_all()
+        self._counter("requests").inc()
+        self._gauge("queue.depth").set(depth)
+        return seq.handle
+
+    # ----------------------------------------------------------- scheduling
+    def _active(self):
+        return [s for s in self._slots if s is not None]
+
+    def _finish(self, seq, reason=None, error=None, now=None):
+        """Complete a sequence's handle and free its slot (caller holds
+        the lock)."""
+        seq.finish_reason = reason
+        if seq.slot is not None:
+            self.engine.driver(self._rung).leave(seq.slot)
+            self._slots[seq.slot] = None
+            seq.slot = None
+            self._counter("leaves").inc()
+        if seq.trace is not None:
+            _trace.record(
+                seq.trace, "serve.decode.sequence", seq.arrival,
+                now if now is not None else self._clock.now(),
+                span_id=seq.root_sid, model=self.engine.name,
+                prompt=len(seq.prompt), generated=len(seq.generated),
+                finish=reason if error is None else
+                type(error).__name__)
+            if error is not None:
+                error.trace_id = seq.trace.trace_id
+        self._counter("errors" if error is not None
+                      else "responses").inc()
+        if error is None:
+            _telemetry.histogram(
+                "serve.decode.request.latency.seconds",
+                model=self.engine.name).observe(
+                max(0.0, (now if now is not None else
+                          self._clock.now()) - seq.arrival),
+                exemplar=seq.trace.trace_id
+                if seq.trace is not None else None)
+        seq.handle._complete(error=error, now=now)
+
+    def _switch_rung(self, target):
+        """Migrate live slots into the ``target`` rung pool, compacting
+        them into the lowest rows (caller holds the lock)."""
+        pairs = []
+        new_slots = [None] * target
+        dst = 0
+        for row, seq in enumerate(self._slots):
+            if seq is None:
+                continue
+            pairs.append((row, dst))
+            seq.slot = dst
+            new_slots[dst] = seq
+            dst += 1
+        self.engine.migrate(self._rung, target, pairs)
+        self._rung = target
+        self._slots = new_slots
+        self.migrations += 1
+        self._counter("migrations").inc()
+        self._gauge("slots").set(target)
+
+    def _admit_locked(self, now):
+        """Retire expired queued requests, grow the rung if the backlog
+        wants it, and fill free slots FIFO."""
+        for seq in [s for s in self._queue
+                    if s.deadline is not None and now > s.deadline]:
+            self._queue.remove(seq)
+            self._finish(seq, reason="deadline", now=now)
+        if not self._queue:
+            return
+        want = min(len(self._active()) + len(self._queue),
+                   self.engine.ladder.max)
+        target = self.engine.ladder.bucket_for(max(want, 1))
+        if target is not None and target > self._rung:
+            self._switch_rung(target)
+        drv = self.engine.driver(self._rung)
+        for row in range(self._rung):
+            if self._slots[row] is not None or not self._queue:
+                continue
+            seq = self._queue.pop(0)
+            drv.join(row)
+            seq.slot = row
+            self._slots[row] = seq
+            self._counter("joins").inc()
+            if seq.trace is not None:
+                _trace.record(seq.trace, "serve.decode.queue.wait",
+                              seq.arrival, now, parent=seq.root_sid,
+                              slot=row)
+
+    def _iterate(self):
+        """One scheduling iteration; returns tokens emitted (0 = no
+        work was ready)."""
+        with self._lock:
+            now = self._clock.now()
+            # retirement BEFORE dispatch: deadline-expired sequences
+            # complete with their partial output; a slot whose next
+            # token would overflow its cache slice fails ALONE — the
+            # program was never dispatched for it, batchmates continue
+            for seq in list(self._active()):
+                if seq.deadline is not None and now > seq.deadline:
+                    self._finish(seq, reason="deadline", now=now)
+            for row in self.engine.driver(self._rung).overflowing():
+                seq = self._slots[row]
+                if seq is None:          # retired row still advancing
+                    continue
+                self._finish(seq, error=MXNetError(
+                    f"decode {self.engine.name!r}: sequence {seq.id} "
+                    f"overflowed its KV-cache slice (slot {row}, "
+                    f"capacity {self.engine.capacity}); shorten the "
+                    "prompt/max_new_tokens or re-bind with a larger "
+                    "capacity"), now=now)
+            self._admit_locked(now)
+            active = self._active()
+            if not active:
+                self._gauge("active").set(0)
+                self._gauge("occupancy").set(0.0)
+                return 0
+            # shrink to the smallest rung covering the live set (frees
+            # the larger pool's compute for the next iterations)
+            target = self.engine.ladder.bucket_for(len(active))
+            if target is not None and target < self._rung:
+                self._switch_rung(target)
+            drv = self.engine.driver(self._rung)
+            tokens = np.zeros((self._rung, 1), np.int32)
+            for row, seq in enumerate(self._slots):
+                if seq is not None:
+                    tokens[row, 0] = seq.next_token()
+            active = list(self._active())
+            shared_sid = _trace.next_span_id() \
+                if any(s.trace is not None for s in active) else None
+            t0 = now
+
+        # dispatch outside the lock: submits stay non-blocking while
+        # the program runs (only pump()/the dispatch thread iterates,
+        # so the engine itself needs no second guard)
+        logits = drv.step(tokens).asnumpy()       # (rung, 1, V)
+        sampled = np.argmax(logits[:, 0, :], axis=-1)
+
+        with self._lock:
+            end = self._clock.now()
+            step_s = max(0.0, end - t0)
+            self.engine.note_exec(self._rung, step_s)
+            emitted = 0
+            for seq in active:
+                if seq.slot is None:
+                    continue
+                emit = seq.emitting()
+                seq.fed += 1
+                if seq.trace is not None:
+                    _trace.record(
+                        seq.trace, "serve.decode.step", t0, end,
+                        span_id=shared_sid, parent=seq.root_sid,
+                        rung=self._rung, n_active=len(active),
+                        shared=True, pos=seq.fed - 1)
+                if not emit:
+                    continue                      # still prefilling
+                tok = int(sampled[seq.slot])
+                if seq.eos_id is not None and tok == seq.eos_id:
+                    self._finish(seq, reason="eos", now=end)
+                    continue                # EOS retires, not emitted
+                seq.generated.append(tok)
+                seq.handle._emit(tok, now=end)
+                emitted += 1
+                if len(seq.generated) >= seq.max_new:
+                    self._finish(seq, reason="length", now=end)
+            self.iterations += 1
+            n_active = len(self._active())
+            self._counter("iterations").inc()
+            if emitted:
+                self._counter("tokens").inc(emitted)
+            _telemetry.histogram("serve.decode.step.seconds",
+                                 model=self.engine.name).observe(step_s)
+            self._gauge("active").set(n_active)
+            self._gauge("occupancy").set(n_active / self._rung)
+            self._gauge("queue.depth").set(len(self._queue))
+            compiles = self.engine.compiles_since_warmup()
+            _telemetry.gauge(
+                "serve.program_cache.compiles_since_warmup").set(
+                compiles or 0)
+            _telemetry.flightrec.note(
+                "serve.decode.step", model=self.engine.name,
+                rung=self._rung, active=n_active, emitted=emitted,
+                step_us=int(step_s * 1e6),
+                compiles_since_warmup=compiles)
+        return max(1, emitted)
+
+    # ----------------------------------------------------------- drive modes
+    def _has_work(self):
+        return bool(self._queue) or any(
+            s is not None for s in self._slots)
+
+    def pump(self, max_iterations=None):
+        """Deterministic drive: run scheduler iterations until nothing
+        is active or queued (or ``max_iterations``). The FakeClock
+        path — no thread, no sleeps. Returns iterations run."""
+        done = 0
+        while max_iterations is None or done < max_iterations:
+            with self._lock:
+                if not self._has_work():
+                    break
+            if self._iterate() == 0 and not self._queue:
+                break
+            done += 1
+        return done
+
+    def _loop(self):
+        while True:
+            with self._cond:
+                if not self._running:
+                    return
+                if not self._has_work():
+                    # bounded wait so queued-request deadlines are
+                    # noticed; a submit notifies sooner
+                    self._cond.wait(timeout=0.05)
+                    continue
+            self._iterate()
+
+    def start(self):
+        """Spawn the decode dispatch thread (idempotent)."""
+        with self._cond:
+            if self._running:
+                return self
+            self._running = True
+        self._thread = threading.Thread(target=self._loop,
+                                        name="mxnet-serve-decode",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self, drain=True):
+        """Stop the thread; ``drain`` finishes in-flight and queued
+        sequences first, else they fail with MXNetError."""
+        with self._cond:
+            self._running = False
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=60)
+            self._thread = None
+        if drain:
+            self.pump()
+        else:
+            with self._lock:
+                now = self._clock.now()
+                for seq in list(self._active()) + self._queue:
+                    self._finish(seq, error=MXNetError(
+                        "decode scheduler stopped"), now=now)
+                self._queue = []
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    # ---------------------------------------------------------------- stats
+    def stats(self):
+        """Snapshot for dashboards/bench: slot occupancy, queue depth,
+        token/iteration counters, per-rung step estimates, and the
+        zero-compile gate reading."""
+
+        def c(key):
+            m = _telemetry.get_metric(f"serve.decode.{key}",
+                                      model=self.engine.name)
+            return m.value if m is not None else 0
+
+        with self._lock:
+            n_active = len(self._active())
+            depth = len(self._queue)
+            rung = self._rung
+        h = _telemetry.get_metric("serve.decode.request.latency.seconds",
+                                  model=self.engine.name)
+        its = c("iterations")
+        return {
+            "model": self.engine.name,
+            "ladder": self.engine.ladder.sizes,
+            "rung": rung,
+            "active": n_active,
+            "occupancy": round(n_active / rung, 4) if rung else None,
+            "queue_depth": depth,
+            "requests": c("requests"),
+            "responses": c("responses"),
+            "errors": c("errors"),
+            "iterations": its,
+            "tokens": c("tokens"),
+            "tokens_per_iteration": round(c("tokens") / its, 3)
+            if its else None,
+            "joins": c("joins"),
+            "leaves": c("leaves"),
+            "migrations": c("migrations"),
+            "latency_ms": None if h is None or not h.count else {
+                "p50": round((h.quantile(0.50) or 0) * 1e3, 3),
+                "p99": round((h.quantile(0.99) or 0) * 1e3, 3),
+                "mean": round(h.mean * 1e3, 3)},
+            "exec_est_ms": {r: round(s * 1e3, 3) for r, s in
+                            sorted(self.engine.exec_est.items())},
+            "capacity": self.engine.capacity,
+            "compiles_since_warmup": self.engine.compiles_since_warmup(),
+            "programs_resident": self.engine.programs_resident(),
+        }
+
+
+def serve_decoder(symbol, arg_params, name="decoder", capacity=None,
+                  ladder=None, clock=None, start=True, max_queue=None,
+                  default_max_new=None, context=None, compute_dtype=None,
+                  logger=None):
+    """One-call front end for continuous decode batching:
+    ``serve_decoder(decode_symbol, params).submit([ids...])``.
+
+    ``symbol`` is a per-slot decode graph
+    (``get_decode_symbol(per_slot=True)``); builds the slot-rung
+    ``DecodeEngine``, warms+pins every rung, and (by default) starts
+    the dispatch thread — ``start=False`` + ``pump()`` with a FakeClock
+    is the deterministic test path, mirroring ``serve()``."""
+    engine = DecodeEngine(name, symbol, arg_params, capacity=capacity,
+                          ladder=ladder, context=context,
+                          compute_dtype=compute_dtype, logger=logger)
+    sched = DecodeScheduler(engine, clock=clock, max_queue=max_queue,
+                            default_max_new=default_max_new,
+                            logger=logger)
+    if start:
+        sched.start()
+    return sched

@@ -1,0 +1,649 @@
+"""Continuous decode batching (ISSUE 15, ROADMAP 3b).
+
+Pins the tentpole end to end: the per-slot ``attention_decode``
+lowering ((B, 1) cursor vector, per-slot masked softmax, one-hot slot
+writes), the ``BatchedKVCacheDecoder`` driver (staggered sequences
+reproduce independent ``KVCacheDecoder`` runs, bit-clean slot reuse,
+host-side per-slot overflow), the ``DecodeScheduler`` (FakeClock-
+deterministic staggered arrivals/finishes, streaming delivery,
+EOS/max-new/deadline retirement, an overflowing slot failing alone),
+and the zero-steady-state-compile contract: ``compile_count()`` delta
+== 0 across arbitrary join/leave at every slot rung, including rung
+migrations. Satellites ride along: slot-pooled export artifacts
+(``Predictor.reset_slot``), memplan's slot-pool KV bytes + an ME801
+trip at a toy capacity x slot count, and the telemetry surface.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu.models import transformer as tfm
+from mxnet_tpu.ops.registry import get_op
+from mxnet_tpu.serve import FakeClock, QueueFullError
+
+V, D, L, H, T = 64, 32, 2, 4, 16      # tiny LM; T doubles as capacity
+
+
+@pytest.fixture(scope="module")
+def trained():
+    """One trained parameter set shared by every pool/reference pair."""
+    sym = tfm.get_symbol(vocab_size=V, d_model=D, n_layer=L, n_head=H,
+                         seq_len=8, include_loss=False, max_seq_len=T)
+    mod = mx.mod.Module(sym, label_names=[])
+    mod.bind([("data", (1, 8))], None, for_training=False)
+    mod.init_params(mx.initializer.Xavier(rnd_type="gaussian",
+                                          magnitude=2))
+    args, _ = mod.get_params()
+    return {k: v.asnumpy() for k, v in args.items()}
+
+
+def _args_nd(trained):
+    return {k: mx.nd.array(v) for k, v in trained.items()}
+
+
+def _pooled_module(trained, slots, compute_dtype=None,
+                   pos_embed="rotary", capacity=T):
+    dec = mx.mod.Module(
+        tfm.get_decode_symbol(vocab_size=V, d_model=D, n_layer=L,
+                              n_head=H, capacity=capacity,
+                              per_slot=True, pos_embed=pos_embed,
+                              max_seq_len=capacity),
+        data_names=("data", "pos_ids") if pos_embed == "learned"
+        else ("data",), label_names=[], compute_dtype=compute_dtype)
+    shapes = [("data", (slots, 1))] + (
+        [("pos_ids", (slots, 1))] if pos_embed == "learned" else [])
+    dec.bind(shapes, None, for_training=False)
+    dec.init_params(initializer=None, arg_params=_args_nd(trained),
+                    aux_params={}, allow_missing=True)
+    return dec
+
+
+def _scalar_decoder(trained, compute_dtype=None, pos_embed="rotary",
+                    capacity=T):
+    m = mx.mod.Module(
+        tfm.get_decode_symbol(vocab_size=V, d_model=D, n_layer=L,
+                              n_head=H, capacity=capacity,
+                              pos_embed=pos_embed,
+                              max_seq_len=capacity),
+        data_names=("data", "pos_ids") if pos_embed == "learned"
+        else ("data",), label_names=[], compute_dtype=compute_dtype)
+    shapes = [("data", (1, 1))] + ([("pos_ids", (1,))]
+                                   if pos_embed == "learned" else [])
+    m.bind(shapes, None, for_training=False)
+    m.init_params(initializer=None, arg_params=_args_nd(trained),
+                  aux_params={}, allow_missing=True)
+    return tfm.KVCacheDecoder(m, capacity=capacity, pos_embed=pos_embed)
+
+
+def _ref_logits(trained, tokens, **kw):
+    """Per-step logits of ONE sequence through the scalar decoder."""
+    d = _scalar_decoder(trained, **kw)
+    return [d.step(np.asarray([[t]], np.int32)).asnumpy()[0, 0]
+            for t in tokens]
+
+
+def _ref_greedy(trained, prompt, n, **kw):
+    d = _scalar_decoder(trained, **kw)
+    for t in prompt[:-1]:
+        d.step(np.asarray([[t]], np.int32))
+    cur, out = int(prompt[-1]), []
+    for _ in range(n):
+        lg = d.step(np.asarray([[cur]], np.int32)).asnumpy()[0, 0]
+        cur = int(np.argmax(lg))
+        out.append(cur)
+    return out
+
+
+_sched_seq = [0]
+
+
+def _sched(trained, ladder, clock=None, pos_embed="rotary",
+           compute_dtype=None, capacity=T, name=None, **kw):
+    sym = tfm.get_decode_symbol(vocab_size=V, d_model=D, n_layer=L,
+                                n_head=H, capacity=capacity,
+                                per_slot=True, pos_embed=pos_embed,
+                                max_seq_len=capacity)
+    # unique engine name per scheduler: the serve.decode.* counters are
+    # process-global per model label, so stats() stays per-instance
+    _sched_seq[0] += 1
+    eng = mx.serve.DecodeEngine(name or f"lmdec{_sched_seq[0]}", sym,
+                                _args_nd(trained), capacity=capacity,
+                                ladder=ladder,
+                                compute_dtype=compute_dtype)
+    return mx.serve.DecodeScheduler(
+        eng, clock=clock if clock is not None else FakeClock(), **kw)
+
+
+# ================================================ per-slot op lowering
+def test_per_slot_infer_shape_and_cursor_binding():
+    sym = tfm.get_decode_symbol(vocab_size=V, d_model=D, n_layer=L,
+                                n_head=H, capacity=T, per_slot=True)
+    _args, outs, auxs = sym.infer_shape(data=(4, 1))
+    assert outs == [(4, 1, V)]
+    by_name = dict(zip(sym.list_auxiliary_states(), auxs))
+    cursors = {n: s for n, s in by_name.items()
+               if n.endswith("cache_pos")}
+    assert len(cursors) == L
+    assert set(cursors.values()) == {(4, 1)}       # per-slot vector
+    caches = {n: s for n, s in by_name.items() if n.endswith("k_cache")}
+    assert set(caches.values()) == {(4, H, T, D // H)}
+
+
+def test_per_slot_cursor_binds_int32(trained):
+    dec = _pooled_module(trained, slots=3, compute_dtype="bfloat16")
+    exe = dec._exec_group.executor
+    cursors = [nm for nm in exe.aux_dict if nm.endswith("cache_pos")]
+    assert cursors
+    for nm in cursors:
+        cell = exe.aux_dict[nm]
+        assert cell.asjax().dtype == jnp.int32
+        assert tuple(cell.shape) == (3, 1)
+
+
+def test_per_slot_rejects_multi_token_windows():
+    with pytest.raises(mx.base.MXNetError, match="one token per"):
+        tfm.get_decode_symbol(vocab_size=V, d_model=D, n_layer=1,
+                              n_head=H, per_slot=True, step_len=2)
+    op = get_op("attention_decode")
+    q = jnp.zeros((2, 1, 2, 4))
+    cache = jnp.zeros((2, 1, 8, 4))
+    with pytest.raises(mx.base.MXNetError, match="one token per"):
+        op.forward({"capacity": 8, "per_slot": True}, [q, q, q],
+                   [cache, cache, jnp.zeros((2, 1), jnp.int32)],
+                   False, None)
+
+
+def test_per_slot_eager_overflow_names_slots():
+    op = get_op("attention_decode")
+    q = jnp.zeros((3, 1, 1, 4))
+    cache = jnp.zeros((3, 1, 4, 4))
+    cur = jnp.asarray([[4], [1], [4]], jnp.int32)
+    with pytest.raises(mx.base.MXNetError, match=r"slot\(s\) \[0, 2\]"):
+        op.forward({"capacity": 4, "per_slot": True}, [q, q, q],
+                   [cache, cache, cur], False, None)
+
+
+def test_rope_per_batch_positions():
+    """rope_apply over (B, T) positions == per-row application of the
+    (T,) path at each row's positions."""
+    from mxnet_tpu.ops.nn import rope_apply
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.randn(3, 2, 1, 8).astype(np.float32))
+    pos = jnp.asarray([[5], [0], [11]], jnp.int32)
+    got = rope_apply(x, pos)
+    for b in range(3):
+        ref = rope_apply(x[b:b + 1], pos[b])
+        np.testing.assert_array_equal(np.asarray(got[b:b + 1]),
+                                      np.asarray(ref))
+
+
+# ============================================== batched driver parity
+@pytest.mark.parametrize("compute_dtype,tol", [
+    (None, 2e-6), ("bfloat16", 2e-2)])
+def test_staggered_batched_decode_matches_independent(trained,
+                                                      compute_dtype,
+                                                      tol):
+    """Acceptance (parity gate): SLOTS sequences decoded concurrently
+    with staggered join/leave reproduce per-sequence KVCacheDecoder
+    outputs — f32 ~1e-6, bf16 2e-2 — including a slot reused by a
+    later sequence."""
+    slots = 3
+    dec = _pooled_module(trained, slots, compute_dtype=compute_dtype)
+    drv = tfm.BatchedKVCacheDecoder(dec, capacity=T)
+    rs = np.random.RandomState(1)
+    seqs = [rs.randint(0, V, 6).astype(np.int32) for _ in range(4)]
+    refs = [_ref_logits(trained, s, compute_dtype=compute_dtype)
+            for s in seqs]
+
+    got = {i: [] for i in range(4)}
+    live = {}                       # slot -> [seq_index, next_pos]
+    joins = {0: (0, 0), 2: (1, 1), 3: (2, 2)}   # iteration -> (seq, slot)
+    for it in range(64):
+        if it in joins:
+            si, slot = joins[it]
+            drv.join(slot)
+            live[slot] = [si, 0]
+        if not live:
+            break
+        toks = np.zeros((slots, 1), np.int32)
+        for slot, (si, k) in live.items():
+            toks[slot, 0] = seqs[si][k]
+        out = drv.step(toks).asnumpy()
+        for slot, (si, k) in list(live.items()):
+            got[si].append(out[slot, 0])
+            live[slot][1] += 1
+            if live[slot][1] >= len(seqs[si]):
+                drv.leave(slot)
+                del live[slot]
+                if si == 0:         # slot reuse mid-flight
+                    drv.join(slot)
+                    live[slot] = [3, 0]
+    for i in range(4):
+        assert len(got[i]) == len(seqs[i])
+        for t in range(len(seqs[i])):
+            np.testing.assert_allclose(
+                np.asarray(got[i][t], np.float32),
+                np.asarray(refs[i][t], np.float32),
+                rtol=tol, atol=tol, err_msg=f"seq {i} step {t}")
+
+
+def test_slot_reuse_is_bit_clean(trained):
+    """A sequence decoded in a slot that previously held (and retired)
+    another sequence is BITWISE identical to the same sequence on a
+    fresh pool — the masked softmax zeroes stale positions exactly."""
+    slots = 2
+    rs = np.random.RandomState(2)
+    a = rs.randint(0, V, T).astype(np.int32)        # fills the slot
+    b = rs.randint(0, V, 5).astype(np.int32)
+
+    dec1 = _pooled_module(trained, slots)
+    drv1 = tfm.BatchedKVCacheDecoder(dec1, capacity=T)
+    drv1.join(0)
+    for t in range(T):
+        drv1.step(np.asarray([[a[t]], [0]], np.int32))
+    drv1.leave(0)
+    drv1.join(0)                                    # reuse
+    reused = [drv1.step(np.asarray([[tok], [0]], np.int32))
+              .asnumpy()[0, 0] for tok in b]
+
+    dec2 = _pooled_module(trained, slots)
+    drv2 = tfm.BatchedKVCacheDecoder(dec2, capacity=T)
+    drv2.join(0)
+    fresh = [drv2.step(np.asarray([[tok], [0]], np.int32))
+             .asnumpy()[0, 0] for tok in b]
+    for t in range(len(b)):
+        np.testing.assert_array_equal(reused[t], fresh[t])
+
+
+def test_driver_overflow_raises_before_dispatch(trained):
+    """Satellite: the host-side per-slot overflow check — the pinned
+    program can never see a concrete cursor, so the driver raises
+    BEFORE dispatch, naming the slot, and batchmates are untouched."""
+    dec = _pooled_module(trained, 2)
+    drv = tfm.BatchedKVCacheDecoder(dec, capacity=T)
+    drv.join(0)
+    drv.join(1)
+    toks = np.zeros((2, 1), np.int32)
+    for _ in range(T):
+        drv.step(toks)
+    with pytest.raises(mx.base.MXNetError, match=r"slot\(s\) \[0, 1\]"):
+        drv.step(toks)
+    # retiring the overflowing slot unblocks its batchmate... which
+    # here means retiring 0 still leaves 1 overflowing
+    drv.leave(0)
+    with pytest.raises(mx.base.MXNetError, match=r"slot\(s\) \[1\]"):
+        drv.step(toks)
+    drv.leave(1)
+    drv.join(0)                     # fresh sequence decodes fine
+    out = drv.step(toks)
+    assert out.shape == (2, 1, V)
+
+
+def test_learned_positions_per_slot(trained):
+    """Per-slot pos_ids feed: staggered learned-position decode matches
+    the scalar driver."""
+    sym = tfm.get_symbol(vocab_size=V, d_model=D, n_layer=1, n_head=H,
+                         seq_len=8, include_loss=False,
+                         pos_embed="learned", max_seq_len=T)
+    mod = mx.mod.Module(sym, label_names=[])
+    mod.bind([("data", (1, 8))], None, for_training=False)
+    mod.init_params(mx.initializer.Xavier(rnd_type="gaussian",
+                                          magnitude=2))
+    args, _ = mod.get_params()
+    args = {k: v.asnumpy() for k, v in args.items()}
+
+    def scalar_ref(tokens):
+        m = mx.mod.Module(
+            tfm.get_decode_symbol(vocab_size=V, d_model=D, n_layer=1,
+                                  n_head=H, capacity=T,
+                                  pos_embed="learned", max_seq_len=T),
+            data_names=("data", "pos_ids"), label_names=[])
+        m.bind([("data", (1, 1)), ("pos_ids", (1,))], None,
+               for_training=False)
+        m.init_params(initializer=None,
+                      arg_params={k: mx.nd.array(v)
+                                  for k, v in args.items()},
+                      aux_params={}, allow_missing=True)
+        d = tfm.KVCacheDecoder(m, capacity=T, pos_embed="learned")
+        return [d.step(np.asarray([[t]], np.int32)).asnumpy()[0, 0]
+                for t in tokens]
+
+    dec = mx.mod.Module(
+        tfm.get_decode_symbol(vocab_size=V, d_model=D, n_layer=1,
+                              n_head=H, capacity=T, per_slot=True,
+                              pos_embed="learned", max_seq_len=T),
+        data_names=("data", "pos_ids"), label_names=[])
+    dec.bind([("data", (2, 1)), ("pos_ids", (2, 1))], None,
+             for_training=False)
+    dec.init_params(initializer=None,
+                    arg_params={k: mx.nd.array(v)
+                                for k, v in args.items()},
+                    aux_params={}, allow_missing=True)
+    drv = tfm.BatchedKVCacheDecoder(dec, capacity=T,
+                                    pos_embed="learned")
+    rs = np.random.RandomState(3)
+    s0 = rs.randint(0, V, 5).astype(np.int32)
+    s1 = rs.randint(0, V, 4).astype(np.int32)
+    r0, r1 = scalar_ref(s0), scalar_ref(s1)
+    drv.join(0)
+    got0, got1 = [], []
+    for it in range(7):
+        if it == 2:
+            drv.join(1)             # staggered: slot 1 two steps later
+        toks = np.zeros((2, 1), np.int32)
+        if it < len(s0):
+            toks[0, 0] = s0[it]
+        if 2 <= it < 2 + len(s1):
+            toks[1, 0] = s1[it - 2]
+        out = drv.step(toks).asnumpy()
+        if it < len(s0):
+            got0.append(out[0, 0])
+        if 2 <= it < 2 + len(s1):
+            got1.append(out[1, 0])
+    for got, ref in ((got0, r0), (got1, r1)):
+        for t in range(len(ref)):
+            np.testing.assert_allclose(got[t], ref[t], rtol=1e-5,
+                                       atol=2e-6)
+
+
+# ========================================== scheduler (FakeClock path)
+def test_scheduler_staggered_arrivals_deterministic(trained):
+    """Acceptance: FakeClock-scripted staggered arrivals/finishes —
+    batched greedy outputs match N independent KVCacheDecoder runs,
+    and a rerun of the same script is bit-identical."""
+    rs = np.random.RandomState(4)
+    prompts = [rs.randint(0, V, 2 + i % 3).tolist() for i in range(6)]
+    lens = [3 + i % 4 for i in range(6)]
+
+    def run():
+        clock = FakeClock()
+        sched = _sched(trained, ladder=[1, 2, 4], clock=clock)
+        outs = [None] * 6
+        hs = []
+        for i, p in enumerate(prompts):
+            hs.append(sched.submit(p, max_new_tokens=lens[i]))
+            sched.pump(max_iterations=1 + i % 2)   # staggered progress
+            clock.advance(0.001)
+        sched.pump()
+        for i, h in enumerate(hs):
+            outs[i] = list(h.result(timeout=5))
+            assert h.finish_reason == "length"
+        # stats snapshot NOW: compile_count is process-global, and the
+        # reference decoders bound below compile their own programs
+        return outs, sched.stats()
+
+    outs, st = run()
+    assert st["responses"] == 6 and st["errors"] == 0
+    assert st["compiles_since_warmup"] == 0
+    for i, p in enumerate(prompts):
+        assert outs[i] == _ref_greedy(trained, p, lens[i]), i
+    outs2, st2 = run()
+    assert outs2 == outs                       # deterministic replay
+    assert st2["responses"] == 6 and st2["compiles_since_warmup"] == 0
+
+
+def test_zero_compiles_across_join_leave_every_rung(trained):
+    """Acceptance: compile_count() delta == 0 after warmup across
+    arbitrary join/leave on every slot rung, including the rung
+    migrations the churn forces."""
+    sched = _sched(trained, ladder=[1, 2, 4])
+    assert sched.engine.warmup_compiles >= 3      # one per rung
+    mark = mx.program_cache.compile_count()
+    rs = np.random.RandomState(5)
+    # wave 1: single sequence (rung 1)
+    h = sched.submit(rs.randint(0, V, 2).tolist(), max_new_tokens=2)
+    sched.pump()
+    # wave 2: four at once (grow 1 -> 4), retire down through 2 -> 1
+    hs = [sched.submit(rs.randint(0, V, 2).tolist(),
+                       max_new_tokens=2 + i) for i in range(4)]
+    sched.pump()
+    # wave 3: churn — overlapping arrivals while others finish
+    for i in range(5):
+        hs.append(sched.submit(rs.randint(0, V, 2).tolist(),
+                               max_new_tokens=3))
+        sched.pump(max_iterations=2)
+    sched.pump()
+    for hh in [h] + hs:
+        hh.result(timeout=5)
+    assert mx.program_cache.compile_count() - mark == 0
+    assert sched.engine.compiles_since_warmup() == 0
+    assert sched.stats()["migrations"] >= 2
+    assert sched.engine.programs_resident()
+    # every rung's program stayed pinned
+    assert len(sched.engine.program_keys()) == 3
+
+
+def test_scheduler_overflow_fails_alone(trained):
+    """Satellite: a sequence overflowing its slot's cache slice errors
+    ALONE — its batchmates' outputs are unaffected."""
+    sched = _sched(trained, ladder=[2])
+    rs = np.random.RandomState(6)
+    long_prompt = rs.randint(0, V, T).tolist()     # fills capacity
+    ok_prompt = rs.randint(0, V, 3).tolist()
+    h_over = sched.submit(long_prompt, max_new_tokens=8)
+    h_ok = sched.submit(ok_prompt, max_new_tokens=4)
+    sched.pump()
+    with pytest.raises(mx.base.MXNetError, match="overflow"):
+        h_over.result(timeout=5)
+    st = sched.stats()
+    assert st["errors"] == 1 and st["responses"] == 1
+    assert list(h_ok.result(timeout=5)) == _ref_greedy(
+        trained, ok_prompt, 4)
+
+
+def test_scheduler_streaming_eos_and_limits(trained):
+    """Streaming callbacks fire in order (late subscribers replay);
+    EOS retires without emitting; max_new_tokens caps length; submit
+    validation rejects bad prompts; the queue bound rejects with
+    QueueFullError."""
+    sched = _sched(trained, ladder=[1, 2], max_queue=3)
+    rs = np.random.RandomState(7)
+    prompt = rs.randint(0, V, 3).tolist()
+    ref = _ref_greedy(trained, prompt, 4)
+
+    seen = []
+    h = sched.submit(prompt, max_new_tokens=4)
+    h.add_token_callback(lambda hh, tok, i: seen.append((i, tok)))
+    sched.pump()
+    assert [t for _, t in sorted(seen)] == list(h.result()) == ref
+    assert h.finish_reason == "length" and h.latency is not None
+    late = []
+    h.add_token_callback(lambda hh, tok, i: late.append(tok))
+    assert late == ref                         # replay on registration
+
+    # EOS: use the first greedy token as the eos id -> zero emitted
+    h2 = sched.submit(prompt, max_new_tokens=8, eos_id=ref[0])
+    sched.pump()
+    assert list(h2.result()) == [] and h2.finish_reason == "eos"
+
+    with pytest.raises(mx.base.MXNetError, match="empty"):
+        sched.submit([])
+    with pytest.raises(mx.base.MXNetError, match="capacity"):
+        sched.submit(list(range(T + 1)))
+    with pytest.raises(mx.base.MXNetError, match="max_new_tokens"):
+        sched.submit(prompt, max_new_tokens=0)
+
+    for _ in range(3):
+        sched.submit(prompt, max_new_tokens=2)
+    with pytest.raises(QueueFullError):
+        sched.submit(prompt, max_new_tokens=2)
+    sched.pump()
+
+
+def test_scheduler_deadline_retires_partial(trained):
+    """A deadline passing mid-decode retires the sequence with its
+    partial output and finish_reason='deadline' (the iteration-level
+    analog of the server's deadline flush)."""
+    clock = FakeClock()
+    sched = _sched(trained, ladder=[1], clock=clock)
+    prompt = [1, 2]
+    h = sched.submit(prompt, max_new_tokens=50, deadline_ms=100)
+    sched.pump(max_iterations=4)               # 3 emitted (2 prefill-1)
+    emitted = len(h.tokens)
+    assert emitted >= 1 and not h.done()
+    clock.advance(0.2)                         # past the deadline
+    sched.pump()
+    assert h.done() and h.finish_reason == "deadline"
+    assert list(h.result()) == h.tokens and len(h.tokens) == emitted
+    assert h.missed_deadline()
+    # a queued request past its deadline completes empty, never runs
+    h2 = sched.submit(prompt, max_new_tokens=4, deadline_ms=1)
+    clock.advance(1.0)
+    sched.pump()
+    assert h2.done() and h2.finish_reason == "deadline"
+    assert list(h2.result()) == []
+
+
+def test_scheduler_traces_and_telemetry(trained):
+    """Per-sequence session traces survive batching: each sequence
+    keeps its own tree under its root, iterations share ONE step span
+    id across batchmates, and the occupancy/counter surface is live."""
+    mx.telemetry.reset()
+    from mxnet_tpu.telemetry import trace as _trace
+    _trace.clear()
+    _trace.configure(sample=1)
+    try:
+        sched = _sched(trained, ladder=[2])
+        rs = np.random.RandomState(8)
+        h1 = sched.submit(rs.randint(0, V, 2).tolist(), max_new_tokens=3)
+        h2 = sched.submit(rs.randint(0, V, 2).tolist(), max_new_tokens=3)
+        sched.pump()
+        h1.result(timeout=5), h2.result(timeout=5)
+        assert h1.trace_id and h2.trace_id
+        assert h1.trace_id != h2.trace_id
+        t1 = {s["name"]: s for s in _trace.spans(h1.trace_id)}
+        assert "serve.decode.sequence" in t1
+        s1 = [s for s in _trace.spans(h1.trace_id)
+              if s["name"] == "serve.decode.step"]
+        s2 = [s for s in _trace.spans(h2.trace_id)
+              if s["name"] == "serve.decode.step"]
+        shared = {s["span"] for s in s1} & {s["span"] for s in s2}
+        assert shared, "batchmates share the iteration step span id"
+        st = sched.stats()
+        assert st["tokens"] == 6 and st["joins"] == 2
+        g = mx.telemetry.get_metric("serve.decode.occupancy",
+                                    model=sched.engine.name)
+        assert g is not None
+        kinds = [r.get("kind")
+                 for r in mx.telemetry.flightrec.get_records()]
+        assert "serve.decode.step" in kinds
+    finally:
+        _trace.configure(sample=_trace._env_sample(), reset_ids=False)
+
+
+def test_slot_ladder_env(monkeypatch):
+    monkeypatch.setenv("MXNET_SERVE_DECODE_SLOTS", "2, 8,4")
+    assert mx.serve.default_slot_ladder() == [2, 4, 8]
+    monkeypatch.setenv("MXNET_SERVE_DECODE_SLOTS", "zero")
+    with pytest.raises(mx.base.MXNetError):
+        mx.serve.default_slot_ladder()
+    monkeypatch.delenv("MXNET_SERVE_DECODE_SLOTS")
+    assert mx.serve.default_slot_ladder() == [1, 4, 8]
+
+
+def test_scheduler_thread_drive_mode(trained):
+    """The real-clock dispatch thread serves submits end to end (the
+    production drive mode bench.py's decode_batch row uses)."""
+    sched = _sched(trained, ladder=[1, 2],
+                   clock=mx.serve.MonotonicClock())
+    rs = np.random.RandomState(9)
+    prompts = [rs.randint(0, V, 2).tolist() for _ in range(3)]
+    with sched:
+        hs = [sched.submit(p, max_new_tokens=3) for p in prompts]
+        outs = [list(h.result(timeout=60)) for h in hs]
+    assert sched.stats()["compiles_since_warmup"] == 0
+    for p, o in zip(prompts, outs):
+        assert o == _ref_greedy(trained, p, 3)
+
+
+def test_stop_without_drain_fails_pending(trained):
+    sched = _sched(trained, ladder=[1])
+    h = sched.submit([1, 2], max_new_tokens=4)
+    sched.stop(drain=False)
+    with pytest.raises(mx.base.MXNetError, match="stopped"):
+        h.result(timeout=1)
+
+
+# =========================================== export / memplan satellites
+def test_slot_pooled_export_artifact(trained, tmp_path):
+    """Satellite: a per-slot decode graph exports as a slot-pooled
+    stateful artifact — the Predictor carries the pooled cache, matches
+    the module driver step for step, and Predictor.reset_slot rewinds
+    ONE slot without disturbing its batchmates."""
+    slots = 3
+    path = str(tmp_path / "lm_slots.mxp")
+    mx.export_model(
+        path,
+        tfm.get_decode_symbol(vocab_size=V, d_model=D, n_layer=L,
+                              n_head=H, capacity=T, per_slot=True,
+                              max_seq_len=T),
+        _args_nd(trained), {}, {"data": (slots, 1)},
+        data_dtypes={"data": np.int32})
+    p = mx.Predictor(path)
+    assert p.stateful
+
+    dec = _pooled_module(trained, slots)
+    drv = tfm.BatchedKVCacheDecoder(dec, capacity=T)
+    for s in range(slots):
+        drv.join(s)
+    rs = np.random.RandomState(10)
+    toks = rs.randint(0, V, (slots, 6)).astype(np.int32)
+    for t in range(4):
+        ref = drv.step(toks[:, t:t + 1]).asnumpy()
+        got = p.forward(data=toks[:, t:t + 1])[0].asnumpy()
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=2e-6)
+
+    # reset slot 1 only: slot 1 restarts from position 0 while slots
+    # 0/2 keep their in-flight state — matched by the module driver
+    p.reset_slot(1)
+    drv.leave(1)
+    drv.join(1)
+    step5 = toks[:, 4:5].copy()
+    ref = drv.step(step5).asnumpy()
+    got = p.forward(data=step5)[0].asnumpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=2e-6)
+
+
+def test_memplan_slot_pool_kv_bytes_and_me801(trained):
+    """Satellite: the planner charges the slot-pooled KV cache per
+    rung under attention_decode — slots x layers x 2 caches + the
+    (slots, 1) int32 cursor — and ME801 trips at a toy capacity x slot
+    count."""
+    from mxnet_tpu.analysis import memplan
+    slots, cap = 8, 32
+    sym = tfm.get_decode_symbol(vocab_size=V, d_model=D, n_layer=L,
+                                n_head=H, capacity=cap, per_slot=True,
+                                max_seq_len=cap)
+    plan = memplan.plan_symbol(sym, {"data": (slots, 1)}, policy="none",
+                               for_training=False)
+    expect = L * (2 * slots * H * cap * (D // H) * 4 + slots * 1 * 4)
+    assert plan["kv_cache_bytes"] == expect
+    assert plan["per_op_bytes"].get("attention_decode") == expect
+    assert plan["aux_bytes"] >= expect
+    # the pool scales linearly with the slot rung
+    plan1 = memplan.plan_symbol(
+        tfm.get_decode_symbol(vocab_size=V, d_model=D, n_layer=L,
+                              n_head=H, capacity=cap, per_slot=True,
+                              max_seq_len=cap),
+        {"data": (1, 1)}, policy="none", for_training=False)
+    assert plan["kv_cache_bytes"] == slots * plan1["kv_cache_bytes"]
+    # ME801 at a toy capacity x slot count
+    found = memplan.plan_findings(plan, capacity_bytes=expect // 2)
+    assert any(d.rule == "ME801" for d in found)
+
+
+def test_scalar_decode_unchanged(trained):
+    """Regression: the scalar (single-session) decode path is
+    untouched — same cursor shape, same outputs as ever."""
+    sym = tfm.get_decode_symbol(vocab_size=V, d_model=D, n_layer=L,
+                                n_head=H, capacity=T)
+    _args, _outs, auxs = sym.infer_shape(data=(2, 1))
+    by_name = dict(zip(sym.list_auxiliary_states(), auxs))
+    assert {s for n, s in by_name.items()
+            if n.endswith("cache_pos")} == {(1,)}
+    d = _scalar_decoder(trained)
+    out = d.step(np.asarray([[1]], np.int32))
+    assert out.shape == (1, 1, V)

@@ -93,6 +93,10 @@ def _check_corpus():
          lambda: _models.transformer.get_decode_symbol(
              vocab_size=64, d_model=32, n_layer=1, n_head=2, capacity=16),
          {"data": (4, 1)}),
+        ("models/transformer_decode_slots",
+         lambda: _models.transformer.get_decode_symbol(
+             vocab_size=64, d_model=32, n_layer=1, n_head=2, capacity=16,
+             per_slot=True), {"data": (4, 1)}),
     ]
 
     def _dcgan(which):

@@ -56,6 +56,9 @@ VARIANT_PATHS = [
     (("lm", "train_tokens_per_sec"), "up"),
     (("lm", "decode_tokens_per_sec"), "up"),
     (("lm", "max_context"), "up"),
+    (("decode_batch", "slots1_tokens_per_sec"), "up"),
+    (("decode_batch", "slots8_tokens_per_sec"), "up"),
+    (("decode_batch", "speedup_8v1"), "up"),
     (("spmd", "spmd_vs_kvstore"), "up"),
     (("ckpt", "exposed_ratio"), "down"),
 ]
