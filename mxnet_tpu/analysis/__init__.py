@@ -36,11 +36,11 @@ from .passes import (AnalysisContext, PASSES, run_passes, lint_symbol,
                      lint_executor, lint_module, lint_json,
                      validate_executor, validate_module, resolve_mode,
                      attr_cache_stable)
-from . import envaudit, kernelcheck, memplan, precision
+from . import envaudit, kernelcheck, memplan, metricaudit, precision
 
 __all__ = ["Diagnostic", "Report", "RULES", "SEVERITIES",
            "AnalysisContext", "PASSES", "run_passes", "lint_symbol",
            "lint_executor", "lint_module", "lint_json",
            "validate_executor", "validate_module", "resolve_mode",
            "attr_cache_stable", "envaudit", "kernelcheck", "memplan",
-           "precision"]
+           "metricaudit", "precision"]

@@ -375,6 +375,10 @@ class KVStoreDistSync(KVStore):
         self._watch_stop = None          # dead-node watcher (on_dead_node)
         self._watch_thread = None
         self._closed = False
+        # fleet identity: ring records / trace spans / ops endpoint now
+        # resolve their rank from this live store (weakref'd — a closed
+        # store stops answering)
+        _telemetry.fleet.register_kvstore(self)
         self._sched = BucketScheduler(
             self._allreduce_flat, self._apply_reduced,
             lambda: int(os.environ.get("MXNET_KVSTORE_BUCKET_BYTES",

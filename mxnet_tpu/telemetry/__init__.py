@@ -48,6 +48,7 @@ from .metrics import (Counter, Gauge, Histogram, counter, gauge, histogram,
 from .sentinel import NanSentinel, AnomalyError
 from . import core
 from . import metrics
+from . import fleet
 from . import flightrec
 from . import memory
 from . import mfu
@@ -57,13 +58,16 @@ from . import stepattr
 from . import chrome_trace
 from . import prometheus
 from . import jsonl
+from . import opsd
+from .opsd import serve_ops
 
 __all__ = ["span", "event", "record_event", "enable", "disable", "enabled",
            "clear", "get_spans", "get_events", "null_span", "wrap_dispatch",
            "Counter", "Gauge", "Histogram", "counter", "gauge", "histogram",
            "get_metric", "snapshot", "reset", "NanSentinel", "AnomalyError",
-           "flightrec", "memory", "mfu", "sentinel", "trace", "stepattr",
-           "chrome_trace", "prometheus", "jsonl"]
+           "fleet", "flightrec", "memory", "mfu", "sentinel", "trace",
+           "stepattr", "chrome_trace", "prometheus", "jsonl", "opsd",
+           "serve_ops"]
 
 
 def snapshot():
@@ -73,6 +77,7 @@ def snapshot():
     snap["spans"] = len(core.get_spans())
     snap["events"] = len(core.get_events())
     snap["memory"] = memory.snapshot()
+    snap["rank"] = fleet.rank()
     return snap
 
 
@@ -88,3 +93,7 @@ def reset():
     trace.clear()
     stepattr.reset()
     memory.reset_peak()
+
+
+# arm the live ops endpoint when the env asks for one (no-op otherwise)
+opsd.maybe_serve_from_env()

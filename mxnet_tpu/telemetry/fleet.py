@@ -1,0 +1,365 @@
+"""Fleet-level telemetry: rank identity, versioned snapshots, merging.
+
+Every other telemetry surface stops at the process boundary; this module
+is the cross-rank layer the replica-serving and multihost bets sit on.
+It answers three questions:
+
+* **Who am I?** — ``rank()`` / ``host()`` resolve this process' fleet
+  identity: an explicit ``configure(rank=...)`` override first, then the
+  ``MXNET_FLEET_RANK`` env var, then the rank of a live distributed
+  kvstore (registered via ``register_kvstore()`` when one is created),
+  then the launcher's ``DMLC_WORKER_ID``, else 0. ``tagged()`` says
+  whether any of those sources is active — single-process runs stay
+  untagged so their ring records and trace spans are byte-identical to
+  the pre-fleet format.
+* **What happened here?** — ``snapshot()`` serializes the *full*
+  metrics registry (counters, gauges, histograms with bucket bounds,
+  cumulative counts and exemplars — which covers breaker ``*.state``
+  gauges and ``faults.*`` counters, since those are plain registry
+  series) to a versioned, JSON-pure dict stamped with rank/host/
+  generation identity.
+* **What happened everywhere?** — ``merge(snapshots)`` combines N
+  per-rank snapshots losslessly: counters sum (exactly — they are
+  integers or float adds of the same stream), gauges keep per-rank
+  values plus min/max/mean, histograms merge bucket-wise so a fleet
+  ``quantile(q)`` computed by ``hist_quantile()`` is within one bucket
+  width of the pooled observation stream's quantile. Exemplars survive
+  by re-landing on the merged bounds; on a per-bucket collision the
+  highest-valued (slowest) exemplar wins.
+
+``prometheus.render(fleet=merge(...))`` turns a merged snapshot into
+one exposition text with ``rank`` labels on every sample.
+
+Everything here is stdlib + the sibling ``metrics`` module: no jax, no
+kvstore import (the kvstore registers *itself*, via a weakref, so
+telemetry stays import-light and the dispatch path is untouched).
+"""
+from __future__ import annotations
+
+import bisect
+import os
+import socket
+import weakref
+
+from . import metrics as _metrics
+
+__all__ = ["SCHEMA_VERSION", "rank", "host", "num_workers", "generation",
+           "tagged", "configure", "register_kvstore", "kvstore",
+           "snapshot", "merge", "merge_histogram_records",
+           "hist_quantile", "hist_exemplar"]
+
+SCHEMA_VERSION = 1
+
+_forced_rank = None
+_forced_nworkers = None
+_kv_ref = None          # weakref to the live dist kvstore, if any
+_host = None
+
+
+def configure(rank=None, num_workers=None):
+    """Explicit identity override (tests, embedders). ``configure()``
+    with no arguments clears back to env/kvstore resolution."""
+    global _forced_rank, _forced_nworkers
+    _forced_rank = None if rank is None else int(rank)
+    _forced_nworkers = None if num_workers is None else int(num_workers)
+
+
+def register_kvstore(kv):
+    """Called by distributed kvstores on creation; held by weakref so a
+    closed/collected store never pins or misleads."""
+    global _kv_ref
+    _kv_ref = weakref.ref(kv)
+
+
+def _live_kvstore():
+    kv = _kv_ref() if _kv_ref is not None else None
+    if kv is None or getattr(kv, "_closed", False):
+        return None
+    return kv
+
+
+def kvstore():
+    """The registered live distributed kvstore, or None."""
+    return _live_kvstore()
+
+
+def _env_int(name):
+    v = os.environ.get(name)
+    if not v:
+        return None
+    try:
+        return int(v)
+    except ValueError:
+        return None
+
+
+def rank():
+    """This process' fleet rank (see module docstring for precedence)."""
+    if _forced_rank is not None:
+        return _forced_rank
+    r = _env_int("MXNET_FLEET_RANK")
+    if r is not None:
+        return r
+    kv = _live_kvstore()
+    if kv is not None:
+        try:
+            return int(kv.rank)
+        except Exception:
+            pass
+    r = _env_int("DMLC_WORKER_ID")
+    return r if r is not None else 0
+
+
+def num_workers():
+    """Fleet size, best effort (1 when standalone)."""
+    if _forced_nworkers is not None:
+        return _forced_nworkers
+    kv = _live_kvstore()
+    if kv is not None:
+        try:
+            return int(kv.num_workers)
+        except Exception:
+            pass
+    n = _env_int("DMLC_NUM_WORKER")
+    return n if n is not None else 1
+
+
+def host():
+    global _host
+    if _host is None:
+        _host = socket.gethostname()
+    return _host
+
+
+def generation():
+    """Recovery re-exec generation (0 on a first life)."""
+    g = _env_int("MXNET_RECOVERY_GENERATION")
+    return g if g is not None else 0
+
+
+def tagged():
+    """True when this process has a real fleet identity — any rank
+    source is active. Untagged (single-process) runs keep ring records
+    and trace spans free of rank keys."""
+    if _forced_rank is not None:
+        return True
+    if os.environ.get("MXNET_FLEET_RANK"):
+        return True
+    if _live_kvstore() is not None:
+        return True
+    return bool(os.environ.get("DMLC_WORKER_ID"))
+
+
+# ------------------------------------------------------------- snapshot
+def _series_sort_key(m):
+    return (m.name, m.labels)
+
+
+def snapshot():
+    """The full registry + identity as a versioned, JSON-pure dict.
+
+    Schema v1::
+
+        {"schema": 1, "rank": int, "host": str, "pid": int,
+         "num_workers": int, "generation": int,
+         "counters":   [{"name", "labels": {...}, "value"}, ...],
+         "gauges":     [{"name", "labels": {...}, "value"}, ...],
+         "histograms": [{"name", "labels": {...},
+                         "buckets": [le, ...],          # sorted bounds
+                         "bucket_counts": [c, ...],      # cumulative
+                         "count", "sum", "min", "max",
+                         "exemplars": {"<bucket idx>": [id, value]}},
+                        ...]}
+
+    Lists are sorted by (name, labels) so two snapshots of the same
+    registry state serialize identically.
+    """
+    counters, gauges, hists = [], [], []
+    for m in sorted(_metrics.all_metrics(), key=_series_sort_key):
+        labels = dict(m.labels)
+        if isinstance(m, _metrics.Counter):
+            counters.append({"name": m.name, "labels": labels,
+                             "value": m.value})
+        elif isinstance(m, _metrics.Gauge):
+            gauges.append({"name": m.name, "labels": labels,
+                           "value": m.value})
+        elif isinstance(m, _metrics.Histogram):
+            hists.append({
+                "name": m.name, "labels": labels,
+                "buckets": list(m.buckets),
+                "bucket_counts": list(m.bucket_counts),
+                "count": m.count, "sum": m.sum,
+                "min": m.min, "max": m.max,
+                "exemplars": {str(i): [ex[0], ex[1]]
+                              for i, ex in sorted(m.exemplars.items())}})
+    return {"schema": SCHEMA_VERSION, "rank": rank(), "host": host(),
+            "pid": os.getpid(), "num_workers": num_workers(),
+            "generation": generation(),
+            "counters": counters, "gauges": gauges, "histograms": hists}
+
+
+# ---------------------------------------------------------------- merge
+def _series_key(name, labels):
+    if not labels:
+        return name
+    inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+    return f"{name}{{{inner}}}"
+
+
+def merge_histogram_records(recs):
+    """Bucket-wise merge of schema-v1 histogram records.
+
+    Identical bucket bounds (the normal case — histograms of one name
+    share their constructor buckets) merge exactly: cumulative counts
+    sum element-wise, so every quantile of the merged record is within
+    one bucket width of the pooled stream's quantile. Mismatched bounds
+    merge conservatively onto the union of bounds via the cumulative
+    step function (each record contributes its largest known cumulative
+    count at or below the bound). Exemplars re-land on the merged
+    bounds by their recorded value; per-bucket collisions keep the
+    highest value (deterministic tie-break on the exemplar id).
+    """
+    recs = [r for r in recs if r]
+    if not recs:
+        return None
+    bounds = recs[0]["buckets"]
+    if all(r["buckets"] == bounds for r in recs[1:]):
+        bounds = list(bounds)
+        counts = [0] * len(bounds)
+        for r in recs:
+            for i, c in enumerate(r["bucket_counts"]):
+                counts[i] += c
+    else:
+        bounds = sorted({le for r in recs for le in r["buckets"]})
+
+        def cum_at(r, le):
+            i = bisect.bisect_right(r["buckets"], le)
+            return r["bucket_counts"][i - 1] if i else 0
+
+        counts = [sum(cum_at(r, le) for r in recs) for le in bounds]
+    mins = [r["min"] for r in recs if r["min"] is not None]
+    maxs = [r["max"] for r in recs if r["max"] is not None]
+    exemplars = {}
+    for r in recs:
+        for _idx, (eid, v) in sorted(r.get("exemplars", {}).items()):
+            landed = bisect.bisect_left(bounds, v)
+            key = str(landed)
+            if key not in exemplars or (v, eid) > tuple(exemplars[key][::-1]):
+                exemplars[key] = [eid, v]
+    return {"buckets": bounds, "bucket_counts": counts,
+            "count": sum(r["count"] for r in recs),
+            "sum": sum(r["sum"] for r in recs),
+            "min": min(mins) if mins else None,
+            "max": max(maxs) if maxs else None,
+            "exemplars": {k: exemplars[k] for k in sorted(exemplars)}}
+
+
+def hist_quantile(rec, q):
+    """``Histogram.quantile`` replayed over a (merged) histogram record
+    — linear interpolation over cumulative buckets, clamped to the
+    recorded max above the last bound. None while empty."""
+    if not rec or not rec["count"]:
+        return None
+    target = q * rec["count"]
+    prev_le, prev_cum = 0.0, 0
+    for le, cum in zip(rec["buckets"], rec["bucket_counts"]):
+        if cum >= target:
+            if cum == prev_cum:
+                return le
+            frac = (target - prev_cum) / (cum - prev_cum)
+            return prev_le + frac * (le - prev_le)
+        prev_le, prev_cum = le, cum
+    return rec["max"]
+
+
+def hist_exemplar(rec, q):
+    """The exemplar id nearest the q-quantile of a (merged) record: the
+    quantile's bucket's exemplar, else the closest bucket above (at
+    least as slow), else the slowest seen. None when none apply."""
+    if not rec or not rec["count"] or not rec.get("exemplars"):
+        return None
+    exemplars = {int(k): v for k, v in rec["exemplars"].items()}
+    target = q * rec["count"]
+    idx = len(rec["buckets"])
+    for i, cum in enumerate(rec["bucket_counts"]):
+        if cum >= target:
+            idx = i
+            break
+    for i in range(idx, len(rec["buckets"]) + 1):
+        if i in exemplars:
+            return exemplars[i][0]
+    return exemplars[max(exemplars)][0]
+
+
+def merge(snapshots):
+    """N per-rank ``snapshot()`` dicts -> one fleet dict.
+
+    * counters: exact sum plus per-rank values;
+    * gauges: per-rank values plus min/max/mean across ranks;
+    * histograms: a bucket-wise ``merged`` record (see
+      ``merge_histogram_records``) plus the per-rank records.
+
+    Series keys render Prometheus-style (``name{k="v"}``). Two
+    snapshots claiming the same rank merge rank-wise too (counters
+    sum; gauges/histogram records last-wins). Output ordering is fully
+    deterministic: sorted ranks, sorted series keys.
+    """
+    snaps = sorted((s for s in snapshots if s),
+                   key=lambda s: (int(s.get("rank", 0)), s.get("host", "")))
+    for s in snaps:
+        if s.get("schema") != SCHEMA_VERSION:
+            raise ValueError(
+                f"fleet snapshot schema {s.get('schema')!r} != "
+                f"{SCHEMA_VERSION} (rank {s.get('rank')!r})")
+    out = {"schema": SCHEMA_VERSION,
+           "ranks": sorted({int(s.get("rank", 0)) for s in snaps}),
+           "hosts": {}, "generations": {},
+           "num_workers": max([int(s.get("num_workers", 1))
+                               for s in snaps] or [1]),
+           "counters": {}, "gauges": {}, "histograms": {}}
+    for s in snaps:
+        r = str(int(s.get("rank", 0)))
+        out["hosts"][r] = s.get("host", "")
+        out["generations"][r] = int(s.get("generation", 0))
+
+    counters, gauges, hists = {}, {}, {}
+    for s in snaps:
+        r = str(int(s.get("rank", 0)))
+        for rec in s.get("counters", ()):
+            key = _series_key(rec["name"], rec["labels"])
+            slot = counters.setdefault(
+                key, {"name": rec["name"], "labels": dict(rec["labels"]),
+                      "by_rank": {}})
+            slot["by_rank"][r] = slot["by_rank"].get(r, 0) + rec["value"]
+        for rec in s.get("gauges", ()):
+            key = _series_key(rec["name"], rec["labels"])
+            slot = gauges.setdefault(
+                key, {"name": rec["name"], "labels": dict(rec["labels"]),
+                      "by_rank": {}})
+            slot["by_rank"][r] = rec["value"]
+        for rec in s.get("histograms", ()):
+            key = _series_key(rec["name"], rec["labels"])
+            slot = hists.setdefault(
+                key, {"name": rec["name"], "labels": dict(rec["labels"]),
+                      "by_rank": {}})
+            slot["by_rank"][r] = {k: rec[k] for k in
+                                  ("buckets", "bucket_counts", "count",
+                                   "sum", "min", "max", "exemplars")}
+
+    for key in sorted(counters):
+        slot = counters[key]
+        slot["total"] = sum(slot["by_rank"].values())
+        out["counters"][key] = slot
+    for key in sorted(gauges):
+        slot = gauges[key]
+        vals = list(slot["by_rank"].values())
+        slot["min"] = min(vals)
+        slot["max"] = max(vals)
+        slot["mean"] = sum(vals) / len(vals)
+        out["gauges"][key] = slot
+    for key in sorted(hists):
+        slot = hists[key]
+        slot["merged"] = merge_histogram_records(
+            [slot["by_rank"][r] for r in sorted(slot["by_rank"], key=int)])
+        out["histograms"][key] = slot
+    return out

@@ -41,6 +41,8 @@ import threading
 import time
 import traceback
 
+from . import fleet as _fleet
+
 __all__ = ["note", "note_event", "note_span", "enabled", "configure",
            "get_records", "clear", "on_crash", "dump_crash"]
 
@@ -84,24 +86,31 @@ def note(kind, **info):
     """
     if not _enabled:
         return
-    _ring.append({"kind": kind, "ts_us": time.perf_counter_ns() // 1000,
-                  **info})
+    rec = {"kind": kind, "ts_us": time.perf_counter_ns() // 1000, **info}
+    if _fleet.tagged():
+        rec["rank"] = _fleet.rank()
+    _ring.append(rec)
 
 
 def note_event(rec):
     """Mirror a core.event() record (already timestamped) into the ring."""
     if not _enabled:
         return
-    _ring.append({"kind": rec["kind"], "ts_us": rec["ts_us"],
-                  **rec["payload"]})
+    out = {"kind": rec["kind"], "ts_us": rec["ts_us"], **rec["payload"]}
+    if _fleet.tagged():
+        out["rank"] = _fleet.rank()
+    _ring.append(out)
 
 
 def note_span(span):
     """Mirror a finished core.Span into the ring."""
     if not _enabled:
         return
-    _ring.append({"kind": "span", "name": span.name, "ts_us": span.ts,
-                  "dur_us": span.dur, **span.args})
+    rec = {"kind": "span", "name": span.name, "ts_us": span.ts,
+           "dur_us": span.dur, **span.args}
+    if _fleet.tagged():
+        rec["rank"] = _fleet.rank()
+    _ring.append(rec)
 
 
 def get_records():
@@ -169,6 +178,8 @@ def _build_report(exc, where, extra):
         "time": time.strftime("%Y-%m-%dT%H:%M:%S%z"),
         "where": where,
         "pid": os.getpid(),
+        "rank": _fleet.rank(),
+        "host": _fleet.host(),
         "argv": list(sys.argv),
         "ring": get_records(),
     }

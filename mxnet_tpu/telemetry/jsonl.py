@@ -16,18 +16,33 @@ from __future__ import annotations
 
 import json
 import os
+import time
 
 from . import core
+from . import fleet as _fleet
 from . import metrics as _metrics
+from . import stepattr as _stepattr
 from . import trace as _trace
 
 __all__ = ["lines", "render", "dump"]
 
 
-def lines(spans=True, events=True, metrics=True, traces=True):
-    """Yield the log as dicts, events first (they are what log consumers
-    key on), then spans in completion order, then the trace plane's
-    request span-tree records, then the registry."""
+def lines(spans=True, events=True, metrics=True, traces=True, steps=True,
+          meta=True):
+    """Yield the log as dicts: a ``meta`` identity line first (rank /
+    host / generation — tools/fleetstat.py keys per-rank dumps on it),
+    then events (they are what log consumers key on), then spans in
+    completion order, then the trace plane's request span-tree records,
+    then step-attribution records, then the registry."""
+    if meta:
+        yield {"type": "meta", "schema": _fleet.SCHEMA_VERSION,
+               "rank": _fleet.rank(), "host": _fleet.host(),
+               "pid": os.getpid(), "num_workers": _fleet.num_workers(),
+               "generation": _fleet.generation(),
+               # wall clock of the dump: cross-rank staleness is only
+               # comparable on wall time (ts_us is per-process
+               # perf_counter time with an arbitrary epoch)
+               "time_unix": time.time()}
     if events:
         for e in core.get_events():
             rec = {"type": "event", "kind": e["kind"], "ts_us": e["ts_us"]}
@@ -44,6 +59,11 @@ def lines(spans=True, events=True, metrics=True, traces=True):
         # request span trees from exactly these records
         for rec in _trace.spans():
             yield {"type": "trace", **rec}
+    if steps:
+        # per-step wall + phase attribution — fleetstat's straggler
+        # table reads exactly these records
+        for rec in _stepattr.records():
+            yield {"type": "step", **rec}
     if metrics:
         for m in _metrics.all_metrics():
             labels = dict(m.labels)

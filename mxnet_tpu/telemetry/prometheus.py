@@ -45,7 +45,7 @@ def _fmt(v):
     return str(int(f)) if f == int(f) else repr(f)
 
 
-def render(openmetrics=False):
+def render(openmetrics=False, fleet=None):
     """The registry as exposition text.
 
     ``openmetrics=True`` additionally emits histogram exemplars in the
@@ -54,7 +54,15 @@ def render(openmetrics=False):
     Prometheus 0.0.4 text) is byte-identical to the pre-exemplar
     format: scrapers and ``parse()`` never see the annotation unless
     asked for (the trace-plane golden-output test pins this).
+
+    ``fleet=`` takes a merged fleet snapshot (``telemetry.fleet.merge``)
+    and renders *that* instead of the live registry: one exposition
+    text with a ``rank`` label on every sample, per-rank and lossless
+    (sums/quantiles are the scraper's aggregation to make). The default
+    single-process rendering is untouched.
     """
+    if fleet is not None:
+        return _render_fleet(fleet, openmetrics)
     lines = []
     seen_types = set()
 
@@ -94,6 +102,69 @@ def render(openmetrics=False):
                 f"{_ex(len(m.buckets))}")
             lines.append(f"{fam}_sum{_labels_text(m.labels)} {_fmt(m.sum)}")
             lines.append(f"{fam}_count{_labels_text(m.labels)} {m.count}")
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+def _render_fleet(fleet, openmetrics=False):
+    """A merged fleet snapshot as one exposition text with rank labels."""
+    lines = []
+    seen_types = set()
+
+    def header(fam, typ):
+        if fam not in seen_types:
+            lines.append(f"# TYPE {fam} {typ}")
+            seen_types.add(fam)
+
+    def ranked(slot):
+        labels = sorted(slot["labels"].items())
+        for r in sorted(slot["by_rank"], key=int):
+            yield r, labels + [("rank", r)]
+
+    for key in sorted(fleet.get("counters", {})):
+        slot = fleet["counters"][key]
+        fam = sanitize(slot["name"]) + "_total"
+        header(fam, "counter")
+        for r, labels in ranked(slot):
+            lines.append(
+                f"{fam}{_labels_text(labels)} "
+                f"{_fmt(slot['by_rank'][r])}")
+    for key in sorted(fleet.get("gauges", {})):
+        slot = fleet["gauges"][key]
+        fam = sanitize(slot["name"])
+        header(fam, "gauge")
+        for r, labels in ranked(slot):
+            lines.append(
+                f"{fam}{_labels_text(labels)} "
+                f"{_fmt(slot['by_rank'][r])}")
+    for key in sorted(fleet.get("histograms", {})):
+        slot = fleet["histograms"][key]
+        fam = sanitize(slot["name"])
+        header(fam, "histogram")
+        for r, labels in ranked(slot):
+            rec = slot["by_rank"][r]
+            exemplars = {int(i): ex
+                         for i, ex in rec.get("exemplars", {}).items()}
+
+            def _ex(idx):
+                ex = exemplars.get(idx) if openmetrics else None
+                if ex is None:
+                    return ""
+                return f' # {{trace_id="{ex[0]}"}} {_fmt(ex[1])}'
+
+            for i, (le, c) in enumerate(zip(rec["buckets"],
+                                            rec["bucket_counts"])):
+                lines.append(
+                    f"{fam}_bucket"
+                    f"{_labels_text(labels, [('le', _fmt(le))])} {c}"
+                    f"{_ex(i)}")
+            lines.append(
+                f"{fam}_bucket"
+                f"{_labels_text(labels, [('le', '+Inf')])} "
+                f"{rec['count']}{_ex(len(rec['buckets']))}")
+            lines.append(
+                f"{fam}_sum{_labels_text(labels)} {_fmt(rec['sum'])}")
+            lines.append(
+                f"{fam}_count{_labels_text(labels)} {rec['count']}")
     return "\n".join(lines) + ("\n" if lines else "")
 
 

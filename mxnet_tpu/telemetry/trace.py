@@ -46,6 +46,7 @@ import itertools
 import os
 import threading
 
+from . import fleet as _fleet
 from . import flightrec as _flightrec
 
 __all__ = ["Trace", "new_trace", "next_span_id", "record", "sample",
@@ -138,6 +139,8 @@ def record(trace, name, start_s, end_s, span_id=None, parent=None,
            "parent": parent, "name": name,
            "ts_us": round(start_s * 1e6),
            "dur_us": max(0, round((end_s - start_s) * 1e6)), **args}
+    if _fleet.tagged():
+        rec["rank"] = _fleet.rank()
     _buf.append(rec)
     if isinstance(trace, Trace) and parent is None and trace.root is None:
         trace.root = sid

@@ -22,8 +22,19 @@ still trains its own shard from its own checkpoints.
 Markers on stdout (the test greps these): ``CHAOS_START``,
 ``CHAOS_DEAD_SEEN`` (detection), ``CHAOS_DONE`` (final metrics).
 Exit codes: 0 success, 17 the planned kill, anything else a bug.
+
+Fleet forensics feed: with ``CHAOS_TELEMETRY_DIR`` set, telemetry is
+enabled and every batch overwrites this worker's per-rank jsonl dump
+(``rank<stable>_gen<g>.jsonl``) — so the doomed worker leaves a dump
+frozen at its kill point, survivors' generation-0 dumps capture the
+``dead_node`` detection, and their generation-1 dumps show the re-formed
+run. ``CHAOS_DONE`` also writes a ``fleet<stable>.json`` registry
+snapshot (taken while the kvstore is still live, so rank identity comes
+from the dist plane) for the cross-rank merge assertions. The test
+feeds all of it to ``tools/fleetstat.py``.
 """
 import hashlib
+import json
 import os
 import sys
 import time
@@ -57,6 +68,14 @@ def main():
     num_epoch = int(os.environ.get("CHAOS_EPOCHS", "4"))
     gen = mx.checkpoint.recovery_generation()
 
+    telemetry_dir = os.environ.get("CHAOS_TELEMETRY_DIR", "")
+    jsonl_path = None
+    if telemetry_dir:
+        os.makedirs(telemetry_dir, exist_ok=True)
+        jsonl_path = os.path.join(telemetry_dir,
+                                  f"rank{stable_id}_gen{gen}.jsonl")
+        mx.telemetry.enable()
+
     kv = mx.kv.create("dist_sync")
     rank, nworker = kv.rank, kv.num_workers
     print(f"CHAOS_START stable={stable_id} rank={rank} "
@@ -82,6 +101,11 @@ def main():
     pause_s = float(os.environ.get("CHAOS_PAUSE_S", "0"))
 
     def cb(p):
+        # dump BEFORE the kill check: the doomed worker's last dump is
+        # its state at the kill batch — the stale file whose wall-clock
+        # gap the fleet report surfaces as the death timeline
+        if jsonl_path:
+            mx.telemetry.jsonl.dump(jsonl_path)
         if kill_tuple is not None and (p.epoch, p.nbatch) == kill_tuple:
             if stable_id == kill_id:
                 print(f"CHAOS_KILL stable={stable_id} at "
@@ -111,6 +135,10 @@ def main():
     except mx.checkpoint.DeadWorkerError as e:
         print(f"CHAOS_DEAD_SEEN stable={stable_id} rank={rank} "
               f"dead={e.dead_ranks} clean={e.clean}", flush=True)
+        if jsonl_path:
+            # the detection-time dump: carries the dead_node event and
+            # the recovery.* counters this survivor recorded
+            mx.telemetry.jsonl.dump(jsonl_path)
         mgr.close()                 # last commits must land before exec
         kv.close(abort=True)        # drop grads staged at the dead peer
         mx.checkpoint.reexec_survivor(e.dead_ranks)
@@ -122,6 +150,13 @@ def main():
         digest.update(np.ascontiguousarray(
             np.round(args[nm].asnumpy().astype(np.float64), 5)).tobytes())
     acc = mod.score(it, "acc")[0][1]
+    if jsonl_path:
+        mx.telemetry.jsonl.dump(jsonl_path)
+        # registry snapshot while the kvstore is still live — rank
+        # identity must come from the dist plane, not the env fallback
+        with open(os.path.join(telemetry_dir,
+                               f"fleet{stable_id}.json"), "w") as f:
+            json.dump(mx.telemetry.fleet.snapshot(), f)
     mgr.close()
     kv.close()
     print(f"CHAOS_DONE stable={stable_id} rank={rank} gen={gen} "

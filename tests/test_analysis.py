@@ -688,6 +688,14 @@ def test_mxlint_env_audit_gate(capsys):
     assert "0 undocumented, 0 dead rows" in out
 
 
+def test_mxlint_metric_audit_gate(capsys):
+    """The metric-catalog CI gate: zero drift both ways, exit 0."""
+    main = _mxlint_main()
+    assert main(["--metric-audit"]) == 0
+    out = capsys.readouterr().out
+    assert "0 undocumented, 0 dead rows" in out
+
+
 def test_mxlint_memory_plan_cli(capsys):
     """--memory-plan renders a per-policy plan; a tiny capacity trips
     ME801 (exit 1), headroom trips ME802 (info, exit 0)."""
@@ -824,6 +832,66 @@ def test_env_audit_detects_drift(tmp_path):
     result = envaudit.audit(str(tmp_path))
     assert result["undocumented"] == ["MXNET_SECRET_KNOB"]
     assert result["dead"] == ["MXNET_GHOST_KNOB"]
+
+
+# --------------------------------------- metric-name doc-sync audit
+def test_metric_audit_in_sync():
+    """Recorded metric names and the docs/telemetry.md Metric catalog
+    match both ways (the CI gate behind ``mxlint --metric-audit``)."""
+    import os
+    from mxnet_tpu.analysis import metricaudit
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    result = metricaudit.audit(repo)
+    assert not result["undocumented"], result["undocumented"]
+    assert not result["dead"], result["dead"]
+    # sanity: the scan really sees the surface — exact names, the
+    # hist= keyword feed, and f-string/metric_prefix families
+    assert "module.fit.batches" in result["code_names"]
+    assert "executor.compile.seconds" in result["code_names"]
+    assert any(p.startswith("serve.decode.")
+               for p in result["code_prefixes"])
+    assert "step.phase." in result["doc_prefixes"]
+
+
+def test_metric_audit_detects_drift(tmp_path):
+    """A synthetic tree with an unrecorded catalog row and an
+    uncatalogued recording, in every resolution mode the scanner
+    claims: literal, concatenation, hist= keyword, f-string family."""
+    from mxnet_tpu.analysis import metricaudit
+    pkg = tmp_path / "mxnet_tpu"
+    pkg.mkdir()
+    (pkg / "m.py").write_text(
+        "from telemetry import counter, gauge, histogram, span\n"
+        "def f(key):\n"
+        "    counter('secret.items').inc()\n"
+        "    name = 'secret.step'\n"
+        "    histogram(name + '.seconds').observe(1)\n"
+        "    gauge(f'family.{key}').set(1)\n"
+        "    span('x', hist='hooked.seconds')\n")
+    docs = tmp_path / "docs"
+    docs.mkdir()
+    (docs / "telemetry.md").write_text(
+        "# Telemetry\n\n"
+        "prose mentioning `unrelated.metric` outside the catalog\n\n"
+        "## Metric catalog\n\n"
+        "| `secret.items` | counter | things |\n"
+        "| `ghost.metric` | gauge | recorded by nothing |\n\n"
+        "## Next section\n")
+    result = metricaudit.audit(str(tmp_path))
+    assert result["undocumented"] == ["hooked.seconds", "secret.step.seconds",
+                                      "family.*"]
+    assert result["dead"] == ["ghost.metric"]
+    assert result["ok"] is False
+
+    # adding the missing rows (a `<placeholder>` row covers the
+    # f-string family) and dropping the dead one restores sync
+    (docs / "telemetry.md").write_text(
+        "## Metric catalog\n\n"
+        "| `secret.items` | counter | things |\n"
+        "| `secret.step.seconds` | histogram | step wall |\n"
+        "| `hooked.seconds` | histogram | span feed |\n"
+        "| `family.<key>` | gauge | per-key family |\n")
+    assert metricaudit.audit(str(tmp_path))["ok"] is True
 
 
 # --------------------------------------- cost-metadata consistency

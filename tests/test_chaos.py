@@ -19,6 +19,7 @@ import pytest
 pytestmark = pytest.mark.slow
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOLS = os.path.join(ROOT, "tools")
 
 
 def _free_port():
@@ -52,6 +53,9 @@ def _spawn(n, tmp_path, kill_id=None, kill_at="1:3", epochs=4,
             "CHAOS_STABLE_ID": str(sid),
             "CHAOS_EPOCHS": str(epochs),
             "MXNET_CKPT_DIR": str(tmp_path / f"ck{sid}"),
+            # every worker feeds the fleet-forensics plane: per-rank
+            # jsonl dumps + a final registry snapshot
+            "CHAOS_TELEMETRY_DIR": str(tmp_path / "fleet"),
         })
         if kill_id is not None:
             env["CHAOS_KILL_STABLE_ID"] = str(kill_id)
@@ -128,6 +132,33 @@ def test_chaos_kill_one_worker_survivors_recover(tmp_path):
     # dist_sync lockstep held through the resume: identical params
     assert done[0]["params"] == done[1]["params"], done
 
+    # ---- merged fleet report over the per-rank dumps ----------------
+    # the dead rank's frozen dump, the survivors' detection dumps and
+    # their re-formed generation-1 dumps merge into one story
+    sys.path.insert(0, TOOLS)
+    import fleetstat
+    fleet_dir = tmp_path / "fleet"
+    dumps = sorted(str(p) for p in fleet_dir.glob("rank*.jsonl"))
+    assert len(dumps) >= 5, dumps  # r0/r1 at gen 0+1, r2 frozen at gen 0
+    ranks = [fleetstat.load_file(p) for p in dumps]
+    doc = fleetstat.build(ranks, gap_seconds=10.0)
+
+    # the dead rank's last dump wall-clock sits a detection + re-exec +
+    # resumed-training gap behind the survivors' — a heartbeat gap
+    assert "2" in doc["dead"]["stale_ranks"], doc["dead"]
+    # survivors reported the death (dead_node events in their gen-0
+    # detection dumps) and finished at the bumped generation
+    assert "2" in doc["dead"]["reported_dead"], doc["dead"]
+    assert doc["generations"] == {"0": 1, "1": 1, "2": 0}, \
+        doc["generations"]
+    # recovery happened cleanly: survivors' metrics agree post-resume,
+    # so the correctness-divergence scan must stay quiet
+    assert doc["divergence"] == [], doc["divergence"]
+    # the report is deterministic: same inputs, byte-identical text
+    doc2 = fleetstat.build([fleetstat.load_file(p) for p in dumps],
+                           gap_seconds=10.0)
+    assert fleetstat.render(doc) == fleetstat.render(doc2)
+
     # loss-curve continuity: final accuracy within tolerance of an
     # uninterrupted 3-worker run of the same task
     _, ref_outs, ref_errs = _spawn(3, tmp_path / "ref", kill_id=None)
@@ -136,3 +167,31 @@ def test_chaos_kill_one_worker_survivors_recover(tmp_path):
     ref_acc = sum(r["acc"] for r in ref.values()) / len(ref)
     for sid, row in done.items():
         assert abs(row["acc"] - ref_acc) < 0.15, (row, ref_acc)
+
+    # ---- fleet merge over a real multi-process dist run -------------
+    # the reference run's per-rank registry snapshots (taken while the
+    # kvstore was live) must merge losslessly: exact counter sums,
+    # histogram counts preserved bucket-wise, ranks from the dist plane
+    import json as _json
+    from mxnet_tpu.telemetry import fleet
+    ref_fleet = tmp_path / "ref" / "fleet"
+    snaps = []
+    for sid in (0, 1, 2):
+        with open(ref_fleet / f"fleet{sid}.json") as f:
+            snaps.append(_json.load(f))
+    merged = fleet.merge(snaps)
+    assert merged["ranks"] == [0, 1, 2], merged["ranks"]
+    batches = [slot for slot in merged["counters"].values()
+               if slot["name"] == "module.fit.batches"]
+    assert batches, sorted(merged["counters"])
+    slot = batches[0]
+    assert slot["total"] == sum(slot["by_rank"].values())
+    # 4 epochs x 8 batches per worker, nothing lost in the merge
+    assert sorted(slot["by_rank"]) == ["0", "1", "2"]
+    assert all(v == 32 for v in slot["by_rank"].values()), slot
+    hists = [slot for slot in merged["histograms"].values()
+             if slot["name"] == "module.fit.batch.seconds"]
+    assert hists, sorted(merged["histograms"])
+    h = hists[0]
+    assert h["merged"]["count"] == \
+        sum(r["count"] for r in h["by_rank"].values()) == 96, h["merged"]

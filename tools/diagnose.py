@@ -337,6 +337,90 @@ def _serving_section(counters, gauge_triples, hist_entries):
     return lines
 
 
+def _decode_section(counters, gauge_triples, hist_entries):
+    """Continuous-decode engine health (mxnet_tpu/serve/decode): slot
+    occupancy, queue depth, join/leave/migration churn, per-iteration
+    step time and request latency — rendered only when serve.decode.*
+    series exist. Both the crash path and the jsonl path call this."""
+    gauges = {}
+    for name, labels, val in gauge_triples:
+        if name.startswith("serve.decode."):
+            gauges[(name[len("serve.decode."):],
+                    labels.get("model", "?"))] = val
+    ctr = {}
+    for series, val in (counters or {}).items():
+        name, labelstr = _strip_labels(series)
+        if not name.startswith("serve.decode."):
+            continue
+        model = "?"
+        for part in labelstr.split(","):
+            if part.strip().startswith("model="):
+                model = part.partition("=")[2].strip().strip('"')
+        key = (name[len("serve.decode."):], model)
+        ctr[key] = ctr.get(key, 0) + val
+    hists = {}
+    for name, labels, rec in hist_entries:
+        if name.startswith("serve.decode."):
+            hists[(name[len("serve.decode."):],
+                   labels.get("model", "?"))] = rec
+    if not (gauges or ctr or hists):
+        return []
+
+    models = sorted({m for (_k, m) in
+                     list(gauges) + list(ctr) + list(hists)})
+    lines = ["decode engine (continuous batching):"]
+    for m in models:
+        slots = gauges.get(("slots", m))
+        active = gauges.get(("active", m))
+        occ = gauges.get(("occupancy", m))
+        head = f"  model {m}:"
+        if slots is not None:
+            head += f" {active or 0:.0f}/{slots:.0f} slots active"
+            if occ is not None:
+                head += f" ({occ:.0%} occupancy)"
+        depth = gauges.get(("queue.depth", m))
+        if depth is not None:
+            head += f", queue depth {depth:.0f}"
+        lines.append(head)
+        reqs = ctr.get(("requests", m), 0)
+        resps = ctr.get(("responses", m), 0)
+        errors = ctr.get(("errors", m), 0)
+        if reqs or resps:
+            lines.append(f"    sessions: {reqs:.0f} admitted, "
+                         f"{resps:.0f} completed"
+                         + (f", {errors:.0f} ERRORS" if errors else ""))
+        iters = ctr.get(("iterations", m), 0)
+        tokens = ctr.get(("tokens", m), 0)
+        if iters:
+            lines.append(f"    iterations: {iters:.0f} "
+                         f"({tokens:.0f} tokens, "
+                         f"{tokens / iters:.2f} tokens/iteration)")
+        joins = ctr.get(("joins", m), 0)
+        leaves = ctr.get(("leaves", m), 0)
+        migrations = ctr.get(("migrations", m), 0)
+        if joins or leaves or migrations:
+            lines.append(f"    churn: {joins:.0f} joins, "
+                         f"{leaves:.0f} leaves, "
+                         f"{migrations:.0f} rung migration(s)")
+        step = hists.get(("step.seconds", m))
+        if step and step.get("count"):
+            p50 = _hist_quantile(step, 0.50)
+            p99 = _hist_quantile(step, 0.99)
+            lines.append(
+                f"    step time: p50 {_fmt_us((p50 or 0) * 1e6)} / "
+                f"p99 {_fmt_us((p99 or 0) * 1e6)} over "
+                f"{step['count']} iterations")
+        lat = hists.get(("request.latency.seconds", m))
+        if lat and lat.get("count"):
+            p50 = _hist_quantile(lat, 0.50)
+            p99 = _hist_quantile(lat, 0.99)
+            lines.append(
+                f"    session latency: p50 {_fmt_us((p50 or 0) * 1e6)} / "
+                f"p99 {_fmt_us((p99 or 0) * 1e6)} over "
+                f"{lat['count']} sessions")
+    return lines
+
+
 def _checkpoint_section(counters, gauge_triples, hist_entries, records):
     """Checkpoint / recovery health (mxnet_tpu/checkpoint): snapshot
     cadence + commit count, exposed stall vs background write cost,
@@ -643,6 +727,10 @@ def render_crash(report, top=10):
         metrics.get("counters") or {},
         _gauge_triples_from_series(metrics.get("gauges") or {}),
         _hist_entries_from_series(metrics.get("histograms") or {}))
+    out += _decode_section(
+        metrics.get("counters") or {},
+        _gauge_triples_from_series(metrics.get("gauges") or {}),
+        _hist_entries_from_series(metrics.get("histograms") or {}))
     out += _checkpoint_section(
         metrics.get("counters") or {},
         _gauge_triples_from_series(metrics.get("gauges") or {}),
@@ -779,6 +867,11 @@ def render_jsonl(lines, top=10):
          for (name, labels), val in gauges.items()],
         [e for e in events if e.get("kind") == "memplan.plan"])
     out += _serving_section(
+        counters,
+        [(name, dict(labels), val)
+         for (name, labels), val in gauges.items()],
+        hist_entries)
+    out += _decode_section(
         counters,
         [(name, dict(labels), val)
          for (name, labels), val in gauges.items()],

@@ -13,7 +13,8 @@ precision flow), pointed at artifacts instead of live bindings:
   symbol and the two ``examples/dcgan.py`` graphs under their canonical
   input shapes (expecting zero findings), runs the precision audit over
   the bundled models at bf16 AND int8-quantized tiers, plans resnet20's
-  memory at two remat policies, and runs the env-var doc-sync audit;
+  memory at two remat policies, and runs the env-var and metric-name
+  doc-sync audits;
 * ``--precision-audit`` — the QT7xx precision-flow pass alone over the
   bundled models, at f32 and simulated-bf16 compute plus the int8
   quant-rewritten variants (``--compute-dtype`` overrides);
@@ -24,6 +25,8 @@ precision flow), pointed at artifacts instead of live bindings:
   HBM table entry, when known);
 * ``--env-audit`` — MXNET_* env reads vs docs/env_var.md rows, both
   directions (the CI doc-sync gate);
+* ``--metric-audit`` — recorded metric names vs the docs/telemetry.md
+  Metric catalog, both directions (the registry's doc-sync gate);
 * ``--mfu-audit`` — registry cost-metadata coverage, plus the memory
   planner's per-op byte sizes over resnet20 (the shared byte table the
   roofline and the planner both consume).
@@ -250,6 +253,39 @@ def run_env_audit(out, as_json=False, quiet=False):
     return findings
 
 
+def run_metric_audit(out, as_json=False, quiet=False):
+    """Metric-name doc-sync audit; error findings on drift."""
+    from mxnet_tpu.analysis import metricaudit
+
+    result = metricaudit.audit(_REPO_ROOT)
+    findings = []
+    for name in result["undocumented"]:
+        findings.append({"target": "metric-audit", "rule": "XX001",
+                         "severity": "error", "node": name,
+                         "hint": "add a docs/telemetry.md catalog row",
+                         "message": f"{name} is recorded by mxnet_tpu/ "
+                                    "but has no docs/telemetry.md "
+                                    "Metric catalog row"})
+    for name in result["dead"]:
+        findings.append({"target": "metric-audit", "rule": "XX001",
+                         "severity": "error", "node": name,
+                         "hint": "drop the dead row (or record the "
+                                 "metric)",
+                         "message": f"{name} is catalogued in "
+                                    "docs/telemetry.md but nothing in "
+                                    "mxnet_tpu/ records it"})
+    if as_json:
+        json.dump(result, out, indent=2)
+        print(file=out)
+    elif not quiet:
+        print(f"  metric-audit: {len(result['code_names'])} metrics + "
+              f"{len(result['code_prefixes'])} families recorded, "
+              f"{len(result['doc_names'])} catalogued, "
+              f"{len(result['undocumented'])} undocumented, "
+              f"{len(result['dead'])} dead rows", file=out)
+    return findings
+
+
 def run_check(out, as_json=False):
     """Lint the bundled corpus; returns the merged findings list."""
     from mxnet_tpu import analysis
@@ -345,6 +381,11 @@ def main(argv=None):
     p.add_argument("--env-audit", action="store_true", dest="env_audit",
                    help="audit MXNET_* env reads against "
                         "docs/env_var.md (both directions)")
+    p.add_argument("--metric-audit", action="store_true",
+                   dest="metric_audit",
+                   help="audit recorded metric names against the "
+                        "docs/telemetry.md Metric catalog (both "
+                        "directions)")
     p.add_argument("--json", action="store_true", dest="as_json",
                    help="emit findings as one JSON document")
     p.add_argument("--strict", action="store_true",
@@ -399,7 +440,7 @@ def main(argv=None):
         return 1 if partial else 0
 
     audit_mode = args.precision_audit or args.memory_plan or \
-        args.env_audit
+        args.env_audit or args.metric_audit
     if not args.check and not args.paths and not audit_mode:
         p.print_usage(file=sys.stderr)
         print("mxlint: nothing to lint (pass symbol JSON paths or "
@@ -425,6 +466,7 @@ def main(argv=None):
                 "resnet20", out, policies=("none", "dots"),
                 capacity_gb=args.capacity_gb, quiet=args.as_json)
             findings += run_env_audit(out, quiet=args.as_json)
+            findings += run_metric_audit(out, quiet=args.as_json)
         if args.precision_audit:
             dtypes = tuple(
                 d.strip() for d in
@@ -447,6 +489,8 @@ def main(argv=None):
                 return 2
         if args.env_audit:
             findings += run_env_audit(out, as_json=args.as_json)
+        if args.metric_audit:
+            findings += run_metric_audit(out, as_json=args.as_json)
         for path in args.paths:
             findings += lint_path(path, shapes, out, as_json=args.as_json)
     except FileNotFoundError as e:

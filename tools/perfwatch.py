@@ -20,6 +20,11 @@ perf-shaped regression fail CI the way a lint rule does:
   their own acceptance gates — boolean ``gate_*``/``*_pass`` flags and
   ``gate_pct`` thresholds over ``*_overhead_pct`` measurements — are
   re-checked, so a stale-but-failing recorded result cannot sit green.
+* **fleet reports** (``--fleet``, repeatable): ``tools/fleetstat.py
+  --json`` documents appended as runs of their own — the fleet-health
+  series (``step.wall.p99_over_p50``, the cross-rank straggler
+  spread) is tracked like any bench series, so a widening p99/p50 gap
+  across sessions regresses CI the same way a throughput drop does.
 
 Exit codes: 0 = no regressions, 1 = regressions/gate failures (each
 listed on stdout), 2 = unusable input. ``--check`` runs the repo
@@ -29,6 +34,7 @@ Usage::
 
     python tools/perfwatch.py --check
     python tools/perfwatch.py --check --payload new_bench_stdout.json
+    python tools/perfwatch.py --check --fleet fleet_r01.json --fleet fleet_r02.json
     python tools/perfwatch.py --history /path/to/BENCH_dir --tolerance 0.1
     python tools/perfwatch.py --json --check
 
@@ -70,6 +76,13 @@ TOLERANCES = {
 }
 
 _ROUND_RE = re.compile(r"r(\d+)")
+
+# fleetstat --json series and whether bigger is better; anything the
+# report grows later defaults to "down" (fleet-health series are
+# spread/imbalance shaped: smaller is healthier)
+FLEET_SERIES_DIRECTIONS = {
+    "step.wall.p99_over_p50": "down",
+}
 
 
 # --------------------------------------------------------------- loading
@@ -143,6 +156,33 @@ def load_history(history_dir=None, extra_payloads=()):
         if payload is None:
             raise ValueError(f"--payload {p}: not a bench payload")
         runs.append((os.path.basename(p), extract_series(payload)))
+    return runs
+
+
+def load_fleet_reports(paths):
+    """[(tag, {series: (value, dir)})] from fleetstat --json reports —
+    one run per report, series prefixed ``fleet.`` so they never
+    collide with bench metric names."""
+    runs = []
+    for p in paths:
+        try:
+            with open(p) as f:
+                doc = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            raise ValueError(f"--fleet {p}: not a fleetstat --json "
+                             "report")
+        series = doc.get("series") if isinstance(doc, dict) else None
+        if not isinstance(series, dict):
+            raise ValueError(f"--fleet {p}: no series block (produce "
+                             "it with tools/fleetstat.py --json)")
+        out = {}
+        for name in sorted(series):
+            val = series[name]
+            if isinstance(val, bool) or not isinstance(val, (int, float)):
+                continue
+            direction = FLEET_SERIES_DIRECTIONS.get(name, "down")
+            out[f"fleet.{name}"] = (float(val), direction)
+        runs.append((os.path.basename(p), out))
     return runs
 
 
@@ -238,9 +278,11 @@ def check_result_gates(results_dir=None):
 
 # ------------------------------------------------------------------ main
 def run(history_dir=None, results_dir=None, payloads=(),
-        tolerance=DEFAULT_TOLERANCE, check_gates=True):
+        tolerance=DEFAULT_TOLERANCE, check_gates=True,
+        fleet_reports=()):
     """The whole watchdog pass; returns (regressions, n_series, n_runs)."""
     runs = load_history(history_dir, payloads)
+    runs += load_fleet_reports(fleet_reports)
     regressions = compare_history(runs, tolerance)
     if check_gates:
         regressions += check_result_gates(results_dir)
@@ -259,6 +301,11 @@ def main(argv=None):
                    metavar="FILE",
                    help="bench payload(s) to append as the newest "
                         "run(s) — a bench.py stdout capture works")
+    p.add_argument("--fleet", action="append", default=[],
+                   metavar="FILE",
+                   help="fleetstat --json report(s) to append as runs "
+                        "— tracks the fleet-health series "
+                        "(step.wall.p99_over_p50) across sessions")
     p.add_argument("--history", default=None, metavar="DIR",
                    help="directory holding BENCH_r*.json "
                         "(default: the repo root)")
@@ -278,7 +325,7 @@ def main(argv=None):
         regressions, n_series, n_runs = run(
             history_dir=args.history, results_dir=args.results,
             payloads=args.payload, tolerance=args.tolerance,
-            check_gates=not args.no_gates)
+            check_gates=not args.no_gates, fleet_reports=args.fleet)
     except ValueError as exc:
         print(f"perfwatch: {exc}", file=sys.stderr)
         return 2
