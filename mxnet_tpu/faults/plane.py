@@ -84,6 +84,7 @@ KNOWN_POINTS = (
     "io.decode",           # prefetch/decode of one batch
     "serve.dispatch",      # serving batch dispatch
     "serve.admit",         # serving admission
+    "train.health.triage", # health-plane escalation ladder entry
 )
 
 _ERROR_KINDS = {

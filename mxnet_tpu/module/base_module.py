@@ -148,6 +148,7 @@ class BaseModule:
             return self._fit_epoch_scan(epoch, train_data, eval_metric,
                                         batch_end_callback, K, skip=skip)
         sa = _telemetry.stepattr
+        nbatch = -1
         for nbatch, batch in enumerate(
                 self._iter_with_data_wait(train_data)):
             if nbatch < skip:
@@ -182,6 +183,7 @@ class BaseModule:
                     "module.fit.batch", epoch=epoch, nbatch=nbatch,
                     dur_us=(time.perf_counter_ns() - t0) // 1000,
                     batch_size=getattr(train_data, "batch_size", 0))
+            self._health_tick(epoch, nbatch)
             self.update_metric(eval_metric, batch.label)
             sa.step_end()
             if monitor is not None:
@@ -192,6 +194,8 @@ class BaseModule:
                                     eval_metric=eval_metric,
                                     locals=locals()))
             self._ckpt_tick(epoch, nbatch)
+        # epoch end: release the one-boundary health-stat lag
+        self._health_tick(epoch, nbatch + 1, steps=0, flush=True)
 
     def _fit_epoch_scan(self, epoch, train_data, eval_metric,
                         batch_end_callback, K, skip=0):
@@ -229,6 +233,7 @@ class BaseModule:
             self._note_batch(epoch, nbatch, batch_span.dur or
                              (time.perf_counter_ns() - t0) // 1000,
                              batch_size)
+            self._health_tick(epoch, nbatch)
             self.update_metric(eval_metric, batch.label)
             sa.step_end()
             if batch_end_callback is not None:
@@ -262,6 +267,10 @@ class BaseModule:
                 epoch=epoch, nbatch=nbatch, steps=steps)
             with win_span:
                 self._run_scan_window(window)
+            # stash this window's K-stacked health stats and drain the
+            # previous window's (one-boundary lag: its device work is
+            # done, so the read never stalls the async scan dispatch)
+            self._health_tick(epoch, nbatch, steps)
             dur_us = win_span.dur or (time.perf_counter_ns() - t0) // 1000
             for _ in range(steps):
                 labels = self._advance_scan_batch()
@@ -296,6 +305,8 @@ class BaseModule:
                     pending = []
         for b in pending:                   # partial tail window
             run_single(b)
+        # epoch end: release the one-boundary health-stat lag
+        self._health_tick(epoch, nbatch, steps=0, flush=True)
 
     def _note_mfu(self, dur_us):
         """Model-level MFU gauge per batch: attributed train FLOPs over
@@ -323,6 +334,36 @@ class BaseModule:
             _telemetry.flightrec.note(
                 "module.fit.batch", epoch=epoch, nbatch=nbatch,
                 dur_us=dur_us, batch_size=batch_size)
+
+    def _health_tick(self, epoch, nbatch, steps=1, flush=False):
+        """Batch/window-boundary hook of both fit loops: drain the
+        in-program health stats (armed runs only) into the process
+        HealthMonitor and run the triage ladder on any rule firings.
+
+        Stats drain only once the device reports them finished
+        (take_health's readiness gate — an eager read would serialize
+        the host behind in-flight windows), so a window's observations
+        may arrive several boundaries late, each carrying the cursor of
+        the batches that produced it. The escalation cursor stays
+        ``(epoch, nbatch + steps)`` — the batches behind it all ran, so
+        a resume from an emergency commit is always safe. ``flush``
+        drains the whole backlog — the epoch-end call, where the loop
+        syncs anyway."""
+        hp = _telemetry.health
+        eg = getattr(self, "_exec_group", None)
+        if eg is None or not hp.armed():
+            return
+        take = getattr(eg, "take_health", None)
+        if take is None:
+            return
+        stats_list = take(cursor=(epoch, nbatch), flush=flush)
+        if not stats_list:
+            return
+        for stats, ep, nb in stats_list:
+            for f in hp.observe(stats, epoch=ep, nbatch=nb):
+                hp.escalate(f["rule"], f["policy"], f["message"],
+                            module=self, epoch=epoch,
+                            nbatch=nbatch + steps)
 
     # --------------------------------------------- checkpointing / recovery
     def _ckpt_tick(self, epoch, nbatch):
@@ -476,7 +517,7 @@ class BaseModule:
             begin_epoch=0, num_epoch=None, validation_metric=None,
             monitor=None, steps_per_dispatch=None, zero_stage=None,
             spmd=None, mesh=None, checkpoint=None, resume=None,
-            elastic=None, remat=None):
+            elastic=None, remat=None, health=None):
         """The training loop (reference base_module.py:368-507 contract).
 
         ``steps_per_dispatch`` (default ``MXNET_STEPS_PER_DISPATCH``,
@@ -536,6 +577,17 @@ class BaseModule:
         keys the program cache and the kernel-tier autotune cache, and
         extends donation to the step's eval-only intermediates (rng
         chain, fully-refreshed aux).
+
+        ``health`` (default ``MXNET_TRAIN_HEALTH``): True arms the
+        training-health plane — the fused/K-step program computes grad/
+        param norms, update-ratio, per-head loss and a non-finite flag
+        in-program, a ``telemetry.health.HealthMonitor`` (pass one as
+        the value to customize detectors) runs divergence rules over
+        them at batch/window boundaries, and firings run the triage
+        ladder (``warn``/``snapshot``/``checkpoint``/``raise`` —
+        ``MXNET_TRAIN_HEALTH_POLICY``), with emergency commits through
+        this fit's checkpoint manager (docs/telemetry.md). Arming keys
+        the program cache and pins process-wide, like ``remat``.
         """
         from ..initializer import Uniform
         from ..checkpoint import CheckpointManager, DeadWorkerError
@@ -556,6 +608,16 @@ class BaseModule:
             # pin process-wide so the kernel-tier autotune key sees the
             # same policy token the program-cache key carries
             self._remat = _remat_mod.set_active(remat)
+        if health is not None:
+            # arm (or install a caller-built monitor into) the training-
+            # health plane BEFORE the fused program is built below —
+            # arming is part of the program-cache key
+            if isinstance(health, _telemetry.health.HealthMonitor):
+                _telemetry.health.install(health)
+            elif isinstance(health, dict):
+                _telemetry.health.configure(armed=True, **health)
+            else:
+                _telemetry.health.configure(armed=bool(health))
 
         # checkpointing arrangement: explicit kwarg > MXNET_CKPT_DIR env
         # (the env path only engages on modules with an executor group —
@@ -611,6 +673,9 @@ class BaseModule:
             elif getattr(train_data, "_stack_k", 1) > 1:
                 train_data.stack_windows(1)     # scan unavailable: unstack
 
+        # triage binding: checkpoint-level health/sentinel escalations
+        # land their emergency commit through THIS fit's manager
+        _telemetry.health.bind_triage(self)
         try:
             self._fit_epochs(train_data, eval_data, eval_metric,
                              validation_metric, epoch_end_callback,
@@ -631,6 +696,7 @@ class BaseModule:
             _telemetry.flightrec.on_crash(exc, where="module.fit")
             raise
         finally:
+            _telemetry.health.release_triage()
             if mgr_owned:
                 mgr.close()
 

@@ -51,6 +51,40 @@ from ..parallel.spmd import SpmdPlan
 __all__ = ["DataParallelExecutorGroup"]
 
 
+def _ssq32(vals):
+    """Traced global sum of squares over an iterable of arrays (f32
+    accumulator). Shared by the per-step health stats and the
+    window-boundary param-stat readings."""
+    acc = jnp.zeros((), jnp.float32)
+    for v in vals:
+        v32 = v.astype(jnp.float32)
+        acc = acc + jnp.sum(v32 * v32)
+    return acc
+
+
+def _window_param_stats(health, w_start, w_end, watched):
+    """Add the window-level param stats to a health dict (traced).
+
+    param-norm and update-ratio need a full pass over the param set;
+    done per step that pass reads the donated/carried buffers and
+    defeats XLA's in-place update (measured: an O(params) copy every
+    step). Both are therefore computed ONCE per dispatch window — over
+    the window's closing params and the window-wide delta — where the
+    single amortised read is in the noise. On the K=1 path a window IS
+    one step, so the reference per-step semantics are unchanged there;
+    on the scan path update_ratio reports the K-step window ratio.
+    """
+    wsq = _ssq32(w_end[nm] for nm in watched)
+    dsq = _ssq32(w_end[nm] - w_start[nm].astype(w_end[nm].dtype)
+                 for nm in watched)
+    pn = jnp.sqrt(wsq)
+    out = dict(health)
+    out["param_norm"] = pn
+    out["update_ratio"] = jnp.sqrt(dsq) / jnp.maximum(
+        pn, jnp.float32(1e-12))
+    return out
+
+
 class DataParallelExecutorGroup:
     def __init__(self, symbol, contexts, workload, data_shapes, label_shapes,
                  param_names, for_training, inputs_need_grad,
@@ -388,6 +422,17 @@ class DataParallelExecutorGroup:
 
         remat_policy = self._remat_policy
 
+        # training-health plane (telemetry/health.py): when armed, the
+        # program computes a small fixed stat set INSIDE the jitted
+        # step — per-step grad global L2 norm, per-loss-head loss and
+        # non-finite flag (returned as extra stacked ys), plus one
+        # window-level param-norm / update-ratio reading (see
+        # _window_param_stats) — all read by the host at window
+        # boundaries where it already syncs. Read-only over values the
+        # step computes anyway, so armed training is bit-identical to
+        # unarmed; arming keys the program cache below.
+        health_armed = _telemetry.health.armed()
+
         # lr/wd arrive as TWO stacked f32 arrays, not 2x161 python
         # scalars: scalar jit args each become their own host->device
         # transfer per dispatch, which through a remote chip is hundreds
@@ -467,8 +512,57 @@ class DataParallelExecutorGroup:
                     break
                 l = lab.astype(jnp.int32).ravel()
                 mets.append(jnp.sum(p.astype(jnp.int32).ravel() == l))
+            health = None
+            if health_armed:
+                f32 = jnp.float32
+                # per-step stats ONLY cover values this step already
+                # materialises (grads, outputs): reductions over the
+                # param set are NOT free here — params ride the donated
+                # scan carry, and any extra reader defeats the in-place
+                # update (measured: an O(params) copy per step, +15% on
+                # a 1M-param epoch). param-norm / update-ratio are
+                # computed once per dispatch window by the program
+                # wrappers below instead.
+                gsq = _ssq32(grads[nm] for nm in watched)
+                # per-loss-head loss value: cross-entropy against the
+                # paired label for classification heads, squared error
+                # for same-shape heads, mean output for heads that ARE
+                # the loss (MakeLoss-style) — mirrors the mets pairing
+                label_for = dict((i, nm) for i, nm in metric_pairs)
+                losses = []
+                for i, (o, is_loss) in enumerate(zip(outs, loss_mask)):
+                    if not is_loss:
+                        continue
+                    nm = label_for.get(i)
+                    lab = rest.get(nm) if nm is not None else None
+                    o32 = o.astype(f32)
+                    if lab is not None and o.ndim > 1 and \
+                            o.shape != lab.shape and \
+                            int(np.prod(o.shape[:-1])) == lab.size:
+                        p = o32.reshape((-1, o.shape[-1]))
+                        idx = lab.astype(jnp.int32).reshape((-1, 1))
+                        picked = jnp.take_along_axis(p, idx, axis=1)
+                        losses.append(-jnp.mean(jnp.log(
+                            jnp.maximum(picked, 1e-30))))
+                    elif lab is not None and o.shape == lab.shape:
+                        d = o32 - lab.astype(f32)
+                        losses.append(jnp.mean(d * d))
+                    else:
+                        losses.append(jnp.mean(o32))
+                loss_vec = jnp.stack(losses) if losses \
+                    else jnp.zeros((0,), f32)
+                finite = (jnp.isfinite(gsq)
+                          & jnp.all(jnp.isfinite(loss_vec)))
+                # raw scalars, NOT packed into one vector: a pack op
+                # (stack/concatenate) is measurably slower in-program
+                # than returning the scalars as-is on micro-steps
+                health = {
+                    "grad_norm": jnp.sqrt(gsq),
+                    "loss": loss_vec,
+                    "nonfinite": 1.0 - finite.astype(f32),
+                }
             return (outs, new_aux, new_w, new_states,
-                    grads if keep_grads else None, key, mets)
+                    grads if keep_grads else None, key, mets, health)
 
         # donate the watched params and optimizer states: both are
         # replaced by same-shaped outputs every step, so XLA updates them
@@ -504,10 +598,25 @@ class DataParallelExecutorGroup:
             "fused_step", tuple(watched), tuple(metric_pairs), keep_grads,
             optimizer.fused_plan_token(),
             ("comm", "rs" if zero_armed else "ar"),
-            ("remat", remat_policy))
+            ("remat", remat_policy),
+            ("health", health_armed))
         self._fused_prog = None
         if self._fused_cache_key is not None:
             self._fused_prog = _progcache.get(self._fused_cache_key)
+        if health_armed:
+            # single-step program: every step is its own dispatch
+            # window, so the window-level param stats land here too
+            def fused_one(w, rest, aux_vals, key, states, lr_arr,
+                          wd_arr):
+                (outs, new_aux, new_w, new_states, grads, key, mets,
+                 health) = step(w, rest, aux_vals, key, states,
+                                lr_arr, wd_arr)
+                health = _window_param_stats(health, w, new_w, watched)
+                return (outs, new_aux, new_w, new_states, grads, key,
+                        mets, health)
+            prog_fn = fused_one
+        else:
+            prog_fn = step
         if self._fused_prog is not None:
             if _telemetry.enabled():
                 _telemetry.counter("executor.jit_cache.hit").inc()
@@ -515,7 +624,7 @@ class DataParallelExecutorGroup:
             if _telemetry.enabled():
                 _telemetry.counter("executor.jit_cache.miss").inc()
             self._fused_prog = _telemetry.wrap_dispatch(
-                jax.jit(step, donate_argnums=donate), "fused_step")
+                jax.jit(prog_fn, donate_argnums=donate), "fused_step")
             if self._fused_cache_key is not None:
                 _progcache.put(self._fused_cache_key, self._fused_prog)
         self._scan_prog = None      # K-step lax.scan program (lazy)
@@ -529,6 +638,9 @@ class DataParallelExecutorGroup:
         self._fused_rng_gen = _random.generation()
         self._fused_lrwd = (None, None, None)  # (key, lr_arr, wd_arr)
         self._fused_metric_scalars = None
+        self._last_health = None    # just-dispatched device stat vector
+        self._health_queue = collections.deque()   # awaiting readiness
+        self._health_armed = health_armed      # drained by take_health()
         # the watched cells must own their buffers exclusively before the
         # first donated step: init_params aliases the same arrays into
         # Module._arg_params, and donating a shared buffer would delete it
@@ -784,9 +896,11 @@ class DataParallelExecutorGroup:
             sa_t1 = _sa.clock()
             _sa.note("assemble", sa_t1 - sa_t0)
         (outs, new_aux, new_w, new_states, grads, self._fused_key,
-         mets) = self._fused_prog(w, arg_vals, exe._aux_vals(),
-                                  self._fused_key, self._fused_states,
-                                  lr_arr, wd_arr)
+         mets, health) = self._fused_prog(w, arg_vals, exe._aux_vals(),
+                                          self._fused_key,
+                                          self._fused_states,
+                                          lr_arr, wd_arr)
+        self._last_health = health        # device scalars (or None)
         if sa_on:
             sa_t2 = _sa.clock()
             _sa.note("dispatch", sa_t2 - sa_t1)
@@ -849,6 +963,7 @@ class DataParallelExecutorGroup:
         back stacked as ys so metrics and callbacks still see per-batch
         numbers."""
         step_core = self._step_core
+        watched = self._fused_watched
 
         def scan_fn(w, states, key, aux_vals, rest_static, xs):
             def body(carry, x):
@@ -856,15 +971,22 @@ class DataParallelExecutorGroup:
                 rest = dict(rest_static)
                 rest.update(x["in"])
                 (outs, new_aux, new_w, new_states, _grads, key,
-                 mets) = step_core(w, rest, aux, key, states,
-                                   x["lr"], x["wd"])
+                 mets, health) = step_core(w, rest, aux, key, states,
+                                           x["lr"], x["wd"])
                 if new_aux:
                     aux = {**aux, **new_aux}
-                return (new_w, new_states, key, aux), (outs, mets)
+                return (new_w, new_states, key, aux), (outs, mets, health)
 
-            (w, states, key, aux), (outs_s, mets_s) = jax.lax.scan(
-                body, (w, states, key, aux_vals), xs)
-            return w, states, key, aux, outs_s, mets_s
+            w0 = w
+            (w, states, key, aux), (outs_s, mets_s, health_s) = \
+                jax.lax.scan(body, (w, states, key, aux_vals), xs)
+            if health_s is not None:
+                # window-level param stats over the K-step delta: one
+                # amortised pass instead of a per-step read that would
+                # break the donated in-place carry (see
+                # _window_param_stats)
+                health_s = _window_param_stats(health_s, w0, w, watched)
+            return w, states, key, aux, outs_s, mets_s, health_s
 
         gkey = None
         if self._fused_cache_key is not None:
@@ -978,9 +1100,10 @@ class DataParallelExecutorGroup:
             sa_t1 = _sa.clock()
             _sa.note("assemble", sa_t1 - sa_t0)
         (new_w, new_states, self._fused_key, new_aux, outs_s,
-         mets_s) = self._scan_prog(
+         mets_s, health_s) = self._scan_prog(
             w, self._fused_states, self._fused_key, exe._aux_vals(),
             rest_static, {"in": xs_in, "lr": lr_arr, "wd": wd_arr})
+        self._last_health = health_s      # (K,)-stacked stats (or None)
         if sa_on:
             sa_t2 = _sa.clock()
             _sa.note("dispatch", sa_t2 - sa_t1)
@@ -1026,6 +1149,77 @@ class DataParallelExecutorGroup:
         self._fused_metric_scalars = scalars
         self._fused_metric_labels = labels
         return labels
+
+    # windows of undrained health stats the device may still be
+    # computing; past this the oldest is forced through (bounds memory
+    # and detection lag when the host runs far ahead of the device)
+    _HEALTH_LAG_MAX = 4
+
+    def take_health(self, cursor=(0, 0), flush=False):
+        """Drain in-program health stats as a list of
+        ``(stat_dict, epoch, nbatch)`` per-step tuples (None when
+        nothing is ready / the program wasn't armed).
+
+        Stats queue behind the dispatch that produced them and are read
+        back only once the device reports them finished
+        (``Array.is_ready()``) — the fit loop never hard-syncs
+        mid-epoch, so an eager device_get here would block on in-flight
+        windows and serialize the host behind the device (measured
+        ~5-10% of a fit epoch on benchmarks/telemetry_overhead.py; the
+        readiness gate makes arming free). The backlog is bounded by
+        ``_HEALTH_LAG_MAX`` windows; ``flush=True`` drains everything —
+        the epoch-end call, where the loop syncs anyway. ``cursor`` is
+        ``(epoch, first_nbatch)`` of the just-dispatched window, handed
+        back alongside its stats so observations attribute to the
+        batches that produced them however late they drain."""
+        q = getattr(self, "_health_queue", None)
+        if q is None:
+            q = self._health_queue = collections.deque()
+        if self._last_health is not None:
+            q.append((self._last_health, cursor))
+            self._last_health = None
+        out = []
+        while q:
+            h, (ep, nb) = q[0]
+            if not flush and len(q) <= self._HEALTH_LAG_MAX:
+                try:
+                    # one leaf speaks for the window: every stat comes
+                    # out of the same dispatch
+                    if not h["grad_norm"].is_ready():
+                        break
+                except AttributeError:
+                    pass        # host-side array: always ready
+            q.popleft()
+            for k, stats in enumerate(self._health_records(h) or ()):
+                out.append((stats, ep, nb + k))
+        return out or None
+
+    @staticmethod
+    def _health_records(h):
+        """Decode one stashed health pytree into per-step host dicts.
+
+        grad_norm / loss / nonfinite are per-step (K-stacked on the
+        scan path); param_norm / update_ratio are one window-level
+        reading (see ``_window_param_stats``), repeated onto each of
+        the window's records so every observation carries the full
+        stat set."""
+        if h is None:
+            return None
+        vals = jax.device_get(h)
+        gn = np.asarray(vals["grad_norm"])
+        loss = np.asarray(vals["loss"])
+        pn = float(np.asarray(vals["param_norm"]))
+        ur = float(np.asarray(vals["update_ratio"]))
+        if gn.ndim == 0:
+            return [{"grad_norm": float(gn), "param_norm": pn,
+                     "update_ratio": ur,
+                     "nonfinite": float(vals["nonfinite"]),
+                     "loss": [float(x) for x in np.ravel(loss)]}]
+        nf = np.asarray(vals["nonfinite"])
+        return [{"grad_norm": float(gn[k]), "param_norm": pn,
+                 "update_ratio": ur, "nonfinite": float(nf[k]),
+                 "loss": [float(x) for x in np.ravel(loss[k])]}
+                for k in range(gn.shape[0])]
 
     # -------------------------------------------------------------- params
     def set_params(self, arg_params, aux_params):
@@ -1075,8 +1269,11 @@ class DataParallelExecutorGroup:
         # any staged execution invalidates fused-step metric scalars so a
         # later update_metric (e.g. an eval pass) can never consume
         # counts from a previous train batch; pending scanned steps are
-        # dropped for the same reason
+        # dropped for the same reason, as are undrained health stats
         self._fused_metric_scalars = None
+        self._last_health = None
+        if getattr(self, "_health_queue", None):
+            self._health_queue.clear()
         if getattr(self, "_scan_results", None):
             self._scan_results.clear()
         self._load_batch(data_batch)

@@ -55,6 +55,7 @@ from . import mfu
 from . import sentinel
 from . import trace
 from . import stepattr
+from . import health
 from . import chrome_trace
 from . import prometheus
 from . import jsonl
@@ -66,8 +67,8 @@ __all__ = ["span", "event", "record_event", "enable", "disable", "enabled",
            "Counter", "Gauge", "Histogram", "counter", "gauge", "histogram",
            "get_metric", "snapshot", "reset", "NanSentinel", "AnomalyError",
            "fleet", "flightrec", "memory", "mfu", "sentinel", "trace",
-           "stepattr", "chrome_trace", "prometheus", "jsonl", "opsd",
-           "serve_ops"]
+           "stepattr", "health", "chrome_trace", "prometheus", "jsonl",
+           "opsd", "serve_ops"]
 
 
 def snapshot():
@@ -92,6 +93,7 @@ def reset():
     flightrec.clear()
     trace.clear()
     stepattr.reset()
+    health.reset()
     memory.reset_peak()
 
 

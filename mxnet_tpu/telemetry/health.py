@@ -1,0 +1,564 @@
+"""Training-health plane: run statistics, divergence detection, triage.
+
+The NaN sentinel (sentinel.py) fires once a tensor is already broken;
+a production run wants the *earlier* signal — gradient global norm,
+parameter norm, update/param ratio, the loss trajectory — sampled
+continuously at near-zero cost, with deterministic detectors and an
+automated response on top. Three layers:
+
+* **In-program statistics** — when armed (``MXNET_TRAIN_HEALTH=1`` /
+  ``fit(health=True)``) the fused/K-step scan train step
+  (module/executor_group.py) computes a small fixed stat set *inside
+  the already-jitted program*: per step, grad global L2 norm,
+  per-loss-head loss value and a non-finite flag (extra stacked ys);
+  per dispatch window, one param global L2 norm and update-ratio
+  (‖Δw‖/‖w‖ over the window's delta) reading — a per-step read of the
+  donated param carry would defeat XLA's in-place update. The host
+  reads everything at window boundaries where it already syncs — zero
+  added dispatches, and the K-step scan path stays async. The stats
+  are read-only outputs: armed training is bit-identical to unarmed.
+  Arming keys the program cache (``("health", True)``) so armed and
+  unarmed runs never share a trace.
+
+* **Detectors** — :class:`HealthMonitor` keeps an EMA baseline plus a
+  rolling median/MAD window per series and fires deterministic rules:
+  ``loss_spike`` (> median + K·MAD), ``loss_plateau`` (EMA unmoved for
+  a full window), ``grad_explosion`` / ``grad_collapse``,
+  ``update_ratio_high`` / ``update_ratio_low`` (out of band), and
+  ``nonfinite``. Every firing lands a ``train.health.*`` metric, a
+  flight-ring record carrying the full stat window, and — when a
+  request trace is active on the thread — a trace-plane event.
+
+* **Triage** — each rule resolves a policy on the ladder
+  ``warn → snapshot → checkpoint → raise`` (cumulative:
+  ``checkpoint`` also logs, ``raise`` also checkpoints when a manager
+  is bound). ``snapshot`` writes a flight-recorder report,
+  ``checkpoint`` lands an emergency ``CheckpointManager`` commit
+  through the existing writer thread, ``raise`` escalates via the same
+  :class:`~.sentinel.AnomalyError` path the sentinel uses. The
+  NaN sentinel routes its own policy through :func:`escalate`, so both
+  tripwires share one escalation surface.
+
+Health state (ok/degraded/diverged) is a plain registry gauge
+(``train.health.state``), so it rides ``fleet.snapshot()``/``merge()``
+to the fleet tools unchanged; ``opsd`` ``/healthz`` flips 503 on
+diverged and ``/trainz`` renders the live series.
+
+Env surface (docs/env_var.md): ``MXNET_TRAIN_HEALTH``,
+``MXNET_TRAIN_HEALTH_K``, ``MXNET_TRAIN_HEALTH_WINDOW``,
+``MXNET_TRAIN_HEALTH_POLICY``.
+
+Pure stdlib + sibling telemetry modules — no jax import, so the
+detector layer is testable by feeding scripted stat dicts.
+"""
+from __future__ import annotations
+
+import collections
+import logging
+import math
+import os
+import threading
+import time
+import weakref
+
+from . import core as _core
+from . import flightrec as _flightrec
+from . import metrics as _metrics
+from . import trace as _trace
+
+__all__ = ["HealthMonitor", "LADDER", "RULES", "STATE_NAMES", "armed",
+           "configure", "install", "monitor", "observe", "escalate",
+           "resolve_policy", "bind_triage", "release_triage", "status",
+           "state", "reset"]
+
+log = logging.getLogger(__name__)
+
+LADDER = ("warn", "snapshot", "checkpoint", "raise")
+
+RULES = ("loss_spike", "loss_plateau", "grad_explosion", "grad_collapse",
+         "update_ratio_high", "update_ratio_low", "nonfinite")
+
+# rule -> health state it drives (1 degraded, 2 diverged)
+_SEVERITY = {"loss_spike": 2, "grad_explosion": 2, "nonfinite": 2,
+             "loss_plateau": 1, "grad_collapse": 1,
+             "update_ratio_high": 1, "update_ratio_low": 1,
+             "sentinel": 2}
+
+STATE_NAMES = {0: "ok", 1: "degraded", 2: "diverged"}
+
+_MIN_SAMPLES = 8        # MAD detectors' warm-up (stepattr discipline)
+_THRESH_EVERY = 16      # threshold recompute cadence over the window
+_MAD_FLOOR_FRAC = 0.02  # MAD floor as a fraction of |median|, plus an
+_MAD_FLOOR_ABS = 1e-8   # absolute floor — a flat series must not flag
+                        # float noise
+
+_env_armed = os.environ.get("MXNET_TRAIN_HEALTH", "")
+_forced = None          # configure(armed=...) / fit(health=...) override
+_UNSET = object()
+
+_lock = threading.Lock()
+_monitor = None         # process-wide HealthMonitor (lazy)
+_triage = None          # weakref to the module fit() is driving
+
+
+def _env_float(name, default):
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def _env_int(name, default):
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def armed():
+    """Is the health plane recording? A ``configure(armed=...)`` /
+    ``fit(health=...)`` override wins, then ``MXNET_TRAIN_HEALTH=1/0``;
+    default off (the stats change the fused program's cache key, so
+    arming is always an explicit decision, never implied by the span
+    tracer switch)."""
+    if _forced is not None:
+        return _forced
+    return _env_armed == "1"
+
+
+def configure(armed=_UNSET, **kwargs):
+    """Override arming (``armed=None`` restores the env default) and/or
+    rebuild the process monitor with new detector knobs (any
+    :class:`HealthMonitor` constructor kwarg)."""
+    global _forced, _monitor
+    if armed is not _UNSET:
+        _forced = armed
+    if kwargs:
+        with _lock:
+            _monitor = HealthMonitor(**kwargs)
+
+
+def install(mon):
+    """Install a caller-built :class:`HealthMonitor` as the process
+    monitor (``fit(health=HealthMonitor(...))``) and arm the plane."""
+    global _monitor, _forced
+    with _lock:
+        _monitor = mon
+    _forced = True
+    return mon
+
+
+def monitor():
+    """The process-wide monitor, created on first use."""
+    global _monitor
+    with _lock:
+        if _monitor is None:
+            _monitor = HealthMonitor()
+        return _monitor
+
+
+def observe(stats, epoch=0, nbatch=0):
+    """Feed one step's stat dict into the process monitor; returns the
+    list of rule firings (each carrying the resolved policy)."""
+    return monitor().observe(stats, epoch=epoch, nbatch=nbatch)
+
+
+def state():
+    """Current health state: 0 ok / 1 degraded / 2 diverged."""
+    mon = _monitor
+    return 0 if mon is None else mon.state
+
+
+def status():
+    """The live health document (/trainz): arming, state, recent rule
+    firings, and the rolling series tails. Cheap; never creates the
+    monitor."""
+    mon = _monitor
+    doc = {"armed": armed(), "state": 0, "state_name": STATE_NAMES[0],
+           "observations": 0, "rules": [], "series": {}}
+    if mon is None:
+        return doc
+    doc["state"] = mon.state
+    doc["state_name"] = STATE_NAMES.get(mon.state, str(mon.state))
+    doc["observations"] = mon.observations
+    doc["rules"] = mon.firings()
+    doc["series"] = mon.series()
+    return doc
+
+
+# ------------------------------------------------------------- policies
+def _parse_policy_spec(spec):
+    """``MXNET_TRAIN_HEALTH_POLICY`` grammar: a bare ladder name sets
+    the default for every rule; ``rule=policy`` tokens (comma-separated)
+    override per rule — e.g. ``"warn"`` or
+    ``"checkpoint,nonfinite=raise,sentinel=raise"``."""
+    default = "warn"
+    per_rule = {}
+    for tok in str(spec or "").split(","):
+        tok = tok.strip()
+        if not tok:
+            continue
+        if "=" in tok:
+            rule, _, pol = tok.partition("=")
+            rule, pol = rule.strip(), pol.strip()
+        else:
+            rule, pol = None, tok
+        if pol not in LADDER:
+            log.warning("MXNET_TRAIN_HEALTH_POLICY: unknown policy %r "
+                        "(want one of %s); ignored", pol, "/".join(LADDER))
+            continue
+        if rule is None:
+            default = pol
+        else:
+            per_rule[rule] = pol
+    return default, per_rule
+
+
+def resolve_policy(rule, override=None):
+    """The ladder policy for ``rule``: an explicit override first, then
+    the ``MXNET_TRAIN_HEALTH_POLICY`` spec (per-rule token, else its
+    default), else ``warn``. The sentinel resolves its policy here too
+    (rule ``"sentinel"``), unifying both tripwires' env surface."""
+    if override is not None:
+        return override
+    default, per_rule = _parse_policy_spec(
+        os.environ.get("MXNET_TRAIN_HEALTH_POLICY", ""))
+    return per_rule.get(rule, default)
+
+
+def bind_triage(module):
+    """Register the module a fit loop is driving so ``checkpoint``-level
+    escalations (from the detector OR the sentinel) can land an
+    emergency commit through its CheckpointManager. Held by weakref."""
+    global _triage
+    _triage = weakref.ref(module)
+
+
+def release_triage():
+    global _triage
+    _triage = None
+
+
+def _triage_module():
+    ref = _triage
+    return ref() if ref is not None else None
+
+
+def escalate(rule, policy, message, module=None, epoch=0, nbatch=0):
+    """Run the triage ladder for one firing. Cumulative: every level
+    logs; ``snapshot`` additionally writes a flight-recorder report;
+    ``checkpoint`` lands an emergency commit through the bound module's
+    CheckpointManager writer thread; ``raise`` throws
+    :class:`~.sentinel.AnomalyError` (after the emergency commit, so
+    the raise path is resumable)."""
+    from .. import faults as _faults
+    level = LADDER.index(policy) if policy in LADDER else 0
+    log.warning("train health: rule %r fired (policy=%s): %s",
+                rule, policy, message)
+    _faults.point("train.health.triage", rule=rule, policy=policy)
+    if level in (1, 2):     # snapshot: a post-mortem without dying
+        # (the raise level skips this — the escaping AnomalyError gets
+        # its crash report from the existing guards, and a second dump
+        # here would break their per-exception dedupe)
+        try:
+            _flightrec.dump_crash(
+                where=f"train.health.{rule}",
+                extra={"rule": rule, "policy": policy,
+                       "message": message, "health": status()})
+        except Exception:
+            log.exception("train health: snapshot dump failed")
+    if level >= 2:          # checkpoint: emergency commit, async writer
+        mod = module if module is not None else _triage_module()
+        mgr = getattr(mod, "_ckpt_manager", None)
+        if mgr is not None:
+            try:
+                # still the async writer thread either way; the raise
+                # path blocks on the commit because the fit loop's
+                # mgr.wait() is never reached once AnomalyError flies
+                seq = mgr.save(mod, epoch, nbatch, block=(level >= 3))
+                _metrics.counter("train.health.emergency_ckpts").inc()
+                _flightrec.note("train.health.ckpt", rule=rule, seq=seq,
+                                epoch=epoch, nbatch=nbatch)
+                if _core.enabled():
+                    _core.event("train.health.ckpt", rule=rule, seq=seq,
+                                epoch=epoch, nbatch=nbatch)
+            except Exception:
+                log.exception("train health: emergency checkpoint "
+                              "failed; the last committed one stands")
+        elif policy == "checkpoint":
+            # raise-level commits are best-effort (a bare sentinel test
+            # has no fit running); an explicit checkpoint policy with
+            # nothing to commit through deserves the noise
+            log.warning("train health: policy 'checkpoint' but no "
+                        "checkpoint manager is bound; skipping the commit")
+    if level >= 3:
+        from .sentinel import AnomalyError
+        raise AnomalyError(f"training health rule {rule!r}: {message}")
+
+
+# -------------------------------------------------------------- monitor
+class _Series:
+    """One stat series: EMA baseline + rolling window with a cached
+    median/MAD threshold pair (recomputed every ``_THRESH_EVERY``
+    appends — the stepattr straggler-detector discipline)."""
+
+    __slots__ = ("window", "ema", "alpha", "_sorted_at", "_med", "_mad",
+                 "appends")
+
+    def __init__(self, maxlen):
+        self.window = collections.deque(maxlen=maxlen)
+        self.ema = None
+        self.alpha = 2.0 / (maxlen + 1)
+        self._sorted_at = -1
+        self._med = None
+        self._mad = None
+        self.appends = 0
+
+    def append(self, v):
+        self.window.append(v)
+        self.appends += 1
+        self.ema = v if self.ema is None else \
+            self.ema + self.alpha * (v - self.ema)
+
+    def med_mad(self):
+        """(median, MAD with floors), or None during warm-up."""
+        if len(self.window) < _MIN_SAMPLES:
+            return None
+        if self._med is None or \
+                self.appends - self._sorted_at >= _THRESH_EVERY:
+            win = sorted(self.window)
+            med = win[len(win) // 2]
+            mad = sorted([abs(w - med) for w in win])[len(win) // 2]
+            self._med = med
+            self._mad = max(mad, _MAD_FLOOR_FRAC * abs(med),
+                            _MAD_FLOOR_ABS)
+            self._sorted_at = self.appends
+        return self._med, self._mad
+
+
+_finite = math.isfinite
+
+
+class HealthMonitor:
+    """Deterministic detectors over the in-program stat stream.
+
+    Parameters (each defaulting from its env knob where one exists):
+
+    window : int — rolling window per series
+        (``MXNET_TRAIN_HEALTH_WINDOW``, default 64).
+    k_mad : float — spike/explosion threshold multiplier: value >
+        median + k·MAD fires (``MXNET_TRAIN_HEALTH_K``, default 6).
+    policy : str | dict — ladder policy: one name for every rule, or a
+        per-rule dict; unset rules resolve through
+        ``MXNET_TRAIN_HEALTH_POLICY`` (see :func:`resolve_policy`).
+    plateau_tol : float — relative EMA movement under which a loss
+        observation counts as flat (default 1e-4).
+    ratio_band : (low, high) — healthy ‖Δw‖/‖w‖ band (default
+        (1e-8, 0.5)). The ratio is read once per dispatch window, over
+        the window-wide delta: with a K-step scan it covers K updates,
+        so size the band for the windowed step, not a single one.
+    collapse_frac : float — grad_norm < frac·median fires
+        ``grad_collapse`` (default 0.01).
+    cooldown : int — observations a fired rule holds down before it can
+        fire again (default: the window size) — bounds record volume.
+    """
+
+    def __init__(self, window=None, k_mad=None, policy=None,
+                 plateau_tol=1e-4, ratio_band=(1e-8, 0.5),
+                 collapse_frac=0.01, cooldown=None):
+        self.window = max(_MIN_SAMPLES,
+                          _env_int("MXNET_TRAIN_HEALTH_WINDOW", 64)
+                          if window is None else int(window))
+        self.k_mad = _env_float("MXNET_TRAIN_HEALTH_K", 6.0) \
+            if k_mad is None else float(k_mad)
+        if isinstance(policy, str):
+            self._policy = {r: policy for r in RULES}
+        else:
+            self._policy = dict(policy or {})
+        self.plateau_tol = float(plateau_tol)
+        self.ratio_band = (float(ratio_band[0]), float(ratio_band[1]))
+        self.collapse_frac = float(collapse_frac)
+        self.cooldown = self.window if cooldown is None else int(cooldown)
+        self._series = {"loss": _Series(self.window),
+                        "grad_norm": _Series(self.window),
+                        "update_ratio": _Series(self.window)}
+        self._flat_run = 0              # consecutive flat-loss steps
+        self._last_fired = {}           # rule -> observation index
+        self._first_fired = {}          # rule -> observation index
+        self._firings = collections.deque(maxlen=256)
+        self._gauges = None
+        self._loss_gauges = {}          # head index -> cached handle
+        self._gauges_gen = -1
+        self.observations = 0
+        self.state = 0
+
+    # ------------------------------------------------------------ wiring
+    def policy_for(self, rule):
+        return resolve_policy(rule, self._policy.get(rule))
+
+    def _handles(self):
+        """Cached metric handles, refreshed on registry reset (the
+        stepattr phase-histogram idiom — registry lookups take a lock
+        each and observe() sits on the boundary path)."""
+        gen = _metrics.generation()
+        if self._gauges is None or self._gauges_gen != gen:
+            self._gauges = {
+                "state": _metrics.gauge("train.health.state"),
+                **{s: _metrics.gauge(f"train.health.{s}")
+                   for s in ("grad_norm", "param_norm", "update_ratio")},
+            }
+            self._loss_gauges = {}
+            self._gauges_gen = gen
+        return self._gauges
+
+    def _loss_gauge(self, head):
+        g = self._loss_gauges.get(head)
+        if g is None:
+            g = _metrics.gauge("train.health.loss", head=str(head))
+            self._loss_gauges[head] = g
+        return g
+
+    def firings(self):
+        return list(self._firings)
+
+    def series(self):
+        out = {name: list(s.window) for name, s in self._series.items()}
+        out["ema"] = {name: s.ema for name, s in self._series.items()
+                      if s.ema is not None}
+        return out
+
+    # ------------------------------------------------------------ observe
+    def observe(self, stats, epoch=0, nbatch=0):
+        """Ingest one step's stat dict — ``grad_norm``, ``param_norm``,
+        ``update_ratio``, ``nonfinite`` scalars plus a ``loss`` head
+        list — run every rule, and emit metrics/ring/trace records for
+        each firing. Returns the firing dicts (rule, policy, message,
+        value, threshold) for the caller's triage pass; the ladder
+        itself runs in :func:`escalate` (the fit loop owns the module
+        handle the checkpoint level needs)."""
+        self.observations += 1
+        n = self.observations
+        gn = float(stats.get("grad_norm", 0.0))
+        pn = float(stats.get("param_norm", 0.0))
+        ur = float(stats.get("update_ratio", 0.0))
+        heads = [float(v) for v in (stats.get("loss") or ())]
+        loss = sum(heads) if heads else None
+        nonfinite = float(stats.get("nonfinite", 0.0)) >= 0.5 or \
+            not (_finite(gn) and _finite(pn) and
+                 all(_finite(h) for h in heads))
+
+        g = self._handles()
+        g["grad_norm"].set(gn)
+        g["param_norm"].set(pn)
+        g["update_ratio"].set(ur)
+        for i, h in enumerate(heads):
+            self._loss_gauge(i).set(h)
+
+        fired = []
+
+        def fire(rule, value, threshold, why):
+            last = self._last_fired.get(rule)
+            if last is not None and n - last <= self.cooldown:
+                return
+            self._last_fired[rule] = n
+            self._first_fired.setdefault(rule, n)
+            fired.append({"rule": rule, "policy": self.policy_for(rule),
+                          "value": value, "threshold": threshold,
+                          "epoch": epoch, "nbatch": nbatch, "n": n,
+                          "message": why})
+
+        # --- detectors (all deterministic; MAD floors per stepattr) ---
+        ls = self._series["loss"]
+        if loss is not None and _finite(loss):
+            mm = ls.med_mad()
+            if mm is not None and loss > mm[0] + self.k_mad * mm[1]:
+                fire("loss_spike", loss, mm[0] + self.k_mad * mm[1],
+                     f"loss {loss:.6g} > median {mm[0]:.6g} + "
+                     f"{self.k_mad:g}*MAD {mm[1]:.6g}")
+            prev_ema = ls.ema
+            if prev_ema is not None and abs(loss - prev_ema) <= \
+                    self.plateau_tol * max(abs(prev_ema), 1e-12):
+                self._flat_run += 1
+                if self._flat_run == self.window:
+                    fire("loss_plateau", loss, prev_ema,
+                         f"loss flat within {self.plateau_tol:g} of its "
+                         f"EMA for {self.window} steps")
+                    self._flat_run = 0
+            else:
+                self._flat_run = 0
+            ls.append(loss)
+
+        gs = self._series["grad_norm"]
+        if _finite(gn):
+            mm = gs.med_mad()
+            if mm is not None:
+                hi = mm[0] + self.k_mad * mm[1]
+                if gn > hi:
+                    fire("grad_explosion", gn, hi,
+                         f"grad norm {gn:.6g} > median {mm[0]:.6g} + "
+                         f"{self.k_mad:g}*MAD {mm[1]:.6g}")
+                elif mm[0] > 0 and gn < self.collapse_frac * mm[0]:
+                    fire("grad_collapse", gn, self.collapse_frac * mm[0],
+                         f"grad norm {gn:.6g} < {self.collapse_frac:g}*"
+                         f"median {mm[0]:.6g}")
+            gs.append(gn)
+
+        if _finite(ur):
+            lo, hi = self.ratio_band
+            if ur > hi:
+                fire("update_ratio_high", ur, hi,
+                     f"update ratio {ur:.6g} above band {hi:g}")
+            elif 0.0 < lo and ur < lo and gn > 0.0:
+                fire("update_ratio_low", ur, lo,
+                     f"update ratio {ur:.6g} below band {lo:g}")
+            self._series["update_ratio"].append(ur)
+
+        if nonfinite:
+            fire("nonfinite", 1.0, 0.5,
+                 "non-finite value in the step stats "
+                 f"(grad_norm={gn!r}, loss={loss!r})")
+
+        for f in fired:
+            self._emit(f)
+        g["state"].set(self.state)
+        return fired
+
+    # ----------------------------------------------------------- emission
+    def _emit(self, f):
+        """One firing -> metric + flight-ring record (with the full stat
+        window) + trace-plane event + state advance. The triage ladder
+        runs separately in :func:`escalate`."""
+        rule = f["rule"]
+        self.state = max(self.state, _SEVERITY.get(rule, 1))
+        self._firings.append(f)
+        _metrics.counter("train.health.firings", rule=rule).inc()
+        _metrics.gauge("train.health.rule_fired", rule=rule).set(f["n"])
+        _metrics.gauge("train.health.first_firing",
+                       rule=rule).set(self._first_fired[rule])
+        tid = _trace.current_id()
+        ring = {"rule": rule, "policy": f["policy"], "epoch": f["epoch"],
+                "nbatch": f["nbatch"], "value": f["value"],
+                "threshold": f["threshold"],
+                "window": {name: [round(v, 8) for v in s.window]
+                           for name, s in self._series.items()}}
+        if tid:
+            ring["trace"] = tid
+        _flightrec.note("train.health", **ring)
+        if _core.enabled():
+            _core.event("train.health", rule=rule, policy=f["policy"],
+                        epoch=f["epoch"], nbatch=f["nbatch"],
+                        value=f["value"], threshold=f["threshold"])
+        if tid:
+            now = time.perf_counter()
+            _trace.record(tid, f"train.health.{rule}", now, now,
+                          policy=f["policy"], value=f["value"])
+
+
+def reset():
+    """Drop the process monitor, its state, and the triage binding (the
+    arming override survives, like stepattr's — tests clear it
+    explicitly via ``configure(armed=None)``)."""
+    global _monitor, _triage
+    with _lock:
+        _monitor = None
+    _triage = None

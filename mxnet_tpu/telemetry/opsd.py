@@ -13,13 +13,17 @@ Routes (all GET, all read-only):
   ``application/openmetrics-text``.
 * ``/healthz`` — liveness JSON: fleet identity, dead ranks from the
   live kvstore's heartbeats (``get_dead_nodes()``), circuit-breaker
-  states, queue depths, last committed checkpoint seq, and
-  compiles-since-warmup. ``"ok"`` is false when any rank is dead or
-  any breaker sits OPEN.
+  states, queue depths, last committed checkpoint seq, training-health
+  state, and compiles-since-warmup. ``"ok"`` is false (HTTP 503) when
+  any rank is dead, any breaker sits OPEN, or the training-health
+  plane reports *diverged*.
 * ``/varz`` — process vitals: filtered env, argv, mesh/device summary
   (only if jax is *already* imported — the ops thread never triggers
   the heavy import), memory-plan gauges, telemetry switch state.
 * ``/tracez`` — the slowest request span trees from the trace plane.
+* ``/trainz`` — the live training-health document (telemetry/health.py):
+  arming, ok/degraded/diverged state, recent rule firings, and the
+  rolling stat series the detectors chew on.
 * ``/fleetz`` — this rank's versioned ``fleet.snapshot()`` (the lossless
   scrape ``tools/fleetstat.py --scrape`` merges across ranks).
 
@@ -39,6 +43,7 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from . import fleet as _fleet
+from . import health as _health
 from . import metrics as _metrics
 from . import prometheus as _prometheus
 from . import trace as _trace
@@ -109,8 +114,14 @@ def healthz():
     doc["queues"] = queues
     doc["compiles_since_warmup"] = compiles
     doc["last_ckpt_seq"] = last_seq
+    health_state = _health.state()
+    doc["train_health"] = {
+        "state": health_state,
+        "name": _health.STATE_NAMES.get(health_state, str(health_state)),
+        "rules": sorted({f["rule"] for f in _health.status()["rules"]})}
     doc["ok"] = (not doc["kvstore"]["dead_nodes"] and
-                 not any(b["state"] == 2 for b in breakers.values()))
+                 not any(b["state"] == 2 for b in breakers.values()) and
+                 health_state < 2)
     return doc
 
 
@@ -190,12 +201,14 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_json(varz())
             elif path == "/tracez":
                 self._send_json(tracez())
+            elif path == "/trainz":
+                self._send_json(_health.status())
             elif path == "/fleetz":
                 self._send_json(_fleet.snapshot())
             elif path == "/":
                 self._send_json({"routes": ["/metrics", "/healthz",
                                             "/varz", "/tracez",
-                                            "/fleetz"]})
+                                            "/trainz", "/fleetz"]})
             else:
                 self._send_json({"error": f"no route {path}"}, status=404)
         except BrokenPipeError:

@@ -21,10 +21,15 @@ Two install points, both on an Executor:
 Every anomaly lands in the metrics registry
 (``sentinel.anomalies{kind=...,array=...}`` counters), the flight
 recorder ring (so crash reports carry the first-anomaly timeline), and
-— when the span tracer is on — the event buffer. The policy decides
-what happens next: ``"warn"`` logs and keeps training, ``"raise"``
-throws :class:`AnomalyError` (which the crash guards then dump).
-Default policy comes from MXNET_NAN_SENTINEL_POLICY.
+— when the span tracer is on — the event buffer. When a request trace
+is active on the thread, records stamp its trace id, so diagnose links
+the first NaN to its request/step tree. The policy then runs the
+training-health triage ladder (health.py): ``warn`` logs and keeps
+training, ``snapshot`` adds a flight-recorder report, ``checkpoint``
+lands an emergency commit through the bound CheckpointManager, and
+``raise`` throws :class:`AnomalyError` (which the crash guards then
+dump). Default policy: MXNET_NAN_SENTINEL_POLICY, else the health
+plane's MXNET_TRAIN_HEALTH_POLICY surface (rule ``sentinel``).
 """
 from __future__ import annotations
 
@@ -34,7 +39,9 @@ import re
 
 from . import core as _core
 from . import flightrec as _flightrec
+from . import health as _health
 from . import metrics as _metrics
+from . import trace as _trace
 
 __all__ = ["NanSentinel", "AnomalyError"]
 
@@ -60,9 +67,10 @@ class NanSentinel:
     interval : int
         Check every Nth executor completion (window stride); per-op taps
         check every observed tensor while a window is open.
-    policy : "warn" | "raise"
-        What to do on an anomaly (default: MXNET_NAN_SENTINEL_POLICY,
-        else "warn").
+    policy : "warn" | "snapshot" | "checkpoint" | "raise"
+        Triage ladder level to run on an anomaly (default:
+        MXNET_NAN_SENTINEL_POLICY, else the health plane's resolution
+        for rule ``sentinel`` — see telemetry/health.py).
     pattern : str
         Regex filter on array/op-output names (like Monitor's).
     check_outputs / check_grads : bool
@@ -73,11 +81,11 @@ class NanSentinel:
                  check_outputs=True, check_grads=True):
         if interval < 1:
             raise ValueError("interval must be >= 1")
-        policy = policy or os.environ.get("MXNET_NAN_SENTINEL_POLICY",
-                                          "warn")
-        if policy not in ("warn", "raise"):
-            raise ValueError(f"policy must be 'warn' or 'raise', "
-                             f"got {policy!r}")
+        policy = policy or os.environ.get("MXNET_NAN_SENTINEL_POLICY") \
+            or _health.resolve_policy("sentinel")
+        if policy not in _health.LADDER:
+            raise ValueError(f"policy must be one of "
+                             f"{'/'.join(_health.LADDER)}, got {policy!r}")
         self.interval = int(interval)
         self.policy = policy
         self.check_outputs = check_outputs
@@ -149,18 +157,25 @@ class NanSentinel:
 
     # ---------------------------------------------------------- emission
     def _emit(self, bad, step):
-        """Record anomalies everywhere, then apply the policy once."""
+        """Record anomalies everywhere, then run the triage ladder once.
+
+        Records stamp the thread's active trace id (when one exists) so
+        a served request's first NaN joins its span tree in diagnose.
+        """
+        tid = _trace.current_id()
+        stamp = {"trace": tid} if tid else {}
         for kind, name in bad:
             self.anomalies.append({"step": step, "kind": kind,
-                                   "array": name})
+                                   "array": name, **stamp})
             _metrics.counter("sentinel.anomalies", kind=kind,
                              array=name).inc()
-            _flightrec.note("anomaly", what=kind, array=name, step=step)
+            _flightrec.note("anomaly", what=kind, array=name, step=step,
+                            **stamp)
             if _core.enabled():
                 _core.event("anomaly", what=kind, array=name, step=step)
         desc = ", ".join(f"{kind} {name!r}" for kind, name in bad)
         msg = (f"non-finite values detected at step {step}: {desc} "
                f"(sentinel policy={self.policy})")
-        if self.policy == "raise":
-            raise AnomalyError(msg)
-        log.warning(msg)
+        # one escalation surface with the health detectors: warn logs,
+        # snapshot dumps, checkpoint commits, raise throws AnomalyError
+        _health.escalate("sentinel", self.policy, msg, nbatch=step)

@@ -42,6 +42,7 @@ constraints.
 from __future__ import annotations
 
 import collections
+import contextlib
 import itertools
 import os
 import threading
@@ -50,7 +51,8 @@ from . import fleet as _fleet
 from . import flightrec as _flightrec
 
 __all__ = ["Trace", "new_trace", "next_span_id", "record", "sample",
-           "spans", "tree", "trace_ids", "roots", "clear", "configure"]
+           "spans", "tree", "trace_ids", "roots", "clear", "configure",
+           "set_current", "current", "current_id", "use"]
 
 _DEFAULT_CAPACITY = 4096
 
@@ -100,8 +102,48 @@ class Trace:
 
 
 def new_trace(session=False):
-    """Allocate a fresh trace identity (cheap: one counter bump)."""
-    return Trace(f"t{next(_trace_seq):06x}", session=session)
+    """Allocate a fresh trace identity (cheap: one counter bump) and
+    mark it the calling thread's *current* trace (latest wins), so
+    out-of-band emitters — the NaN sentinel, the training-health plane —
+    can stamp the active request's id without threading it through
+    every call signature."""
+    t = Trace(f"t{next(_trace_seq):06x}", session=session)
+    set_current(t)
+    return t
+
+
+_tls = threading.local()
+
+
+def set_current(trace):
+    """Set (or clear, with None) this thread's active trace — a Trace
+    or a bare trace-id string."""
+    _tls.current = trace
+
+
+def current():
+    """This thread's active trace (Trace/str), or None."""
+    return getattr(_tls, "current", None)
+
+
+def current_id():
+    """The active trace's id string for this thread, or None."""
+    cur = getattr(_tls, "current", None)
+    if cur is None:
+        return None
+    return cur.trace_id if isinstance(cur, Trace) else str(cur)
+
+
+@contextlib.contextmanager
+def use(trace):
+    """Scope ``trace`` as the thread's current trace, restoring the
+    previous one on exit (nested server/step scopes)."""
+    prev = current()
+    set_current(trace)
+    try:
+        yield trace
+    finally:
+        set_current(prev)
 
 
 def next_span_id():
@@ -217,8 +259,9 @@ def tree(trace_id):
 
 def clear():
     """Drop buffered trace records (ids keep counting — uniqueness is
-    process-lifetime)."""
+    process-lifetime) and this thread's current-trace mark."""
     _buf.clear()
+    _tls.current = None
 
 
 def configure(capacity=None, sample=None, reset_ids=False):

@@ -662,17 +662,87 @@ def _anomaly_section(anoms):
     if not anoms:
         return ["anomalies: none recorded"]
     anoms = sorted(anoms, key=lambda a: a.get("ts_us", 0))
+
+    def where(a):
+        # sentinel records stamp the active request trace id: the
+        # first-NaN joins its span tree in the traces section below
+        tid = a.get("trace")
+        return f" (trace {tid})" if tid else ""
+
     first = anoms[0]
     lines = [f"anomalies: {len(anoms)} non-finite detections "
              f"(NaN/Inf sentinel)"]
     lines.append(f"  FIRST: {first.get('what', first.get('kind', '?'))} "
                  f"{first.get('array', '?')!r} at step "
-                 f"{first.get('step', '?')}")
+                 f"{first.get('step', '?')}{where(first)}")
     for a in anoms[1:6]:
         lines.append(f"  then:  {a.get('what', a.get('kind', '?'))} "
-                     f"{a.get('array', '?')!r} at step {a.get('step', '?')}")
+                     f"{a.get('array', '?')!r} at step "
+                     f"{a.get('step', '?')}{where(a)}")
     if len(anoms) > 6:
         lines.append(f"  ... and {len(anoms) - 6} more")
+    return lines
+
+
+_HEALTH_STATES = {0: "ok", 1: "degraded", 2: "diverged"}
+
+
+def _train_health_section(counters, gauge_triples, records):
+    """Training-health plane report (telemetry/health.py): state, the
+    rule-firing timeline, the final stat-series values, and any
+    emergency-checkpoint commits the triage ladder landed. ``records``
+    are the ``train.health`` / ``train.health.ckpt`` flight-ring
+    records (crash path) or core events (jsonl path)."""
+    state = None
+    tails = {}
+    for name, labels, val in gauge_triples or []:
+        if name == "train.health.state":
+            state = int(val)
+        elif name in ("train.health.grad_norm", "train.health.param_norm",
+                      "train.health.update_ratio"):
+            tails[name[len("train.health."):]] = val
+        elif name == "train.health.loss":
+            head = dict(labels).get("head", "0")
+            tails[f"loss[{head}]"] = val
+    per_rule = {}
+    for series, val in (counters or {}).items():
+        name, labels = _strip_labels(series)
+        if name != "train.health.firings":
+            continue
+        rule = "?"
+        for part in labels.split(","):
+            if part.startswith("rule="):
+                rule = part.split("=", 1)[1].strip('"')
+        per_rule[rule] = per_rule.get(rule, 0) + val
+    firings = [r for r in records or []
+               if r.get("kind") == "train.health"]
+    ckpts = [r for r in records or []
+             if r.get("kind") == "train.health.ckpt"]
+    if state is None and not (per_rule or firings or ckpts):
+        return ["training health: plane not armed / no records"]
+    tag = _HEALTH_STATES.get(state or 0, str(state))
+    head = f"training health: {tag.upper() if state else tag}"
+    if per_rule:
+        head += " (" + ", ".join(f"{r} x{int(n)}"
+                                 for r, n in sorted(per_rule.items())) + ")"
+    lines = [head]
+    for r in firings[-5:]:
+        lines.append(
+            f"  epoch {r.get('epoch', '?')} batch {r.get('nbatch', '?')}: "
+            f"{r.get('rule', '?')} -> {r.get('policy', '?')} "
+            f"(value {r.get('value', '?'):g} vs threshold "
+            f"{r.get('threshold', '?'):g})"
+            if isinstance(r.get("value"), (int, float)) and
+            isinstance(r.get("threshold"), (int, float)) else
+            f"  epoch {r.get('epoch', '?')} batch {r.get('nbatch', '?')}: "
+            f"{r.get('rule', '?')} -> {r.get('policy', '?')}")
+    if tails:
+        lines.append("  final series: " + ", ".join(
+            f"{k}={v:g}" for k, v in sorted(tails.items())))
+    for r in ckpts[-3:]:
+        lines.append(f"  emergency checkpoint: seq {r.get('seq', '?')} "
+                     f"at epoch {r.get('epoch', '?')} batch "
+                     f"{r.get('nbatch', '?')} ({r.get('rule', '?')})")
     return lines
 
 
@@ -715,6 +785,10 @@ def render_crash(report, top=10):
     ring = report.get("ring") or []
     anoms = [r for r in ring if r.get("kind") == "anomaly"]
     out += _anomaly_section(anoms)
+    out += _train_health_section(
+        metrics.get("counters") or {},
+        _gauge_triples_from_series(metrics.get("gauges") or {}),
+        ring)
     out += _lint_section(metrics.get("counters") or {},
                          [r for r in ring if r.get("kind") == "lint.finding"])
     out += _roofline_section(
@@ -852,9 +926,16 @@ def render_jsonl(lines, top=10):
     out += _memory_section(mem)
 
     anoms = [{"what": e.get("what"), "array": e.get("array"),
-              "step": e.get("step"), "ts_us": e.get("ts_us", 0)}
+              "step": e.get("step"), "trace": e.get("trace"),
+              "ts_us": e.get("ts_us", 0)}
              for e in events if e.get("kind") == "anomaly"]
     out += _anomaly_section(anoms)
+    out += _train_health_section(
+        counters,
+        [(name, dict(labels), val)
+         for (name, labels), val in gauges.items()],
+        [e for e in events
+         if e.get("kind") in ("train.health", "train.health.ckpt")])
     out += _lint_section(counters,
                          [e for e in events
                           if e.get("kind") == "lint.finding"])

@@ -25,6 +25,11 @@ ranks, sorted series, no wall-clock reads):
 * **metric-divergence detection** — per-rank loss/eval-metric/grad-norm
   drift past a leave-one-out z-score threshold (a diverging rank means
   a bad data shard or silent corruption, not load);
+* **training-health attribution** — each rank's ok/degraded/diverged
+  state and fired rules from the ``train.health.*`` gauges, plus which
+  rank's detector fired *first* (``train.health.first_firing`` carries
+  the firing's step index, so the origin is ordered even after the
+  blast radius trips every peer);
 * **dead-rank timeline** — dump-staleness gaps (wall-clock meta),
   ``dead_node`` events from survivors, ``recovery.*`` counters and the
   re-exec generation per rank;
@@ -54,10 +59,14 @@ STRAGGLER_PCT = 20.0     # mean-wall excess over fleet median that flags
 _DISPERSION_FLOOR = 0.05  # leave-one-out z denominator floor (fraction)
 
 # divergence is judged on correctness-shaped series only (loss, eval
-# metrics, monitored tensors, anomaly trips) — load-shaped series
-# (queue depths, walls) differ across ranks legitimately
-_DIVERGENCE_GAUGES = ("monitor.stat",)
-_DIVERGENCE_COUNTERS = ("sentinel.anomalies",)
+# metrics, monitored tensors, anomaly trips, the training-health plane's
+# live per-step stats) — load-shaped series (queue depths, walls)
+# differ across ranks legitimately
+_DIVERGENCE_GAUGES = ("monitor.stat", "train.health.grad_norm",
+                      "train.health.update_ratio", "train.health.loss")
+_DIVERGENCE_COUNTERS = ("sentinel.anomalies", "train.health.firings")
+
+_HEALTH_STATE_NAMES = {0: "ok", 1: "degraded", 2: "diverged"}
 
 
 def _fleet_mod():
@@ -375,6 +384,42 @@ def divergence(ranks, z_threshold=DEFAULT_Z):
     return flags
 
 
+def train_health(ranks):
+    """Per-rank training-health attribution from the ``train.health.*``
+    gauges every snapshot/jsonl/crash dump carries: each rank's
+    ok/degraded/diverged state, its fired rules, and — the question an
+    operator actually asks — WHICH rank's detector fired first.
+    ``train.health.first_firing{rule=...}`` records the observation
+    (step) index of a rule's first firing on that rank, so the fleet
+    minimum names the sick rank even when the blast radius later trips
+    every peer."""
+    doc = {"by_rank": {}, "first": None}
+    for r in ranks:
+        key = str(r["rank"])
+        state = None
+        rules = {}
+        for rec in r["gauges"]:
+            if rec["name"] == "train.health.state":
+                state = int(rec["value"])
+            elif rec["name"] == "train.health.first_firing":
+                rule = rec["labels"].get("rule", "?")
+                rules[rule] = int(rec["value"])
+        if state is None and not rules:
+            continue
+        state = state or 0
+        doc["by_rank"][key] = {
+            "state": state,
+            "name": _HEALTH_STATE_NAMES.get(state, str(state)),
+            "rules": rules}
+    firsts = [(n, key, rule)
+              for key, rec in doc["by_rank"].items()
+              for rule, n in rec["rules"].items()]
+    if firsts:
+        n, rank, rule = min(firsts)
+        doc["first"] = {"rank": rank, "rule": rule, "observation": n}
+    return doc
+
+
 def dead_rank_timeline(ranks, gap_seconds=DEFAULT_GAP_S):
     """Stale dumps + survivor-reported deaths + recovery counters."""
     doc = {"stale_ranks": [], "lag_seconds": {}, "reported_dead": [],
@@ -460,6 +505,7 @@ def build(ranks, z_threshold=DEFAULT_Z, gap_seconds=DEFAULT_GAP_S):
         "generations": {str(r["rank"]): r["generation"] for r in ranks},
         "step": steps,
         "divergence": divergence(ranks, z_threshold),
+        "train_health": train_health(ranks),
         "dead": dead_rank_timeline(ranks, gap_seconds),
         "serving": serving_rollup(ranks, merged),
         "merged": merged,
@@ -468,6 +514,11 @@ def build(ranks, z_threshold=DEFAULT_Z, gap_seconds=DEFAULT_GAP_S):
     if steps["spread_p99_over_p50"] is not None:
         doc["series"]["step.wall.p99_over_p50"] = \
             steps["spread_p99_over_p50"]
+    if doc["train_health"]["by_rank"]:
+        # worst rank's health state as a tracked fleet series (0 ok /
+        # 1 degraded / 2 diverged) — perfwatch --fleet flags any climb
+        doc["series"]["train.health.state.max"] = float(max(
+            rec["state"] for rec in doc["train_health"]["by_rank"].values()))
     return doc
 
 
@@ -523,6 +574,24 @@ def render(doc, z_threshold=DEFAULT_Z, gap_seconds=DEFAULT_GAP_S):
     else:
         out.append("  none")
     out.append("")
+
+    th = doc.get("train_health") or {}
+    if th.get("by_rank"):
+        out.append("training health:")
+        for rank in sorted(th["by_rank"], key=int):
+            rec = th["by_rank"][rank]
+            rules = ", ".join(
+                f"{rule}@{rec['rules'][rule]}"
+                for rule in sorted(rec["rules"],
+                                   key=lambda x: rec["rules"][x])) \
+                or "no rules fired"
+            tag = rec["name"].upper() if rec["state"] else rec["name"]
+            out.append(f"  rank {rank}: {tag} ({rules})")
+        if th.get("first"):
+            f = th["first"]
+            out.append(f"  FIRST DIVERGED: rank {f['rank']} — "
+                       f"{f['rule']} at observation {f['observation']}")
+        out.append("")
 
     dead = doc["dead"]
     out.append("dead-rank timeline:")

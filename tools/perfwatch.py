@@ -82,6 +82,9 @@ _ROUND_RE = re.compile(r"r(\d+)")
 # spread/imbalance shaped: smaller is healthier)
 FLEET_SERIES_DIRECTIONS = {
     "step.wall.p99_over_p50": "down",
+    # worst-rank training-health state (0 ok / 1 degraded / 2 diverged)
+    # from the health plane via fleetstat --json
+    "train.health.state.max": "down",
 }
 
 
