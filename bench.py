@@ -533,6 +533,184 @@ def measure_decode_batch_variant():
             rows["speedup_8v1"] = round(
                 rows["slots8_tokens_per_sec"]
                 / rows["slots1_tokens_per_sec"], 2)
+
+        # --- TTFT vs prompt length: chunked prefill against the
+        # token-at-a-time path (ISSUE 18).  Long-context decode symbol
+        # (capacity past the 2048-token prompt) on one slot, one
+        # request in flight, so ttft is pure prefill latency.
+        try:
+            TCAP = 2048 + 64
+            chunk = mx.serve.default_prefill_chunk()
+            lsym = tfm.get_symbol(vocab_size=V, d_model=D, n_layer=L,
+                                  n_head=H, seq_len=8,
+                                  include_loss=False, max_seq_len=TCAP)
+            lmod = mx.mod.Module(lsym, label_names=[])
+            lmod.bind([("data", (1, 8))], None, for_training=False)
+            np.random.seed(7)
+            lmod.init_params(mx.initializer.Xavier(rnd_type="gaussian",
+                                                   magnitude=2))
+            largs, _ = lmod.get_params()
+
+            def lgen(s):
+                return tfm.get_decode_symbol(
+                    vocab_size=V, d_model=D, n_layer=L, n_head=H,
+                    capacity=TCAP, per_slot=True, step_len=s,
+                    max_seq_len=TCAP)
+
+            plens = (64, 512, 2048)
+            rs = np.random.RandomState(11)
+            prompts = {n: rs.randint(0, V, n).tolist() for n in plens}
+            curve = {str(n): {} for n in plens}
+            for tag, ch in (("nochunk", 1), ("chunk", chunk)):
+                sched = mx.serve.serve_decoder(
+                    lgen(1), largs, name=f"decb_ttft_{tag}",
+                    ladder=[1], start=True,
+                    symbol_gen=lgen if ch > 1 else None,
+                    prefill_chunk=ch)
+                for n in plens:
+                    h = sched.submit(prompts[n], max_new_tokens=2)
+                    h.result(timeout=600)
+                    curve[str(n)][f"{tag}_ms"] = round(h.ttft * 1e3, 2)
+                sched.stop()
+            for n in plens:
+                c = curve[str(n)]
+                c["speedup"] = round(c["nochunk_ms"] / c["chunk_ms"], 2)
+            rows["ttft_curve"] = curve
+            rows["ttft_prefill_chunk"] = chunk
+            rows["ttft_2048_ms"] = curve["2048"]["chunk_ms"]
+            rows["ttft_2048_speedup"] = curve["2048"]["speedup"]
+        except Exception as e:      # sub-row must not sink the variant
+            rows["ttft_error"] = f"{type(e).__name__}: {e}"
+
+        # --- speculative decoding sub-row: a seeded draft/target pair
+        # trained to memorise a deterministic Markov map (next token is
+        # an affine function of the current one) so acceptance is high
+        # by construction; the speedup is spec vs non-spec tokens/s at
+        # slots 8 on the SAME trained target.  MXNET_SERVE_SPEC_DRAFT
+        # picks the draft preset ("<d_model>x<n_layer>", "off" skips).
+        draft_preset = os.environ.get("MXNET_SERVE_SPEC_DRAFT", "64x1")
+        try:
+            if draft_preset.strip().lower() in ("off", "none", "0", ""):
+                rows["spec_decode"] = {"skipped":
+                                       f"MXNET_SERVE_SPEC_DRAFT="
+                                       f"{draft_preset}"}
+            else:
+                dd, dl = (int(x) for x in
+                          draft_preset.lower().split("x"))
+                SV, ST, SCAP = 128, 16, 64
+                TD, TL, SH = 512, 6, 8
+                K = mx.serve.default_spec_k()
+
+                def _walk(start, length):
+                    out, cur = [], int(start) % SV
+                    for _ in range(length):
+                        out.append(cur)
+                        cur = (7 * cur + 11) % SV
+                    return out
+
+                def _markov_iter(B, n_batches, seed):
+                    it = tfm.SyntheticLMIter(SV, B, ST, n_batches,
+                                             seed)
+                    rs2 = np.random.RandomState(seed)
+                    for i in range(n_batches):
+                        s = np.stack([
+                            _walk(rs2.randint(0, SV), ST + 1)
+                            for _ in range(B)]).astype(np.int32)
+                        it._data[i] = mx.nd.array(s[:, :ST])
+                        it._label[i] = mx.nd.array(
+                            s[:, 1:].reshape(-1).astype(np.float32))
+                    return it
+
+                def _fit(d_model, n_layer, seed):
+                    np.random.seed(seed)
+                    m = mx.mod.Module(tfm.get_symbol(
+                        vocab_size=SV, d_model=d_model,
+                        n_layer=n_layer, n_head=SH, seq_len=ST,
+                        include_loss=True, max_seq_len=SCAP))
+                    m.fit(_markov_iter(16, 32, seed), num_epoch=6,
+                          optimizer="sgd",
+                          optimizer_params=(("learning_rate", 0.1),
+                                            ("momentum", 0.9)),
+                          initializer=mx.initializer.Xavier(
+                              rnd_type="gaussian", magnitude=2))
+                    a, _ = m.get_params()
+                    return a
+
+                def _spec_gen(d_model, n_layer):
+                    return lambda s: tfm.get_decode_symbol(
+                        vocab_size=SV, d_model=d_model,
+                        n_layer=n_layer, n_head=SH, capacity=SCAP,
+                        per_slot=True, step_len=s, max_seq_len=SCAP)
+
+                targs = _fit(TD, TL, seed=21)
+                dargs = _fit(dd, dl, seed=22)
+                sprompts = [_walk(3 + 11 * i, 8) for i in range(8)]
+                tps = {}
+                acceptance = None
+                for tag in ("spec", "base"):
+                    tgen = _spec_gen(TD, TL)
+                    sched = mx.serve.serve_decoder(
+                        tgen(1), targs, name=f"decb_{tag}", ladder=[8],
+                        start=True, symbol_gen=tgen, prefill_chunk=8,
+                        draft_symbol_gen=(_spec_gen(dd, dl)
+                                          if tag == "spec" else None),
+                        draft_params=(dargs if tag == "spec"
+                                      else None),
+                        spec_k=K if tag == "spec" else None)
+                    hs = [sched.submit(p, max_new_tokens=32)
+                          for p in sprompts]
+                    t0 = time.perf_counter()
+                    toks = sum(len(h.result(timeout=600)) for h in hs)
+                    dt = time.perf_counter() - t0
+                    st = sched.stats()
+                    sched.stop()
+                    tps[tag] = toks / dt if dt else None
+                    if tag == "spec":
+                        acceptance = st["spec"]["acceptance"]
+                rows["spec_decode"] = {
+                    "draft": draft_preset, "k": K,
+                    "acceptance": acceptance,
+                    "tokens_per_sec": round(tps["spec"], 1),
+                    "base_tokens_per_sec": round(tps["base"], 1),
+                    "model": {"vocab": SV, "d_model": TD, "layers": TL,
+                              "heads": SH, "capacity": SCAP},
+                }
+                if tps.get("spec") and tps.get("base"):
+                    rows["spec_speedup"] = round(
+                        tps["spec"] / tps["base"], 2)
+        except Exception as e:      # sub-row must not sink the variant
+            rows["spec_decode"] = {"error": f"{type(e).__name__}: {e}"}
+
+        # --- prefix-cache hit-rate point: 8 requests sharing a system
+        # prefix via submit(prefix_id=); the first is the cold capture,
+        # the rest join at cursor C off the stored rows.
+        try:
+            pr = mx.serve.serve_decoder(
+                dec_sym, args, name="decb_prefix", ladder=[4],
+                start=True, prefix_cache_mb=8)
+            rsp = np.random.RandomState(5)
+            shared = rsp.randint(0, V, CAP // 2).tolist()
+            cold_ms, warm = None, []
+            for i in range(8):
+                h = pr.submit(shared + [1 + i], max_new_tokens=4,
+                              prefix_id="bench-sys-prompt")
+                h.result(timeout=600)
+                if i == 0:
+                    cold_ms = round(h.ttft * 1e3, 2)
+                else:
+                    warm.append(h.ttft * 1e3)
+            pst = pr.stats()["prefix"]
+            pr.stop()
+            rows["prefix_hit_rate"] = pst["hit_rate"]
+            rows["prefix"] = {
+                "hits": pst["hits"], "misses": pst["misses"],
+                "entries": pst["entries"], "bytes": pst["bytes"],
+                "cold_ttft_ms": cold_ms,
+                "warm_ttft_ms": round(float(np.mean(warm)), 2),
+            }
+        except Exception as e:      # sub-row must not sink the variant
+            rows["prefix_error"] = f"{type(e).__name__}: {e}"
+
         rows.update({
             "model": {"vocab": V, "d_model": D, "layers": L, "heads": H,
                       "capacity": CAP},

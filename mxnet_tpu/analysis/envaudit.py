@@ -5,7 +5,8 @@ silently — a knob nobody can discover is a knob that ships
 half-supported. This audit keeps the two in lockstep, ast-based so it
 survives formatting:
 
-* **code scan** — every ``*.py`` under ``mxnet_tpu/`` is parsed and
+* **code scan** — every ``*.py`` under ``mxnet_tpu/`` (plus the repo's
+  ``bench.py``, which reads its own knobs) is parsed and
   every string constant that IS an ``MXNET_*`` name is collected: the
   codebase's convention is that such a literal is always an environ
   key — ``os.environ.get/[...]``, ``os.getenv``, the ``_env_int``-style
@@ -52,25 +53,33 @@ def _collect_prefix(expr, prefixes):
                 prefixes.add(m.group(0))
 
 
-def scan_code(root):
-    """(exact_names, prefixes) of MXNET_* environ keys under ``root``."""
+def _scan_file(path, exact, prefixes):
+    try:
+        with open(path) as f:
+            tree = ast.parse(f.read(), filename=path)
+    except (OSError, SyntaxError):
+        return
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Constant):
+            _collect_keys(node, exact)
+        elif isinstance(node, ast.JoinedStr):
+            _collect_prefix(node, prefixes)
+
+
+def scan_code(root, extra_files=()):
+    """(exact_names, prefixes) of MXNET_* environ keys under ``root``
+    plus any ``extra_files`` (bench.py reads knobs too — e.g. the
+    ``MXNET_SERVE_SPEC_DRAFT`` draft preset — and those must stay
+    documented like everything else)."""
     exact, prefixes = set(), set()
     for dirpath, dirnames, filenames in os.walk(root):
         dirnames[:] = [d for d in dirnames if d != "__pycache__"]
         for fname in filenames:
             if not fname.endswith(".py"):
                 continue
-            path = os.path.join(dirpath, fname)
-            try:
-                with open(path) as f:
-                    tree = ast.parse(f.read(), filename=path)
-            except SyntaxError:
-                continue
-            for node in ast.walk(tree):
-                if isinstance(node, ast.Constant):
-                    _collect_keys(node, exact)
-                elif isinstance(node, ast.JoinedStr):
-                    _collect_prefix(node, prefixes)
+            _scan_file(os.path.join(dirpath, fname), exact, prefixes)
+    for path in extra_files:
+        _scan_file(path, exact, prefixes)
     return exact, prefixes
 
 
@@ -90,7 +99,9 @@ def audit(repo_root):
     """
     code_root = os.path.join(repo_root, "mxnet_tpu")
     doc_path = os.path.join(repo_root, "docs", "env_var.md")
-    exact, prefixes = scan_code(code_root)
+    exact, prefixes = scan_code(
+        code_root,
+        extra_files=(os.path.join(repo_root, "bench.py"),))
     doc = scan_docs(doc_path)
 
     def doc_covers(name):

@@ -94,6 +94,13 @@ def state_multiplier(optimizer):
     return OPTIMIZER_STATE_MULT.get(str(name).lower(), 1.0)
 
 
+def _opdef_of(node):
+    try:
+        return node.opdef()
+    except Exception:
+        return None
+
+
 def _nelems(shape):
     n = 1
     for d in shape:
@@ -108,10 +115,25 @@ def _itemsize(name):
         return 4
 
 
+def _default_prefix_cache_bytes():
+    """The serve-plane prefix store's byte budget, charged only when
+    the operator armed it (``MXNET_SERVE_PREFIX_CACHE_MB`` set in the
+    environment): plans for non-serving bindings stay byte-identical."""
+    import os
+    raw = os.environ.get("MXNET_SERVE_PREFIX_CACHE_MB")
+    if raw is None:
+        return 0
+    try:
+        return int(max(0.0, float(raw)) * (1 << 20))
+    except ValueError:
+        return 0
+
+
 def plan_symbol(symbol, shapes, policy="none", for_training=True,
                 optimizer="sgd_mom", compute_dtype=None, n_data=1,
                 spmd_plan=None, zero=False, donation=True,
-                fixed_params=(), state_bytes=None, batch_axis=0):
+                fixed_params=(), state_bytes=None, batch_axis=0,
+                prefix_cache_bytes=None):
     """Static peak-HBM plan for one (symbol, input shapes) binding.
 
     ``shapes`` maps data/label names to concrete shapes (the same dict
@@ -127,6 +149,13 @@ def plan_symbol(symbol, shapes, policy="none", for_training=True,
     optimizer-multiplier estimate with an exact figure (the exec group
     knows its armed state tree). ``donation=False`` adds the
     double-buffer params+state a non-donating (staged) update pays.
+
+    ``prefix_cache_bytes`` charges the serving prefix store's byte
+    budget (``serve.prefix.PrefixStore``) against slot-pooled decode
+    bindings — ``None`` reads ``MXNET_SERVE_PREFIX_CACHE_MB`` when set
+    (else 0), so ME801 gates HBM with the store's worst case included
+    before anything compiles. The charge applies only to graphs with a
+    ``per_slot`` stateful decode op (the store snapshots their rows).
     """
     shapes = dict(shapes)
     arg_shapes, out_shapes, aux_shapes = symbol.infer_shape(**shapes)
@@ -202,6 +231,20 @@ def plan_symbol(symbol, shapes, policy="none", for_training=True,
                     dtypes.get((id(inp), idx), "float32"))
         kv_charges.append((n.op, nb))
     kv_cache_bytes = sum(nb for _, nb in kv_charges)
+    # prefix-store accounting: the serving plane's prefix cache holds
+    # snapshots of these same rows under its own byte budget — a
+    # slot-pooled decode binding pays the full budget up front so ME801
+    # trips BEFORE the store could grow into an OOM
+    from ..base import parse_bool as _parse_bool
+    per_slot_decode = any(
+        not n.is_variable and _parse_bool(n.attrs.get("per_slot", False))
+        and getattr(_opdef_of(n), "stateful_infer", False)
+        for n in nodes)
+    prefix_store_bytes = 0
+    if per_slot_decode and kv_charges:
+        prefix_store_bytes = int(prefix_cache_bytes
+                                 if prefix_cache_bytes is not None
+                                 else _default_prefix_cache_bytes())
     output_bytes = sum(_nelems(s) * 4 for s in out_shapes
                        if s is not None)
 
@@ -213,6 +256,7 @@ def plan_symbol(symbol, shapes, policy="none", for_training=True,
 
     for _op, _nb in kv_charges:
         charge(_op, _nb)
+    charge("prefix_store", prefix_store_bytes)
 
     residual = 0
     if for_training:
@@ -233,7 +277,7 @@ def plan_symbol(symbol, shapes, policy="none", for_training=True,
     state_dev = state_bytes // n_state_shards
     nd = max(1, int(n_data))
 
-    fixed_dev = param_bytes + state_dev + aux_bytes
+    fixed_dev = param_bytes + state_dev + aux_bytes + prefix_store_bytes
     linear_dev = (batch_bytes + residual + output_bytes) // nd
     peak_dev = fixed_dev + grad_bytes + linear_dev
     if for_training and not donation:
@@ -260,6 +304,7 @@ def plan_symbol(symbol, shapes, policy="none", for_training=True,
         "state_bytes_per_device": int(state_dev),
         "aux_bytes": int(aux_bytes),
         "kv_cache_bytes": int(kv_cache_bytes),
+        "prefix_store_bytes": int(prefix_store_bytes),
         "batch_bytes": int(batch_bytes),
         "residual_bytes": int(residual),
         "output_bytes": int(output_bytes),

@@ -204,18 +204,19 @@ def get_decode_symbol(vocab_size=256, d_model=64, n_layer=2, n_head=4,
     unchanged. ``pos_embed='learned'`` adds a ``pos_ids`` input
     (``(step_len,)`` absolute positions — ``KVCacheDecoder`` feeds it).
 
-    ``per_slot=True`` builds the slot-pooled continuous-batching graph
-    (``step_len`` must stay 1): every batch row is an independent decode
-    slot with its own (B, 1) cache cursor, so one pinned program
-    advances B sequences at B different positions per dispatch —
-    ``BatchedKVCacheDecoder`` drives it, ``serve.decode`` schedules it.
-    With learned positions the ``pos_ids`` input becomes ``(B, 1)``
+    ``per_slot=True`` builds the slot-pooled continuous-batching graph:
+    every batch row is an independent decode slot with its own (B, 1)
+    cache cursor, so one pinned program advances B sequences at B
+    different positions per dispatch — ``BatchedKVCacheDecoder`` drives
+    it, ``serve.decode`` schedules it. ``step_len`` > 1 builds the
+    S-token *window* variant of the same graph (chunked prefill and
+    speculative verify): each slot consumes S tokens starting at its own
+    cursor, with within-window causal masking, and the logits row ``s``
+    predicts the token after stream position ``cursor + s``. With
+    learned positions the ``pos_ids`` input becomes ``(B, step_len)``
     per-slot absolute positions.
     """
     _validate(vocab_size, d_model, n_head, pos_embed)
-    if per_slot and step_len != 1:
-        raise MXNetError("per_slot decode advances one token per slot "
-                         f"per dispatch (step_len={step_len})")
     capacity = capacity or default_cache_capacity()
     max_seq_len = max_seq_len or capacity
     S = step_len
@@ -373,6 +374,15 @@ class BatchedKVCacheDecoder:
     HERE, naming the offending slots, before the masked write would
     no-op) and the per-slot ``pos_ids`` feed for learned positions.
 
+    Besides the steady-state S=1 program, a driver can carry *window*
+    modules (``add_window``): same parameters, same shared aux cells,
+    ``step_len=S`` graphs that advance every slot by S positions per
+    dispatch — chunked prefill and speculative verify ride these.
+    ``step`` dispatches on ``tokens.shape[1]``. ``rewind`` pokes a
+    slot's device cursor to an arbitrary position (the join-style aux
+    update, never a compile) — the seam for padded final prefill
+    chunks, prefix-cache joins at cursor C, and speculative rollback.
+
     ``serve.decode.DecodeScheduler`` builds the continuous-batching
     front end (admission, retirement, streaming, rung ladder) on top of
     one of these per slot rung.
@@ -387,11 +397,31 @@ class BatchedKVCacheDecoder:
         self.slots = int(slots)
         self.pos = np.zeros(self.slots, np.int64)    # device-cursor mirror
         self.active = np.zeros(self.slots, bool)
+        self._windows = {}                           # step_len -> module
+
+    def add_window(self, step_len, module):
+        """Register an S-token window module. It MUST have been bound
+        with ``shared_module=`` this driver's S=1 module (or a module
+        sharing its cells) so both programs advance the SAME device
+        cache/cursor cells — the executor-group aux-sharing rule makes
+        that automatic when slot count and capacity agree."""
+        self._windows[int(step_len)] = module
+
+    @property
+    def window_lens(self):
+        return sorted(self._windows)
 
     def _cursor_cells(self):
         exe = self._mod._exec_group.executor
         return [cell for nm, cell in exe.aux_dict.items()
                 if nm.endswith("cache_pos")]
+
+    def _kv_cells(self):
+        """(name, cell) for every layer's K and V cache, in graph
+        order — the prefix store snapshots/restores these rows."""
+        exe = self._mod._exec_group.executor
+        return [(nm, cell) for nm, cell in exe.aux_dict.items()
+                if nm.endswith("k_cache") or nm.endswith("v_cache")]
 
     def free_slots(self):
         """Slot indices with no active sequence."""
@@ -420,38 +450,92 @@ class BatchedKVCacheDecoder:
         keeps advancing as a masked no-op until the next join."""
         self.active[int(slot)] = False
 
-    def overflowing(self):
-        """Active slots whose NEXT step would pass capacity — the
-        scheduler retires these (alone) before dispatch."""
+    def rewind(self, slot, pos):
+        """Poke ``slot``'s device cursor to ``pos`` across every layer
+        (the same tiny in-place aux update as ``join`` — never a
+        compile). Used to discard the tail of a window after dispatch:
+        padded final prefill chunks, rejected speculative proposals, and
+        decoding slots riding a chunk dispatch all rewind to the stream
+        position they actually reached. Cache rows past ``pos`` become
+        garbage nobody attends (exp(-inf)-masked) and are rewritten
+        before first read — the same bit-clean contract as ``join``."""
+        self.rewind_many([slot], [pos])
+
+    def rewind_many(self, slots, positions):
+        """Batched ``rewind``: ONE aux update per layer for any number
+        of slots (the chunk-dispatch epilogue touches most of a rung)."""
+        import jax.numpy as jnp
+        if not len(slots):
+            return
+        idx = np.asarray(slots, np.int32)
+        val = np.asarray(positions, np.int32)
+        for cell in self._cursor_cells():
+            cell._set(cell.asjax().at[idx, 0].set(jnp.asarray(val)))
+        self.pos[idx] = val.astype(np.int64)
+
+    def capture_rows(self, slot, length):
+        """Snapshot ``slot``'s first ``length`` cache positions across
+        every layer: ``{cell_name: (length, ...) np.ndarray}``. The
+        prefix store keeps these host-side under its byte budget."""
+        slot = int(slot)
+        return {nm: np.asarray(cell.asjax()[slot, :, :int(length)])
+                for nm, cell in self._kv_cells()}
+
+    def restore_rows(self, slot, rows):
+        """Write captured rows back into ``slot`` (prefix-cache join):
+        one in-place aux update per layer cache, bitwise the values
+        ``capture_rows`` saw. The caller rewinds/sets the cursor."""
+        slot = int(slot)
+        for nm, cell in self._kv_cells():
+            row = rows[nm]
+            arr = cell.asjax()
+            cell._set(arr.at[slot, :, :row.shape[1]].set(
+                np.asarray(row, dtype=str(arr.dtype))))
+
+    def overflowing(self, window=1):
+        """Active slots whose next ``window``-token dispatch would pass
+        capacity — the scheduler retires these (alone) before dispatch."""
         return [i for i in range(self.slots)
-                if self.active[i] and self.pos[i] + 1 > self.capacity]
+                if self.active[i] and self.pos[i] + window > self.capacity]
 
     def step(self, tokens):
-        """Advance every slot by one token: ``tokens`` (slots,) or
-        (slots, 1) int ids (retired slots ride any valid id, 0 by
-        convention) -> logits (slots, 1, V) NDArray. Raises per slot
+        """Advance every slot by one S-token window: ``tokens``
+        (slots,) or (slots, S) int ids (retired slots ride any valid
+        id, 0 by convention) -> logits (slots, S, V) NDArray. S=1 runs
+        the steady-state decode program; S>1 dispatches the matching
+        window module registered via ``add_window``. Raises per slot
         BEFORE dispatch when an active slot would overflow its cache —
         batchmates are untouched (nothing was dispatched)."""
         from .. import ndarray as nd
         from ..io import DataBatch
-        over = self.overflowing()
-        if over:
-            raise MXNetError(
-                f"KV cache overflow in slot(s) {over}: position "
-                f"{[int(self.pos[i]) for i in over]} + 1 exceeds "
-                f"capacity {self.capacity}; retire the sequence(s) or "
-                "re-bind with a larger capacity")
         tokens = np.asarray(tokens)
         if tokens.ndim == 1:
             tokens = tokens[:, None]
-        if tokens.shape != (self.slots, 1):
-            raise MXNetError(f"step() wants ({self.slots}, 1) tokens, "
+        S = tokens.shape[1]
+        if tokens.shape != (self.slots, S) or S < 1:
+            raise MXNetError(f"step() wants ({self.slots}, S) tokens, "
                              f"got {tokens.shape}")
+        if S == 1:
+            mod = self._mod
+        else:
+            mod = self._windows.get(S)
+            if mod is None:
+                raise MXNetError(
+                    f"no window module for step_len={S} (have "
+                    f"{self.window_lens}); add_window() it at engine "
+                    "warmup — steady-state dispatch never compiles")
+        over = self.overflowing(S)
+        if over:
+            raise MXNetError(
+                f"KV cache overflow in slot(s) {over}: position "
+                f"{[int(self.pos[i]) for i in over]} + {S} exceeds "
+                f"capacity {self.capacity}; retire the sequence(s) or "
+                "re-bind with a larger capacity")
         data = [nd.array(tokens.astype(np.int32))]
         if self.pos_embed == "learned":
+            pos = self.pos[:, None] + np.arange(S)[None, :]
             data.append(nd.array(
-                np.minimum(self.pos, self.capacity - 1)
-                .astype(np.float32)[:, None]))
-        self._mod.forward(DataBatch(data=data, label=[]), is_train=False)
-        self.pos += 1            # the program advances EVERY slot
-        return self._mod.get_outputs()[0]
+                np.minimum(pos, self.capacity - 1).astype(np.float32)))
+        mod.forward(DataBatch(data=data, label=[]), is_train=False)
+        self.pos += S            # the program advances EVERY slot
+        return mod.get_outputs()[0]

@@ -637,12 +637,14 @@ def _register_attention():
 #   sequence position (the single-session KVCacheDecoder path);
 # * ``per_slot=True`` — a (B, 1) int32 cursor VECTOR: each batch row is
 #   an independent decode *slot* at its own position in its own slice
-#   of the slot-pooled (B, H, C, Dh) cache. Writes land per slot
+#   of the slot-pooled (B, H, C, Dh) cache. S=1 writes land per slot
 #   through a one-hot select (bit-exact: untouched positions keep their
-#   cache value verbatim), the causal mask is per slot
-#   (key_pos <= cursor[b]), and the softmax runs over each slot's own
-#   prefix — so ONE pinned program advances B independent sequences by
-#   one token per dispatch. A retired slot keeps advancing harmlessly
+#   cache value verbatim); S>1 windows (chunked prefill, speculative
+#   verify) land through a per-row dynamic_update_slice. The causal
+#   mask is per slot AND per window offset
+#   (key_pos <= cursor[b] + s), and the softmax runs over each slot's
+#   own prefix — so ONE pinned program advances B independent staggered
+#   sequences by S tokens per dispatch. A retired slot keeps advancing harmlessly
 #   (its row is garbage nobody reads); rejoining resets only the
 #   cursor, because positions beyond a slot's prefix are exp(-inf)-
 #   masked to exactly zero weight and every attended position has been
@@ -702,16 +704,17 @@ def _attention_decode_fwd(attrs, inputs, aux, is_train, rng):
 
 
 def _attention_decode_per_slot(attrs, q, k, v, k_cache, v_cache, cursor):
-    """The slot-pooled lowering: cursor (B, 1), one token per slot."""
+    """The slot-pooled lowering: cursor (B, 1), an S-token window per
+    slot. S=1 is the steady-state decode program (one-hot cache write,
+    bit-pinned since the slot pool landed); S>1 is the chunked-prefill /
+    speculative-verify window — each slot writes its S tokens at its OWN
+    cursor via a per-row ``dynamic_update_slice`` and the causal mask
+    runs over ``cursor[b] + arange(S)``, so one pinned program advances
+    B staggered sequences by S positions per dispatch."""
     from .base import parse_bool, parse_float
     from .ops.nn import rope_apply
 
     B, H, S, Dh = q.shape
-    if S != 1:
-        raise MXNetError(
-            f"attention_decode(per_slot=True) advances one token per "
-            f"slot per dispatch (got S={S}); iteration-level batching "
-            "feeds (B, 1) token windows")
     capacity = k_cache.shape[2]
     pos = cursor.reshape((B,)).astype(jnp.int32)          # (B,)
     if not isinstance(pos, jax.core.Tracer):
@@ -729,17 +732,36 @@ def _attention_decode_per_slot(attrs, q, k, v, k_cache, v_cache, cursor):
         q = rope_apply(q, positions, base)
         k = rope_apply(k, positions, base)
     key_pos = jnp.arange(capacity)                         # (C,)
-    # one-hot per-slot write: jnp.where keeps untouched cache positions
-    # bit-identical and lands each slot's token at its own cursor; a
-    # cursor past capacity matches nothing (no clamped write)
-    write = (key_pos[None, :] == pos[:, None])[:, None, :, None]
-    k_cache = jnp.where(write, k.astype(k_cache.dtype), k_cache)
-    v_cache = jnp.where(write, v.astype(v_cache.dtype), v_cache)
+    if S == 1:
+        # one-hot per-slot write: jnp.where keeps untouched cache
+        # positions bit-identical and lands each slot's token at its own
+        # cursor; a cursor past capacity matches nothing (no clamped
+        # write). Kept verbatim for S=1 so the steady-state decode
+        # program stays bit-identical to the pre-window pin.
+        write = (key_pos[None, :] == pos[:, None])[:, None, :, None]
+        k_cache = jnp.where(write, k.astype(k_cache.dtype), k_cache)
+        v_cache = jnp.where(write, v.astype(v_cache.dtype), v_cache)
+    else:
+        # window write: each slot lands its S rows at its own cursor.
+        # vmap over B means a slot only ever writes its OWN cache row,
+        # so the clamp DUS applies near capacity can't corrupt a
+        # batchmate — the driver guards pos + S <= capacity for every
+        # slot that is still live.
+        def _write_row(cache_row, new_row, p):
+            return jax.lax.dynamic_update_slice(cache_row, new_row,
+                                                (0, p, 0))
+        k_cache = jax.vmap(_write_row)(k_cache,
+                                       k.astype(k_cache.dtype), pos)
+        v_cache = jax.vmap(_write_row)(v_cache,
+                                       v.astype(v_cache.dtype), pos)
     logits = jnp.einsum("bhqd,bhkd->bhqk", q, k_cache.astype(q.dtype),
                         precision=jax.lax.Precision.HIGHEST,
                         preferred_element_type=jnp.float32) * scale
-    # per-slot prefix mask: slot b attends key_pos <= cursor[b]
-    mask = (key_pos[None, :] <= pos[:, None])[:, None, None, :]
+    # per-slot causal mask: query s of slot b sits at stream position
+    # cursor[b] + s and attends key_pos <= that — within-window
+    # causality falls out of the same comparison
+    q_pos = pos[:, None] + jnp.arange(S)[None, :]          # (B, S)
+    mask = (key_pos[None, None, :] <= q_pos[:, :, None])[:, None]
     logits = jnp.where(mask, logits, -jnp.inf)
     probs = jax.nn.softmax(logits, axis=-1)
     out = jnp.einsum("bhqk,bhkd->bhqd", probs,
@@ -764,9 +786,27 @@ def _attention_decode_infer(attrs, in_shapes):
     return [q_s, q_s, q_s], [q_s], [cache, cache, cur]
 
 
+#: the S>1 window path (chunked prefill / speculative verify): q and
+#: the f32 out accumulator hold one 64-token chunk of head rows while
+#: two cache blocks stream K-major — declared and PK9xx-validated at
+#: registration so the decode window variant is gated by the same
+#: import-time contract as the Pallas kernels, even while its lowering
+#: is the XLA composition
+_ATTENTION_DECODE_KSPEC = {
+    "tiles": [((64, 512), "float32"),      # q window (S=64 x Dh<=512)
+              ((128, 512), "float32"),     # k_cache block
+              ((128, 512), "float32"),     # v_cache block
+              ((64, 512), "float32")],     # f32 out accumulator
+    "dtypes": ("float32", "bfloat16", "float16"),
+}
+
+
 def _register_attention_decode():
     if "attention_decode" in OP_REGISTRY:
         return
+    from .analysis.kernelcheck import validate_kernel_spec
+    validate_kernel_spec("attention_decode", "window",
+                         _ATTENTION_DECODE_KSPEC)
     _register_op("attention_decode", inputs=("q", "k", "v"),
                  aux=("k_cache", "v_cache", "cache_pos"),
                  full=_attention_decode_fwd,

@@ -44,7 +44,10 @@ from .server import InferenceServer, serve
 from .warm import restore_server, save_server, server_payload
 from .loadgen import PoissonLoadGen, run_scripted
 from .decode import (DecodeEngine, DecodeHandle, DecodeScheduler,
-                     default_slot_ladder, serve_decoder)
+                     default_prefill_chunk, default_slot_ladder,
+                     default_spec_k, serve_decoder)
+from .prefix import PrefixStore, default_prefix_budget_bytes
+from .sampling import SamplingParams
 
 __all__ = ["MonotonicClock", "FakeClock", "BucketLadder",
            "QueueFullError", "ShedError", "CircuitOpenError",
@@ -54,4 +57,6 @@ __all__ = ["MonotonicClock", "FakeClock", "BucketLadder",
            "serve", "restore_server", "save_server", "server_payload",
            "PoissonLoadGen", "run_scripted", "DecodeEngine",
            "DecodeScheduler", "DecodeHandle", "default_slot_ladder",
+           "default_prefill_chunk", "default_spec_k", "PrefixStore",
+           "default_prefix_budget_bytes", "SamplingParams",
            "serve_decoder"]

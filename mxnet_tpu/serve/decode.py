@@ -18,14 +18,38 @@ pinned program per iteration:
   slots' cache rows + cursors between rung pools with eager per-row
   copies (no program-cache entries).
 * ``DecodeScheduler`` — iteration-level continuous batching on the
-  ``submit`` seam: prefill admission into free slots (prompt tokens
-  ride the iteration stream, one per dispatch, so the program shape
-  never changes), per-iteration retirement (EOS / max-new-tokens /
-  deadline / per-slot cache overflow — an overflowing slot fails ALONE,
-  batchmates keep decoding), greedy sampling, and streaming token
-  delivery through ``DecodeHandle`` callbacks. Two drive modes, same as
-  the server: ``start()`` (dispatch thread, real clock) and ``pump()``
-  (explicit iterations, FakeClock-deterministic).
+  ``submit`` seam: prefill admission into free slots, per-iteration
+  retirement (EOS / max-new-tokens / deadline / per-slot cache
+  overflow — an overflowing slot fails ALONE, batchmates keep
+  decoding), temperature/top-k/top-p sampling on a recorded
+  per-request rng chain (``SamplingParams``; default greedy), and
+  streaming token delivery through ``DecodeHandle`` callbacks. Two
+  drive modes, same as the server: ``start()`` (dispatch thread, real
+  clock) and ``pump()`` (explicit iterations, FakeClock-deterministic).
+
+Three decode fast paths ride the same rungs (all preserve the
+zero-steady-state-compile contract — every program they need is
+compiled and pinned at warmup):
+
+* **Chunked prefill** — each rung carries an S-token *window* program
+  (``MXNET_SERVE_PREFILL_CHUNK``, default 64) next to its S=1 decode
+  program, so a T-token prompt prefills in ⌈T/S⌉ dispatches instead of
+  T and TTFT goes near-flat in prompt length. Slots mid-decode ride a
+  chunk dispatch with one real token plus pads and REWIND their cursor
+  afterwards (a join-style aux poke), so mixed prefill/decode
+  iterations lose nothing.
+* **Prefix-cache reuse** — ``submit(prefix_id=...)`` names a shared
+  prompt prefix; the first completion snapshots its cache rows into a
+  ``PrefixStore`` (LRU under ``MXNET_SERVE_PREFIX_CACHE_MB``, charged
+  by the static memory planner) and later submits *join at cursor C*
+  with the rows written back — bitwise what a cold prefill computes.
+* **Speculative decoding** — a draft engine proposes
+  ``MXNET_SERVE_SPEC_K`` tokens per iteration (K cheap S=1 dispatches)
+  and the target verifies them in ONE S=K window dispatch over the
+  per-slot cursor vector (slots verify at staggered positions); exact
+  rejection sampling keeps the output distributionally identical to
+  target-only decode and bit-identical under greedy, with rejected
+  tails rolled back by cursor rewind on both engines.
 
 Per-sequence traces survive being batched with strangers: every
 sequence keeps its own session trace (root span
@@ -56,13 +80,19 @@ from ..base import MXNetError
 from ..io import DataDesc
 from .batching import BucketLadder, QueueFullError
 from .clock import MonotonicClock
+from .prefix import PrefixStore
+from .sampling import SamplingParams, sample_token, token_probs, \
+    speculative_verify
 
 __all__ = ["DecodeEngine", "DecodeScheduler", "DecodeHandle",
-           "default_slot_ladder", "serve_decoder"]
+           "default_slot_ladder", "default_prefill_chunk",
+           "default_spec_k", "serve_decoder"]
 
 log = logging.getLogger(__name__)
 
 _seq_ids = itertools.count()
+
+_GREEDY = SamplingParams()
 
 
 def _env_int(name, default):
@@ -70,6 +100,20 @@ def _env_int(name, default):
         return int(os.environ.get(name, "") or default)
     except ValueError:
         return default
+
+
+def default_prefill_chunk():
+    """``MXNET_SERVE_PREFILL_CHUNK`` (docs/env_var.md), default 64:
+    prompt tokens per prefill dispatch. 1 disables chunking (token-at-
+    a-time prefill, the pre-window behavior)."""
+    return max(1, _env_int("MXNET_SERVE_PREFILL_CHUNK", 64))
+
+
+def default_spec_k():
+    """``MXNET_SERVE_SPEC_K`` (docs/env_var.md), default 4: draft
+    tokens proposed (and verified in one window dispatch) per
+    speculative iteration."""
+    return max(2, _env_int("MXNET_SERVE_SPEC_K", 4))
 
 
 def default_slot_ladder():
@@ -90,14 +134,23 @@ def default_slot_ladder():
 
 
 class _Sequence:
-    """One admitted decode request's scheduling state."""
+    """One admitted decode request's scheduling state.
+
+    The *stream* is ``prompt ++ generated``; ``fed`` counts stream
+    tokens whose cache rows are written (= the slot's device cursor).
+    An iteration feeds ``stream[fed : fed + n]`` in one dispatch and
+    advances ``fed`` by the tokens it actually committed — in steady
+    state ``fed == stream_len() - 1`` (the last sampled token is fed
+    next), during prefill ``stream_len() - fed > 1``.
+    """
 
     __slots__ = ("id", "prompt", "max_new", "eos_id", "arrival",
                  "deadline", "trace", "root_sid", "handle", "fed",
-                 "generated", "slot", "finish_reason")
+                 "generated", "slot", "finish_reason", "sampling",
+                 "rng", "first_dispatch_at", "prefix_id", "prefix_cold")
 
     def __init__(self, prompt, max_new, eos_id, arrival, deadline,
-                 trace=None):
+                 trace=None, sampling=None, prefix_id=None):
         self.id = next(_seq_ids)
         self.prompt = prompt
         self.max_new = max_new
@@ -106,24 +159,33 @@ class _Sequence:
         self.deadline = deadline          # absolute clock s, or None
         self.trace = trace
         self.root_sid = None
-        self.fed = 0                      # prompt+generated tokens fed
+        self.fed = 0                      # stream tokens fed = cursor
         self.generated = []
         self.slot = None
         self.finish_reason = None
+        self.sampling = sampling if sampling is not None else _GREEDY
+        self.rng = self.sampling.make_rng()
+        self.first_dispatch_at = None     # first dispatch covering us
+        self.prefix_id = prefix_id
+        self.prefix_cold = False          # missed: capture after prefill
         self.handle = DecodeHandle(self)
 
-    def next_token(self):
-        """The token this sequence feeds THIS iteration: the next
-        prompt token while prefilling, else the last sampled one."""
-        if self.fed < len(self.prompt):
-            return int(self.prompt[self.fed])
-        return int(self.generated[-1])
+    def stream_len(self):
+        return len(self.prompt) + len(self.generated)
 
-    def emitting(self):
-        """Does this iteration's output row carry a NEW token? True
-        once the last prompt token has been fed (its logits predict the
-        first generated position)."""
-        return self.fed >= len(self.prompt) - 1
+    def stream_token(self, i):
+        if i < len(self.prompt):
+            return int(self.prompt[i])
+        return int(self.generated[i - len(self.prompt)])
+
+    def remaining(self):
+        """Stream tokens not yet fed (1 in steady state; > 1 while
+        prefilling)."""
+        return self.stream_len() - self.fed
+
+    def window(self, n):
+        """The next ``n`` stream tokens to feed."""
+        return [self.stream_token(self.fed + j) for j in range(n)]
 
 
 class DecodeHandle:
@@ -178,10 +240,21 @@ class DecodeHandle:
 
     @property
     def ttft(self):
-        """Admission-to-first-token seconds (None before the first)."""
+        """Submit-to-first-token seconds, queue wait INCLUDED (None
+        before the first token)."""
         if self.first_token_at is None:
             return None
         return self.first_token_at - self.request.arrival
+
+    @property
+    def ttft_exec(self):
+        """First-dispatch-to-first-token seconds: the prefill cost the
+        engine actually paid, with queue wait excluded — the number the
+        chunked-prefill win shows up in under load."""
+        if self.first_token_at is None or \
+                self.request.first_dispatch_at is None:
+            return None
+        return self.first_token_at - self.request.first_dispatch_at
 
     def missed_deadline(self):
         return (self.completed_at is not None
@@ -254,11 +327,23 @@ class DecodeEngine:
     defaults to the bound cache's (inferred from the aux shapes);
     ``pos_embed`` is detected from the graph (a ``pos_ids`` argument =
     learned positions, fed per slot by the drivers).
+
+    ``symbol_gen`` (``step_len -> symbol``, e.g.
+    ``lambda s: get_decode_symbol(per_slot=True, step_len=s)``) arms
+    the S>1 *window* programs: for every ``window_lens`` entry W > 1,
+    each rung gets a Module over ``symbol_gen(W)`` bound with
+    ``shared_module=`` that rung's S=1 module — parameter cells chain
+    to the bucket leader's and the KV-cache/cursor aux CELLS are shared
+    outright (their shapes are step-independent), so the window program
+    and the decode program advance the same device state. Window
+    lengths clamp to ``capacity``; all window programs warm and pin
+    alongside the rungs' S=1 programs.
     """
 
     def __init__(self, name, symbol, arg_params, aux_params=None,
                  capacity=None, ladder=None, context=None,
-                 compute_dtype=None, logger=None):
+                 compute_dtype=None, logger=None, symbol_gen=None,
+                 window_lens=()):
         from ..context import current_context
         from ..module import BucketingModule
 
@@ -322,10 +407,50 @@ class DecodeEngine:
                                      pos_embed=self.pos_embed)
             for s in self.ladder}
 
-    def _provide_data(self, slots):
-        descs = [DataDesc("data", (slots, 1), np.int32)]
+        self.window_lens = sorted(
+            {min(int(w), self.capacity) for w in (window_lens or ())}
+            - {0, 1})
+        self._window_mods = {}               # (rung, S) -> Module
+        if self.window_lens:
+            if symbol_gen is None:
+                raise MXNetError(
+                    f"DecodeEngine({name!r}): window_lens="
+                    f"{self.window_lens} needs symbol_gen= (a "
+                    "step_len -> per-slot decode symbol factory)")
+            self._build_windows(symbol_gen, compute_dtype,
+                                logger or log)
+
+    def _build_windows(self, symbol_gen, compute_dtype, logger):
+        from ..module import Module
+        for rung in self.ladder:
+            base = self._bm._buckets[rung]
+            b_exe = base._exec_group.executor
+            for S in self.window_lens:
+                mod = Module(symbol_gen(S),
+                             data_names=list(self.data_names),
+                             label_names=[], logger=logger,
+                             context=self._context,
+                             compute_dtype=compute_dtype)
+                mod.bind(self._provide_data(rung, S),
+                         label_shapes=None, for_training=False,
+                         shared_module=base)
+                w_exe = mod._exec_group.executor
+                for nm, cell in w_exe.aux_dict.items():
+                    if b_exe.aux_dict.get(nm) is not cell:
+                        raise MXNetError(
+                            f"DecodeEngine({self.name!r}): window "
+                            f"step_len={S} did not share aux cell "
+                            f"{nm!r} with the rung-{rung} decode "
+                            "module — symbol_gen must rebuild the SAME "
+                            "graph (names, capacity, slot count) at a "
+                            "different step_len")
+                self._drivers[rung].add_window(S, mod)
+                self._window_mods[(rung, S)] = mod
+
+    def _provide_data(self, slots, step=1):
+        descs = [DataDesc("data", (slots, step), np.int32)]
         if self.pos_embed == "learned":
-            descs.append(DataDesc("pos_ids", (slots, 1), np.float32))
+            descs.append(DataDesc("pos_ids", (slots, step), np.float32))
         return descs
 
     def driver(self, rung):
@@ -334,11 +459,12 @@ class DecodeEngine:
 
     # ------------------------------------------------------------- warmup
     def warmup(self, clock):
-        """Compile every slot rung (two steps: first pays the trace,
-        second measures steady state on ``clock``), pin the programs,
-        record the compile delta. Warmup garbage stays harmless: the
-        drivers' slots are all free afterwards and a join rewinds the
-        slot's cursor."""
+        """Compile every slot rung's S=1 program AND every window
+        program (two steps each: first pays the trace, second measures
+        steady state on ``clock``), pin them all, record the compile
+        delta. Warmup garbage stays harmless: afterwards every driver
+        slot is free, every cursor is rewound to 0, and a join rewinds
+        again."""
         mark = _progcache.compile_count()
         for rung in self.ladder:
             drv = self._drivers[rung]
@@ -347,7 +473,18 @@ class DecodeEngine:
             t0 = clock.now()
             drv.step(zeros).asnumpy()            # steady state
             self.exec_est[rung] = max(0.0, clock.now() - t0)
+            for S in drv.window_lens:
+                wz = np.zeros((rung, S), np.int32)
+                # rewind first so even tiny caches never see the
+                # clamped dynamic_update_slice path during warmup
+                drv.rewind_many(list(range(rung)), [0] * rung)
+                drv.step(wz).asnumpy()           # trace + compile
+                drv.rewind_many(list(range(rung)), [0] * rung)
+                t0 = clock.now()
+                drv.step(wz).asnumpy()           # steady state
+                self.exec_est[(rung, S)] = max(0.0, clock.now() - t0)
             drv.active[:] = False
+            drv.rewind_many(list(range(rung)), [0] * rung)
         self._pin_programs()
         self._warm_mark = _progcache.compile_count()
         self.warmup_compiles = self._warm_mark - mark
@@ -372,6 +509,10 @@ class DecodeEngine:
     def program_keys(self):
         keys = []
         for rung, mod in self._bm._buckets.items():
+            key = mod._exec_group.executor.program_cache_key("fwd_infer")
+            if key is not None:
+                keys.append(key)
+        for (_rung, _S), mod in self._window_mods.items():
             key = mod._exec_group.executor.program_cache_key("fwd_infer")
             if key is not None:
                 keys.append(key)
@@ -424,19 +565,67 @@ class DecodeScheduler:
     sequences (EOS / max-new / deadline / per-slot overflow), admits
     queued ones into free slots (growing the rung when the ladder
     allows), migrates live slots on rung switches, then advances every
-    slot one token through the rung's pinned program and streams the
-    sampled tokens. Greedy (argmax) sampling.
+    slot through the rung's pinned programs and streams the sampled
+    tokens. Sampling is per request (``SamplingParams``; default
+    greedy-argmax).
+
+    Fast paths (each armed only when its programs were built at engine
+    construction, so steady state never compiles): ``prefill_chunk``
+    S>1 window dispatches while any slot is prefilling (decoding slots
+    ride along with one real token + pads and rewind after);
+    ``draft_engine`` + ``spec_k`` speculative iterations when every
+    active slot is in steady state (K draft proposals, one S=K target
+    verify, exact rejection, cursor rollback on both engines);
+    ``prefix_store`` joins at cursor C on ``submit(prefix_id=...)``
+    hits and snapshots cold prefixes when their prefill completes.
     """
 
     def __init__(self, engine, clock=None, max_queue=None,
-                 default_max_new=None, logger=None):
+                 default_max_new=None, logger=None, draft_engine=None,
+                 prefill_chunk=None, spec_k=None, prefix_store=None):
         self.engine = engine
+        self.draft = draft_engine
         self._clock = clock if clock is not None else MonotonicClock()
         self._max_queue = max_queue if max_queue is not None else \
             _env_int("MXNET_SERVE_DECODE_MAX_QUEUE", 256)
         self._default_max_new = default_max_new if default_max_new \
             is not None else _env_int("MXNET_SERVE_DECODE_MAX_NEW", 64)
         self.logger = logger or log
+
+        if self.draft is not None:
+            if list(self.draft.ladder.sizes) != list(engine.ladder.sizes):
+                raise MXNetError(
+                    f"draft engine ladder {self.draft.ladder.sizes} "
+                    f"must match the target's {engine.ladder.sizes} "
+                    "(slots mirror 1:1)")
+            if self.draft.capacity < engine.capacity:
+                raise MXNetError(
+                    f"draft cache capacity {self.draft.capacity} < "
+                    f"target capacity {engine.capacity}: the draft "
+                    "tracks the same stream")
+        chunk = int(prefill_chunk if prefill_chunk is not None
+                    else default_prefill_chunk())
+        chunk = min(chunk, engine.capacity)
+        usable = set(engine.window_lens)
+        if self.draft is not None:
+            usable &= set(self.draft.window_lens)
+        self.prefill_chunk = chunk if chunk > 1 and chunk in usable \
+            else 1
+        k = int(spec_k if spec_k is not None else default_spec_k())
+        self.spec_k = 0
+        if self.draft is not None:
+            if k < 2 or k not in set(engine.window_lens):
+                raise MXNetError(
+                    f"speculative decoding armed (draft engine given) "
+                    f"but the target has no step_len={k} verify window "
+                    f"(windows: {engine.window_lens}); build the "
+                    "engine with spec_k in window_lens")
+            self.spec_k = k
+        self.prefix_store = prefix_store
+        self.spec_proposed = 0
+        self.spec_accepted = 0
+        self.spec_rollbacks = 0
+
         # reentrant: completion/token callbacks run with the scheduler
         # lock held and may legitimately submit a follow-up sequence
         self._lock = threading.RLock()
@@ -448,13 +637,24 @@ class DecodeScheduler:
         self._running = False
         self.iterations = 0
         self.migrations = 0
+        # draft first: the target's post-warmup compile mark is the
+        # zero-compile gate stats() reports, so it must be taken LAST
+        if self.draft is not None:
+            with _telemetry.span("serve.decode.warmup",
+                                 model=self.draft.name):
+                self.draft.warmup(self._clock)
         with _telemetry.span("serve.decode.warmup",
                              model=self.engine.name):
             est = self.engine.warmup(self._clock)
+        if self.draft is not None:
+            # the target's warmup compiles landed after the draft's
+            # mark; refresh it so BOTH gates read 0 in steady state
+            self.draft._warm_mark = _progcache.compile_count()
         self.logger.info(
-            "decode %r warmed — slot ladder %s, %d compiles, step est %s",
+            "decode %r warmed — slot ladder %s, windows %s, "
+            "%d compiles, step est %s",
             self.engine.name, self.engine.ladder.sizes,
-            self.engine.warmup_compiles,
+            self.engine.window_lens, self.engine.warmup_compiles,
             {r: f"{s * 1e3:.2f}ms" for r, s in est.items()})
         self._gauge("slots").set(self._rung)
         self._gauge("active").set(0)
@@ -471,14 +671,20 @@ class DecodeScheduler:
 
     # ------------------------------------------------------------ admission
     def submit(self, prompt, max_new_tokens=None, eos_id=None,
-               deadline_ms=None, trace=None):
+               deadline_ms=None, trace=None, sampling=None,
+               prefix_id=None):
         """Admit one sequence: ``prompt`` is a 1-D int id sequence
         (1 <= len <= cache capacity). ``max_new_tokens`` caps
         generation (``MXNET_SERVE_DECODE_MAX_NEW`` default); ``eos_id``
         retires the sequence when sampled (not emitted);
         ``deadline_ms`` (relative to now) retires it mid-decode with a
-        partial result and ``finish_reason="deadline"``. Returns the
-        streaming ``DecodeHandle``."""
+        partial result and ``finish_reason="deadline"``. ``sampling``
+        is a ``SamplingParams`` (default greedy-argmax; replaying the
+        same params + prompt reproduces the token stream byte for
+        byte). ``prefix_id`` names a shared prompt prefix for the
+        prefix store: a hit joins at cursor C with donated cache rows,
+        a miss prefills cold and snapshots the prompt's rows for the
+        next submit. Returns the streaming ``DecodeHandle``."""
         prompt = np.asarray(prompt, np.int64).reshape(-1)
         if prompt.size < 1:
             raise MXNetError("empty prompt")
@@ -496,7 +702,8 @@ class DecodeScheduler:
         tr = trace
         if tr is None and _trace.sample():
             tr = _trace.new_trace(session=True)
-        seq = _Sequence(prompt, max_new, eos_id, now, deadline, trace=tr)
+        seq = _Sequence(prompt, max_new, eos_id, now, deadline, trace=tr,
+                        sampling=sampling, prefix_id=prefix_id)
         if tr is not None:
             seq.root_sid = _trace.next_span_id()
             if tr.root is None:
@@ -531,6 +738,8 @@ class DecodeScheduler:
         seq.finish_reason = reason
         if seq.slot is not None:
             self.engine.driver(self._rung).leave(seq.slot)
+            if self.draft is not None:
+                self.draft.driver(self._rung).leave(seq.slot)
             self._slots[seq.slot] = None
             seq.slot = None
             self._counter("leaves").inc()
@@ -570,6 +779,8 @@ class DecodeScheduler:
             new_slots[dst] = seq
             dst += 1
         self.engine.migrate(self._rung, target, pairs)
+        if self.draft is not None:
+            self.draft.migrate(self._rung, target, pairs)
         self._rung = target
         self._slots = new_slots
         self.migrations += 1
@@ -596,13 +807,106 @@ class DecodeScheduler:
                 continue
             seq = self._queue.pop(0)
             drv.join(row)
+            if self.draft is not None:
+                self.draft.driver(self._rung).join(row)
             seq.slot = row
             self._slots[row] = seq
             self._counter("joins").inc()
+            if seq.prefix_id is not None and \
+                    self.prefix_store is not None:
+                self._prefix_admit(row, seq, now)
             if seq.trace is not None:
                 _trace.record(seq.trace, "serve.decode.queue.wait",
                               seq.arrival, now, parent=seq.root_sid,
                               slot=row)
+
+    def _prefix_admit(self, row, seq, now):
+        """Prefix-store hit test for one freshly joined sequence: on a
+        hit the slot *joins at cursor C* — the stored rows write back
+        into its cache slice (bitwise what a cold prefill of those
+        positions computes) and the cursor rewinds forward to C, so
+        prefill starts at the first unshared token. A miss marks the
+        sequence cold: its prompt rows snapshot into the store the
+        iteration its prefill completes."""
+        tags = ("target", "draft") if self.draft is not None \
+            else ("target",)
+        c, entry = self.prefix_store.lookup(seq.prefix_id, seq.prompt,
+                                            tags=tags)
+        if entry is None:
+            seq.prefix_cold = True
+            self._counter("prefix.misses").inc()
+            return
+        drv = self.engine.driver(self._rung)
+        drv.restore_rows(row, {nm: r[:, :c]
+                               for nm, r in entry.payloads["target"]
+                               .items()})
+        drv.rewind(row, c)
+        if self.draft is not None:
+            ddrv = self.draft.driver(self._rung)
+            ddrv.restore_rows(row, {nm: r[:, :c]
+                                    for nm, r in entry.payloads["draft"]
+                                    .items()})
+            ddrv.rewind(row, c)
+        seq.fed = c
+        self._counter("prefix.hits").inc()
+        if seq.trace is not None:
+            _trace.record(seq.trace, "serve.decode.prefix.join",
+                          now, now, parent=seq.root_sid, slot=row,
+                          cursor=c)
+
+    def _plan_dispatch(self):
+        """Pick this iteration's dispatch shape (caller holds the
+        lock): ``("window", S)`` — every active slot feeds up to S
+        stream tokens (S = prefill chunk while anyone prefills and
+        every live cursor has room, else 1) — or ``("spec", K)`` when
+        speculation is armed and every active slot is in steady state
+        with K positions of cache headroom on both engines."""
+        drv = self.engine.driver(self._rung)
+        ddrv = self.draft.driver(self._rung) if self.draft else None
+        prefilling = any(s.remaining() > 1 for s in self._active())
+        if prefilling:
+            S = self.prefill_chunk
+            if S > 1 and not drv.overflowing(S) and \
+                    (ddrv is None or not ddrv.overflowing(S)):
+                return "window", S
+            return "window", 1
+        if self.spec_k and ddrv is not None and \
+                not drv.overflowing(self.spec_k) and \
+                not ddrv.overflowing(self.spec_k):
+            return "spec", self.spec_k
+        return "window", 1
+
+    def _dispatch_spec(self, drv, ddrv, base_tokens, meta, K):
+        """One speculative iteration's device work (runs OUTSIDE the
+        scheduler lock, like every dispatch): K draft S=1 dispatches
+        propose ``d_1..d_K`` per slot, then ONE target S=K window
+        dispatch — window ``[t, d_1..d_{K-1}]`` at the slot's own
+        cursor — yields the target distribution for every proposed
+        position (row j verifies ``d_{j+1}``). Returns
+        ``{row: (accepted, tokens)}`` from exact rejection sampling."""
+        rung = base_tokens.shape[0]
+        proposals = np.zeros((rung, K), np.int64)
+        draft_rows = {row: [] for row, _seq in meta}
+        feed = base_tokens.copy()
+        for j in range(K):
+            dlog = ddrv.step(feed).asnumpy()       # (rung, 1, V)
+            feed = np.zeros((rung, 1), np.int32)
+            for row, seq in meta:
+                d = sample_token(dlog[row, 0], seq.sampling, seq.rng)
+                proposals[row, j] = d
+                draft_rows[row].append(dlog[row, 0])
+                feed[row, 0] = d
+        window = np.zeros((rung, K), np.int32)
+        window[:, 0] = base_tokens[:, 0]
+        if K > 1:
+            window[:, 1:] = proposals[:, :K - 1]
+        vlog = drv.step(window).asnumpy()          # (rung, K, V)
+        out = {}
+        for row, seq in meta:
+            out[row] = speculative_verify(
+                vlog[row], np.asarray(draft_rows[row]),
+                proposals[row], seq.sampling, seq.rng)
+        return out
 
     def _iterate(self):
         """One scheduling iteration; returns tokens emitted (0 = no
@@ -638,10 +942,28 @@ class DecodeScheduler:
             if target is not None and target < self._rung:
                 self._switch_rung(target)
             drv = self.engine.driver(self._rung)
-            tokens = np.zeros((self._rung, 1), np.int32)
-            for row, seq in enumerate(self._slots):
-                if seq is not None:
-                    tokens[row, 0] = seq.next_token()
+            ddrv = self.draft.driver(self._rung) if self.draft else None
+            mode, S = self._plan_dispatch()
+            meta = []                    # (row, seq[, n_fed]) rows
+            if mode == "spec":
+                tokens = np.zeros((self._rung, 1), np.int32)
+                for row, seq in enumerate(self._slots):
+                    if seq is None:
+                        continue
+                    tokens[row, 0] = seq.stream_token(seq.fed)
+                    meta.append((row, seq))
+            else:
+                tokens = np.zeros((self._rung, S), np.int32)
+                for row, seq in enumerate(self._slots):
+                    if seq is None:
+                        continue
+                    n = min(S, seq.remaining())
+                    tokens[row, :n] = seq.window(n)
+                    meta.append((row, seq, n))
+            for entry in meta:
+                seq = entry[1]
+                if seq.first_dispatch_at is None:
+                    seq.first_dispatch_at = now
             active = list(self._active())
             shared_sid = _trace.next_span_id() \
                 if any(s.trace is not None for s in active) else None
@@ -650,41 +972,88 @@ class DecodeScheduler:
         # dispatch outside the lock: submits stay non-blocking while
         # the program runs (only pump()/the dispatch thread iterates,
         # so the engine itself needs no second guard)
-        logits = drv.step(tokens).asnumpy()       # (rung, 1, V)
-        sampled = np.argmax(logits[:, 0, :], axis=-1)
+        if mode == "spec":
+            verdicts = self._dispatch_spec(
+                drv, ddrv, tokens, [(r, s) for r, s in meta], S)
+        else:
+            logits = drv.step(tokens).asnumpy()    # (rung, S, V)
+            if ddrv is not None:
+                # the draft shadows every non-speculative dispatch so
+                # its cache tracks the same stream positions
+                ddrv.step(tokens).asnumpy()
 
         with self._lock:
             end = self._clock.now()
             step_s = max(0.0, end - t0)
-            self.engine.note_exec(self._rung, step_s)
+            self.engine.note_exec(self._rung if S == 1
+                                  else (self._rung, S), step_s)
             emitted = 0
-            for seq in active:
-                if seq.slot is None:
-                    continue
-                emit = seq.emitting()
-                seq.fed += 1
-                if seq.trace is not None:
-                    _trace.record(
-                        seq.trace, "serve.decode.step", t0, end,
-                        span_id=shared_sid, parent=seq.root_sid,
-                        rung=self._rung, n_active=len(active),
-                        shared=True, pos=seq.fed - 1)
-                if not emit:
-                    continue                      # still prefilling
-                tok = int(sampled[seq.slot])
-                if seq.eos_id is not None and tok == seq.eos_id:
-                    self._finish(seq, reason="eos", now=end)
-                    continue                # EOS retires, not emitted
-                seq.generated.append(tok)
-                seq.handle._emit(tok, now=end)
-                emitted += 1
-                if len(seq.generated) >= seq.max_new:
-                    self._finish(seq, reason="length", now=end)
+            chunks = 0
+            rew_rows, rew_pos = [], []
+            if mode == "spec":
+                emitted = self._commit_spec(
+                    meta, verdicts, S, t0, end, shared_sid,
+                    rew_rows, rew_pos)
+            else:
+                for row, seq, n in meta:
+                    if seq.slot is None:
+                        continue
+                    was_prefilling = seq.remaining() > 1
+                    samples = seq.fed + n == seq.stream_len()
+                    if seq.trace is not None:
+                        _trace.record(
+                            seq.trace, "serve.decode.step", t0, end,
+                            span_id=shared_sid, parent=seq.root_sid,
+                            rung=self._rung, n_active=len(active),
+                            shared=True, pos=seq.fed, window=n)
+                        if was_prefilling:
+                            _trace.record(
+                                seq.trace, "serve.decode.prefill",
+                                t0, end, parent=seq.root_sid,
+                                pos=seq.fed, tokens=n, chunk=S)
+                    if was_prefilling:
+                        chunks += 1
+                    tok = sample_token(logits[row, n - 1],
+                                       seq.sampling, seq.rng) \
+                        if samples else None
+                    seq.fed += n
+                    if n < S:
+                        # the dispatch advanced the cursor by S; pull
+                        # it back to the stream position actually fed
+                        rew_rows.append(row)
+                        rew_pos.append(seq.fed)
+                    self._capture_prefix(seq, end)
+                    if not samples:
+                        continue              # still prefilling
+                    if seq.eos_id is not None and tok == seq.eos_id:
+                        self._finish(seq, reason="eos", now=end)
+                        continue            # EOS retires, not emitted
+                    seq.generated.append(tok)
+                    seq.handle._emit(tok, now=end)
+                    emitted += 1
+                    if len(seq.generated) >= seq.max_new:
+                        self._finish(seq, reason="length", now=end)
+            # retired rows keep advancing one window per dispatch; pull
+            # any nearing capacity back to 0 so no dispatch ever sees a
+            # clamped window write for a row nobody owns
+            maxw = max([1] + list(drv.window_lens))
+            seen = set(rew_rows)
+            for row in range(self._rung):
+                if self._slots[row] is None and row not in seen and \
+                        drv.pos[row] + maxw > self.engine.capacity:
+                    rew_rows.append(row)
+                    rew_pos.append(0)
+            if rew_rows:
+                drv.rewind_many(rew_rows, rew_pos)
+                if ddrv is not None:
+                    ddrv.rewind_many(rew_rows, rew_pos)
             self.iterations += 1
             n_active = len(self._active())
             self._counter("iterations").inc()
             if emitted:
                 self._counter("tokens").inc(emitted)
+            if chunks:
+                self._counter("prefill.chunks").inc(chunks)
             _telemetry.histogram("serve.decode.step.seconds",
                                  model=self.engine.name).observe(step_s)
             self._gauge("active").set(n_active)
@@ -697,9 +1066,76 @@ class DecodeScheduler:
             _telemetry.flightrec.note(
                 "serve.decode.step", model=self.engine.name,
                 rung=self._rung, active=n_active, emitted=emitted,
-                step_us=int(step_s * 1e6),
+                step_us=int(step_s * 1e6), mode=mode, window=S,
                 compiles_since_warmup=compiles)
         return max(1, emitted)
+
+    def _commit_spec(self, meta, verdicts, K, t0, end, shared_sid,
+                     rew_rows, rew_pos):
+        """Apply one speculative iteration's verdicts (caller holds the
+        lock): commit each slot's accepted prefix + rejection sample,
+        stream the tokens, roll the cursor back over the rejected tail
+        (both engines, via the caller's rewind batch), retire on EOS /
+        max-new mid-window (tokens past the stop are discarded — the
+        target never sampled them)."""
+        emitted = 0
+        for row, seq in meta:
+            if seq.slot is None:
+                continue
+            accepted, toks = verdicts[row]
+            self.spec_proposed += K
+            self.spec_accepted += accepted
+            if accepted < K:
+                self.spec_rollbacks += 1
+            committed = 0
+            finish = None
+            for tok in toks:
+                if seq.eos_id is not None and tok == seq.eos_id:
+                    finish = "eos"
+                    break
+                seq.generated.append(int(tok))
+                seq.handle._emit(int(tok), now=end)
+                emitted += 1
+                committed += 1
+                if len(seq.generated) >= seq.max_new:
+                    finish = "length"
+                    break
+            seq.fed += committed
+            if committed < K:
+                rew_rows.append(row)
+                rew_pos.append(seq.fed)
+            if seq.trace is not None:
+                _trace.record(
+                    seq.trace, "serve.decode.step", t0, end,
+                    span_id=shared_sid, parent=seq.root_sid,
+                    rung=self._rung, shared=True, pos=seq.fed,
+                    spec_k=K, accepted=accepted, committed=committed)
+            if finish is not None:
+                self._finish(seq, reason=finish, now=end)
+        self._counter("spec.proposed").inc(K * len(meta))
+        accepted_now = sum(verdicts[r][0] for r, _ in meta)
+        if accepted_now:
+            self._counter("spec.accepted").inc(accepted_now)
+        return emitted
+
+    def _capture_prefix(self, seq, now):
+        """Snapshot a cold prefix the moment its prefill completes
+        (caller holds the lock): the slot's first ``len(prompt)`` cache
+        positions on the target (and draft, when armed) plus the token
+        ids they encode."""
+        if not seq.prefix_cold or self.prefix_store is None or \
+                seq.slot is None or seq.fed < len(seq.prompt):
+            return
+        payloads = {"target": self.engine.driver(self._rung)
+                    .capture_rows(seq.slot, len(seq.prompt))}
+        if self.draft is not None:
+            payloads["draft"] = self.draft.driver(self._rung) \
+                .capture_rows(seq.slot, len(seq.prompt))
+        stored = self.prefix_store.put(
+            seq.prefix_id, np.asarray(seq.prompt, np.int64), payloads)
+        seq.prefix_cold = False
+        if stored:
+            self._counter("prefix.captures").inc()
 
     # ----------------------------------------------------------- drive modes
     def _has_work(self):
@@ -784,10 +1220,18 @@ class DecodeScheduler:
             n_active = len(self._active())
             depth = len(self._queue)
             rung = self._rung
+            spec_proposed = self.spec_proposed
+            spec_accepted = self.spec_accepted
+            spec_rollbacks = self.spec_rollbacks
         h = _telemetry.get_metric("serve.decode.request.latency.seconds",
                                   model=self.engine.name)
         its = c("iterations")
-        return {
+        # exec_est keys mix rungs (int) and (rung, window) tuples —
+        # render both as strings ("8", "8xS64") for a stable sort
+        exec_est = {
+            (f"{k[0]}xS{k[1]}" if isinstance(k, tuple) else str(k)):
+            round(s * 1e3, 3) for k, s in self.engine.exec_est.items()}
+        out = {
             "model": self.engine.name,
             "ladder": self.engine.ladder.sizes,
             "rung": rung,
@@ -804,22 +1248,37 @@ class DecodeScheduler:
             "joins": c("joins"),
             "leaves": c("leaves"),
             "migrations": c("migrations"),
+            "prefill_chunk": self.prefill_chunk,
+            "prefill_chunks": c("prefill.chunks"),
             "latency_ms": None if h is None or not h.count else {
                 "p50": round((h.quantile(0.50) or 0) * 1e3, 3),
                 "p99": round((h.quantile(0.99) or 0) * 1e3, 3),
                 "mean": round(h.mean * 1e3, 3)},
-            "exec_est_ms": {r: round(s * 1e3, 3) for r, s in
-                            sorted(self.engine.exec_est.items())},
+            "exec_est_ms": dict(sorted(exec_est.items())),
             "capacity": self.engine.capacity,
             "compiles_since_warmup": self.engine.compiles_since_warmup(),
             "programs_resident": self.engine.programs_resident(),
         }
+        if self.spec_k:
+            out["spec"] = {
+                "k": self.spec_k,
+                "proposed": spec_proposed,
+                "accepted": spec_accepted,
+                "rollbacks": spec_rollbacks,
+                "acceptance": round(spec_accepted / spec_proposed, 4)
+                if spec_proposed else None,
+            }
+        if self.prefix_store is not None:
+            out["prefix"] = self.prefix_store.stats()
+        return out
 
 
 def serve_decoder(symbol, arg_params, name="decoder", capacity=None,
                   ladder=None, clock=None, start=True, max_queue=None,
                   default_max_new=None, context=None, compute_dtype=None,
-                  logger=None):
+                  logger=None, symbol_gen=None, prefill_chunk=None,
+                  draft_symbol_gen=None, draft_params=None, spec_k=None,
+                  prefix_cache_mb=None):
     """One-call front end for continuous decode batching:
     ``serve_decoder(decode_symbol, params).submit([ids...])``.
 
@@ -827,13 +1286,62 @@ def serve_decoder(symbol, arg_params, name="decoder", capacity=None,
     (``get_decode_symbol(per_slot=True)``); builds the slot-rung
     ``DecodeEngine``, warms+pins every rung, and (by default) starts
     the dispatch thread — ``start=False`` + ``pump()`` with a FakeClock
-    is the deterministic test path, mirroring ``serve()``."""
+    is the deterministic test path, mirroring ``serve()``.
+
+    Fast paths (each optional, all off by default):
+
+    * ``symbol_gen`` — ``symbol_gen(step_len) -> Symbol`` for the SAME
+      model; arms chunked prefill (window S =
+      ``prefill_chunk``/``MXNET_SERVE_PREFILL_CHUNK``) so a T-token
+      prompt lands in ⌈T/S⌉ dispatches instead of T.
+    * ``draft_symbol_gen``/``draft_params`` — a small draft LM (same
+      generator signature) arms speculative decoding with
+      ``spec_k``/``MXNET_SERVE_SPEC_K`` proposals per verify dispatch.
+    * ``prefix_cache_mb`` (or ``MXNET_SERVE_PREFIX_CACHE_MB``) — the
+      byte budget for ``submit(prefix_id=...)`` cache-row reuse; pass
+      0 to disable the store entirely.
+    """
+    window_lens = set()
+    chunk = default_prefill_chunk() if prefill_chunk is None \
+        else int(prefill_chunk)
+    if symbol_gen is not None and chunk > 1:
+        window_lens.add(chunk)
+    k = default_spec_k() if spec_k is None else int(spec_k)
+    draft_engine = None
+    if draft_symbol_gen is not None:
+        if draft_params is None:
+            raise MXNetError("serve_decoder: draft_symbol_gen needs "
+                             "draft_params")
+        if symbol_gen is None:
+            raise MXNetError(
+                "serve_decoder: speculative decoding needs symbol_gen= "
+                "too — the target verifies K proposals in one "
+                "step_len=K window dispatch")
+        window_lens.add(k)
     engine = DecodeEngine(name, symbol, arg_params, capacity=capacity,
                           ladder=ladder, context=context,
-                          compute_dtype=compute_dtype, logger=logger)
+                          compute_dtype=compute_dtype, logger=logger,
+                          symbol_gen=symbol_gen, window_lens=window_lens)
+    if draft_symbol_gen is not None:
+        draft_engine = DecodeEngine(
+            name + ".draft", draft_symbol_gen(1), draft_params,
+            capacity=engine.capacity, ladder=engine.ladder.sizes,
+            context=context, compute_dtype=compute_dtype, logger=logger,
+            symbol_gen=draft_symbol_gen, window_lens=window_lens)
+    budget = None if prefix_cache_mb is None \
+        else int(float(prefix_cache_mb) * (1 << 20))
+    store = None
+    if budget is None or budget > 0:
+        store = PrefixStore(budget_bytes=budget)
+        if store.budget_bytes <= 0:
+            store = None
     sched = DecodeScheduler(engine, clock=clock, max_queue=max_queue,
                             default_max_new=default_max_new,
-                            logger=logger)
+                            logger=logger, draft_engine=draft_engine,
+                            prefill_chunk=chunk,
+                            spec_k=k if draft_engine is not None
+                            else None,
+                            prefix_store=store)
     if start:
         sched.start()
     return sched

@@ -143,17 +143,33 @@ def test_per_slot_cursor_binds_int32(trained):
         assert tuple(cell.shape) == (3, 1)
 
 
-def test_per_slot_rejects_multi_token_windows():
-    with pytest.raises(mx.base.MXNetError, match="one token per"):
-        tfm.get_decode_symbol(vocab_size=V, d_model=D, n_layer=1,
-                              n_head=H, per_slot=True, step_len=2)
+def test_per_slot_window_lowering():
+    """S>1 per-slot windows (ISSUE 18): each slot writes S cache rows
+    at its own cursor, the causal mask staggers per slot, and the
+    cursor vector advances by S."""
+    sym = tfm.get_decode_symbol(vocab_size=V, d_model=D, n_layer=1,
+                                n_head=H, per_slot=True, step_len=2)
+    _args, outs, _auxs = sym.infer_shape(data=(4, 2))
+    assert outs == [(4, 2, V)]
     op = get_op("attention_decode")
-    q = jnp.zeros((2, 1, 2, 4))
-    cache = jnp.zeros((2, 1, 8, 4))
-    with pytest.raises(mx.base.MXNetError, match="one token per"):
-        op.forward({"capacity": 8, "per_slot": True}, [q, q, q],
-                   [cache, cache, jnp.zeros((2, 1), jnp.int32)],
-                   False, None)
+    rs = np.random.RandomState(3)
+    B, Hh, S, Dh, C = 2, 1, 2, 4, 8
+    q, k, v = (jnp.asarray(rs.randn(B, Hh, S, Dh).astype(np.float32))
+               for _ in range(3))
+    kc = jnp.asarray(rs.randn(B, Hh, C, Dh).astype(np.float32))
+    vc = jnp.asarray(rs.randn(B, Hh, C, Dh).astype(np.float32))
+    cur = jnp.asarray([[0], [3]], jnp.int32)
+    outs, auxs = op.forward({"capacity": C, "per_slot": True},
+                            [q, k, v], [kc, vc, cur], False, None)
+    k2, v2, cur2 = auxs
+    assert np.array_equal(np.asarray(cur2), [[2], [5]])
+    # slot 0 wrote rows 0..1, slot 1 rows 3..4; everything else intact
+    assert np.array_equal(np.asarray(k2[0, :, :2]), np.asarray(k[0]))
+    assert np.array_equal(np.asarray(k2[1, :, 3:5]), np.asarray(k[1]))
+    assert np.array_equal(np.asarray(k2[0, :, 2:]),
+                          np.asarray(kc[0, :, 2:]))
+    assert np.array_equal(np.asarray(v2[1, :, :3]),
+                          np.asarray(vc[1, :, :3]))
 
 
 def test_per_slot_eager_overflow_names_slots():

@@ -100,6 +100,17 @@ def _check_corpus():
          lambda: _models.transformer.get_decode_symbol(
              vocab_size=64, d_model=32, n_layer=1, n_head=2, capacity=16,
              per_slot=True), {"data": (4, 1)}),
+        # chunked-prefill window graph (S>1 per-slot decode) and the
+        # draft/verify pair's verify window — the decode fast paths'
+        # serving graphs (serve/decode.py)
+        ("models/transformer_decode_chunked",
+         lambda: _models.transformer.get_decode_symbol(
+             vocab_size=64, d_model=32, n_layer=1, n_head=2, capacity=16,
+             per_slot=True, step_len=8), {"data": (4, 8)}),
+        ("models/transformer_decode_verify",
+         lambda: _models.transformer.get_decode_symbol(
+             vocab_size=64, d_model=32, n_layer=1, n_head=2, capacity=16,
+             per_slot=True, step_len=4), {"data": (4, 4)}),
     ]
 
     def _dcgan(which):

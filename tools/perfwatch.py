@@ -65,6 +65,9 @@ VARIANT_PATHS = [
     (("decode_batch", "slots1_tokens_per_sec"), "up"),
     (("decode_batch", "slots8_tokens_per_sec"), "up"),
     (("decode_batch", "speedup_8v1"), "up"),
+    (("decode_batch", "ttft_2048_ms"), "down"),
+    (("decode_batch", "spec_speedup"), "up"),
+    (("decode_batch", "prefix_hit_rate"), "up"),
     (("spmd", "spmd_vs_kvstore"), "up"),
     (("ckpt", "exposed_ratio"), "down"),
 ]
