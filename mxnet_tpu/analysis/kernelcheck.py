@@ -40,6 +40,7 @@ __all__ = ["SUPPORTED_GATE_DTYPES", "SUBLANE_ROWS", "LANES",
 #: reference (kernel_tier.py gates every variant before selection)
 SUPPORTED_GATE_DTYPES = frozenset({
     "float32", "bfloat16", "float16", "int8", "int32", "uint8",
+    "float8_e4m3fn", "float8_e5m2",
 })
 
 #: minimum second-to-last-dim rows per dtype (TPU tiling: the last dim

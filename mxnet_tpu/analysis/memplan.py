@@ -8,7 +8,8 @@ the Symbol graph alone: a liveness/residual analysis over the executor's
 topo order, layout-aware for everything that now decides the footprint —
 per-``MXNET_REMAT_POLICY`` residual sets (mirroring the measured
 ``remat.residual_bytes`` semantics op by op, see below), dtype-aware
-param bytes (int8 quant weights count 1 B/elem), ZeRO's 1/N flat state
+param bytes (int8/fp8 quant weights and fp8 KV-cache cells count
+1 B/elem), ZeRO's 1/N flat state
 shards, SPMD param specs, donation credits, and the batch buffers —
 divided across the mesh. Zero compiles, zero traces, no jax import.
 
@@ -109,6 +110,11 @@ def _nelems(shape):
 
 
 def _itemsize(name):
+    # fp8 storage (quant weights, KV cache cells) is 1 B/elem; resolve
+    # it by name so the no-jax contract holds even when ml_dtypes has
+    # not registered the dtype with numpy
+    if str(name).startswith("float8"):
+        return 1
     try:
         return np.dtype(name).itemsize
     except TypeError:
@@ -186,7 +192,9 @@ def plan_symbol(symbol, shapes, policy="none", for_training=True,
                    and not n._extra.get("__is_aux__")
                    and n.name not in batch_names]
     watched = [n for n in param_nodes if n.name not in set(fixed_params)
-               and dtypes.get((id(n), 0)) not in ("int8",)]
+               and dtypes.get((id(n), 0)) not in ("int8",
+                                                  "float8_e4m3fn",
+                                                  "float8_e5m2")]
 
     def shard_fraction(name, shape):
         if spmd_plan is None:

@@ -57,6 +57,11 @@ _MOVEMENT_OPS = frozenset({
 
 _CAST_OPS = frozenset({"Cast", "cast"})
 
+#: valid narrow storage dtypes for Quantized* weights (QT702-704):
+#: the int8 PTQ tier and the fp8 serving tier (ops/quant.py)
+_QUANT_STORAGE_DTYPES = frozenset({"int8", "float8_e4m3fn",
+                                   "float8_e5m2"})
+
 
 def dtype_name(dt):
     """Canonical dtype name; tolerates np dtypes, strings, ml_dtypes."""
@@ -283,7 +288,8 @@ def _audit(sym, compute_dtype, bound):
                          "the compute dtype); use an explicit Cast if "
                          "the upcast is intended"))
 
-    # QT702/703: the int8 quant-rewrite contract around Quantized* ops
+    # QT702/703: the quant-rewrite contract around Quantized* ops —
+    # int8 and fp8 (float8_e4m3fn) storage are both valid tiers
     quant_weight_vars = set()
     for n in nodes:
         if n.is_variable or not n.op.startswith("Quantized"):
@@ -293,11 +299,11 @@ def _audit(sym, compute_dtype, bound):
             continue
         wnode, widx = ins[1]
         wdt = dtypes.get((id(wnode), widx), "float32")
-        if wdt != "int8":
+        if wdt not in _QUANT_STORAGE_DTYPES:
             found.append(Diagnostic(
                 "QT702", f"{n.op} node {n.name!r} consumes weight "
                 f"{wnode.name!r} of dtype {wdt}; the quant rewrite "
-                "never produced an int8 + scale pair for it",
+                "never produced a narrow-storage + scale pair for it",
                 node=n.name, op=n.op,
                 hint="run quantize_symbol over the trained symbol (or "
                      "bind the _q/_scale params it produced)"))
@@ -314,19 +320,23 @@ def _audit(sym, compute_dtype, bound):
                 if n.op.startswith("Quantized") and i == 1:
                     continue
                 found.append(Diagnostic(
-                    "QT703", f"int8 weight {inp.name!r} also feeds "
+                    "QT703", f"quantized weight {inp.name!r} also feeds "
                     f"{n.op} node {n.name!r} (slot {i}), which reads "
-                    "the raw int8 codes as values",
+                    "the raw storage codes as values",
                     node=n.name, op=n.op,
                     hint="keep a float copy for the non-quantized "
                          "consumer, or route it through the Quantized "
                          "op"))
 
-    # QT704: Cast back to int8 whose source chain is int8 already
+    # QT704: Cast back to a narrow storage dtype (int8 or fp8) whose
+    # source chain is already that dtype — a dequant->requant round
+    # trip. A legitimate fp8 dequant chain (storage -> f32 compute,
+    # never cast back) does not trip this.
     for n in nodes:
         if n.is_variable or n.op not in _CAST_OPS:
             continue
-        if dtype_name(n.attrs.get("dtype", "")) != "int8":
+        target = dtype_name(n.attrs.get("dtype", ""))
+        if target not in _QUANT_STORAGE_DTYPES:
             continue
         src, sidx = n.inputs[0] if n.inputs else (None, 0)
         hops = 0
@@ -336,16 +346,17 @@ def _audit(sym, compute_dtype, bound):
             src, sidx = src.inputs[0]
             hops += 1
         if src is not None and \
-                dtypes.get((id(src), sidx)) == "int8" and hops >= 0 \
+                dtypes.get((id(src), sidx)) == target and hops >= 0 \
                 and (id(src), sidx) != (id(n.inputs[0][0]),
                                         n.inputs[0][1]):
             found.append(Diagnostic(
-                "QT704", f"Cast node {n.name!r} requantizes to int8 a "
-                f"chain that starts int8 at {src.name!r}: a "
-                "dequantize->requantize round trip",
+                "QT704", f"Cast node {n.name!r} requantizes to "
+                f"{target} a chain that starts {target} at "
+                f"{src.name!r}: a dequantize->requantize round trip",
                 node=n.name, op=n.op,
                 hint="drop the float detour; quantize_symbol already "
-                     "produces int8 weights consumed in place"))
+                     "produces narrow-storage weights consumed in "
+                     "place"))
 
     # QT705: loss-head accumulation narrower than f32 BY DECLARATION
     # (a second propagation without compute_dtype: the mixed-precision

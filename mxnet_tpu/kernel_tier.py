@@ -182,7 +182,9 @@ def _synth_inputs(opdef, attrs, shapes, dtypes):
     the same problem. Inputs whose declared name marks them as
     second-moment state (Adam's ``var``, RMSProp's ``n``, BatchNorm's
     ``moving_var``) are made non-negative — a negative synthetic
-    variance would NaN both sides and fail the gate on noise.
+    variance would NaN both sides and fail the gate on noise. The same
+    applies to decode cursors (``*cache_pos``): a negative position
+    makes the causal mask empty and softmax all -inf.
     """
     import numpy as np
     import jax.numpy as jnp
@@ -196,7 +198,8 @@ def _synth_inputs(opdef, attrs, shapes, dtypes):
     for i, (s, dt) in enumerate(zip(shapes, dtypes)):
         a = rng.standard_normal(tuple(s)).astype("float32")
         name = names[i] if i < len(names) else ""
-        if name in ("var", "n") or "var" in name.split("_"):
+        if name in ("var", "n") or "var" in name.split("_") \
+                or name.endswith("cache_pos"):
             a = np.abs(a)
         vals.append(jnp.asarray(a).astype(dt))
     return vals

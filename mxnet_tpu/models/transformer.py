@@ -37,7 +37,7 @@ from ..base import MXNetError
 
 __all__ = ["get_symbol", "get_decode_symbol", "SyntheticLMIter",
            "KVCacheDecoder", "BatchedKVCacheDecoder",
-           "default_cache_capacity"]
+           "default_cache_capacity", "default_cache_dtype"]
 
 
 def default_cache_capacity():
@@ -47,6 +47,14 @@ def default_cache_capacity():
         return int(os.environ.get("MXNET_LM_CACHE_CAPACITY", "256"))
     except ValueError:
         return 256
+
+
+def default_cache_dtype():
+    """Decode KV-cache storage dtype default: ``MXNET_LM_CACHE_DTYPE``
+    (docs/env_var.md — ``fp8`` stores cache rows as float8_e4m3fn,
+    quantized on write and dequantized on read), else None for
+    compute-width cells."""
+    return os.environ.get("MXNET_LM_CACHE_DTYPE") or None
 
 
 def _proj(x, num_hidden, name, no_bias=False):
@@ -60,7 +68,7 @@ def _proj(x, num_hidden, name, no_bias=False):
 
 def _block(x, *, i, seq_len, d_model, n_head, dropout, pos_embed,
            rope_base, name, decode=False, capacity=None,
-           per_slot=False):
+           per_slot=False, cache_dtype=None):
     """One pre-LN transformer block; ``decode=True`` swaps the full
     ``attention`` for the KV-cache ``attention_decode`` path (same
     parameter names either way, so one trained parameter set serves
@@ -87,6 +95,7 @@ def _block(x, *, i, seq_len, d_model, n_head, dropout, pos_embed,
         att = sym.attention_decode(
             q, k, v, capacity=capacity, rope=(pos_embed == "rotary"),
             rope_base=rope_base, per_slot=per_slot,
+            cache_dtype=cache_dtype or "",
             name=f"{pfx}_attn")
     else:
         if pos_embed == "rotary":
@@ -196,7 +205,7 @@ def get_symbol(vocab_size=256, d_model=64, n_layer=2, n_head=4,
 def get_decode_symbol(vocab_size=256, d_model=64, n_layer=2, n_head=4,
                       pos_embed="rotary", rope_base=10000.0,
                       capacity=None, step_len=1, max_seq_len=None,
-                      per_slot=False, name="lm"):
+                      per_slot=False, cache_dtype=None, name="lm"):
     """Incremental KV-cache decoder: ``(B, step_len)`` new token ids in,
     logits ``(B, step_len, vocab)`` out, per-layer K/V caches of
     ``capacity`` positions riding executor aux state. Parameter names
@@ -215,9 +224,16 @@ def get_decode_symbol(vocab_size=256, d_model=64, n_layer=2, n_head=4,
     predicts the token after stream position ``cursor + s``. With
     learned positions the ``pos_ids`` input becomes ``(B, step_len)``
     per-slot absolute positions.
+
+    ``cache_dtype='fp8'`` (or ``MXNET_LM_CACHE_DTYPE=fp8``) declares
+    the per-layer K/V cache cells as ``float8_e4m3fn`` storage: rows
+    quantize on write and dequantize on read inside the pinned decode
+    program, quartering cache HBM traffic and footprint. The cursor
+    stays int32 and the default (None) keeps compute-width cells.
     """
     _validate(vocab_size, d_model, n_head, pos_embed)
     capacity = capacity or default_cache_capacity()
+    cache_dtype = cache_dtype or default_cache_dtype()
     max_seq_len = max_seq_len or capacity
     S = step_len
 
@@ -232,7 +248,7 @@ def get_decode_symbol(vocab_size=256, d_model=64, n_layer=2, n_head=4,
         x = _block(x, i=i, seq_len=S, d_model=d_model, n_head=n_head,
                    dropout=0.0, pos_embed=pos_embed, rope_base=rope_base,
                    name=name, decode=True, capacity=capacity,
-                   per_slot=per_slot)
+                   per_slot=per_slot, cache_dtype=cache_dtype)
     x = sym.LayerNorm(x, name=f"{name}_ln_f")
     flat = sym.Reshape(x, shape=(-3, 0), name=f"{name}_head_fold")
     logits = sym.dot(flat, tok_w, transpose_b=True,

@@ -363,6 +363,15 @@ _MAGIC = 0x112
 # mshadow type flags (mshadow/base.h): kFloat32..kInt64
 _DTYPE_CODE = {np.dtype(d): i for i, d in enumerate(
     ["float32", "float64", "float16", "uint8", "int32", "int8", "int64"])}
+# extension codes for the fp8 storage dtypes, parked far outside the
+# reference range (0-6 here, <=12 in later mshadow revisions): a file
+# carrying fp8 cells has no reference-framework reading anyway, while
+# files restricted to the standard dtypes stay byte-for-byte compatible
+try:
+    _DTYPE_CODE[np.dtype("float8_e4m3fn")] = 100
+    _DTYPE_CODE[np.dtype("float8_e5m2")] = 101
+except TypeError:       # numpy without ml_dtypes registration
+    pass
 _CODE_DTYPE = {v: k for k, v in _DTYPE_CODE.items()}
 
 

@@ -183,8 +183,9 @@ def _qfc_flops(attrs, in_shapes):
 
 
 def _qfc_bytes(attrs, in_shapes):
-    # int8 weights move at 1 B/element — the tier's whole point; data,
-    # scales, bias and output stay at the 4 B accounting width
+    # quantized weights (int8 or fp8 storage) move at 1 B/element —
+    # the tier's whole point; data, scales, bias and output stay at
+    # the 4 B accounting width
     data_s, w_s = in_shapes[0], in_shapes[1]
     num_hidden = parse_int(attrs["num_hidden"])
     float_elems = _prod(data_s) + data_s[0] * num_hidden + \
@@ -198,6 +199,7 @@ def _qconv_flops(attrs, in_shapes):
 
 
 def _qconv_bytes(attrs, in_shapes):
+    # 1 B/elem weights (int8 or fp8 storage), float everything else
     data_s, w_s = in_shapes[0], in_shapes[1]
     nf = parse_int(attrs["num_filter"])
     out = data_s[0] * nf * _prod(_conv_out_spatial(attrs, data_s))
@@ -237,11 +239,19 @@ def _attention_decode_flops(attrs, in_shapes):
 
 
 def _attention_decode_bytes(attrs, in_shapes):
-    # q/k/v/out move once; the K/V cache is read AND written (the
-    # dominant term — decode is memory-bound by construction)
+    # q/k/v/out move once at compute width; the K/V cache READ is
+    # cursor-bounded — only the live prefix [0, cursor + S) streams
+    # from HBM (the pallas variant's index-map clamp; a session's
+    # cursor averages C/2) — and the write lands S rows per cache.
+    # Both charge at the declared cache_dtype width: fp8 storage moves
+    # 1 B/elem, the default compute-width cells 4 B
     b, h, s, d = in_shapes[0]
     c = parse_int(attrs.get("capacity", 256))
-    return _B * (4.0 * b * h * s * d + 4.0 * b * h * c * d)
+    itm = 1.0 if str(attrs.get("cache_dtype", "")).startswith(
+        ("fp8", "float8", "e4m3", "e5m2")) else _B
+    live = c / 2.0 + s
+    return _B * 4.0 * b * h * s * d + \
+        itm * 2.0 * b * h * (live + s) * d
 
 
 def _rope_cost():

@@ -55,7 +55,7 @@ from .registry import OP_REGISTRY, get_op, register
 
 __all__ = ["pallas_call", "pallas_sgd_mom_update", "pallas_adam_update",
            "fused_softmax_ce", "fused_conv_bn_relu", "fused_layernorm",
-           "fused_bias_gelu", "fused_embedding"]
+           "fused_bias_gelu", "fused_embedding", "decode_attention"]
 
 
 def _interpret():
@@ -943,6 +943,116 @@ def _register_embedding_variant():
         emb.add_variant("pallas", _embedding_variant,
                         eligible=_embedding_eligible,
                         kernel_spec=_EMB_KSPEC)
+
+
+# ==========================================================================
+# flash-decode attention (the attention_decode pallas variant — rtc.py
+# owns the op, the RoPE/cache-write prologue, and the registration; the
+# kernel here is only the cursor-bounded attention READ)
+# ==========================================================================
+def _decode_attn_kernel(block_k, s_len, scale):
+    def kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, m_s, l_s, acc_s):
+        b = pl.program_id(0)
+        kb = pl.program_id(1)
+        n_kb = pl.num_programs(1)
+
+        @pl.when(kb == 0)
+        def _init():
+            m_s[...] = jnp.full(m_s.shape, -jnp.inf, jnp.float32)
+            l_s[...] = jnp.zeros(l_s.shape, jnp.float32)
+            acc_s[...] = jnp.zeros(acc_s.shape, jnp.float32)
+
+        cursor = pos_ref[b]                  # this row's write position
+        k_start = kb * block_k
+
+        def update():
+            q = q_ref[...].astype(jnp.float32) * scale     # (S, Dh)
+            k = k_ref[...].astype(jnp.float32)             # (block_k, Dh)
+            v = v_ref[...].astype(jnp.float32)
+            # HIGHEST: match the XLA composition's f32 accumulation;
+            # the astype above is also the fp8-cache dequant on read
+            s = jnp.dot(q, k.T, precision=jax.lax.Precision.HIGHEST)
+            # query row i sits at stream position cursor + i and attends
+            # key positions <= that (the same comparison as the XLA mask)
+            q_pos = cursor + jax.lax.broadcasted_iota(
+                jnp.int32, (s_len, block_k), 0)
+            k_pos = k_start + jax.lax.broadcasted_iota(
+                jnp.int32, (s_len, block_k), 1)
+            s = jnp.where(k_pos <= q_pos, s, -jnp.inf)
+            m = m_s[...]                     # (S, 1) f32
+            m_blk = jnp.max(s, axis=-1, keepdims=True)
+            m_new = jnp.maximum(m, m_blk)
+            m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+            p = jnp.exp(s - m_safe)
+            p = jnp.where(jnp.isfinite(s), p, 0.0)
+            corr = jnp.where(jnp.isfinite(m), jnp.exp(m - m_safe), 0.0)
+            m_s[...] = m_new
+            l_s[...] = l_s[...] * corr + jnp.sum(p, axis=-1,
+                                                 keepdims=True)
+            acc_s[...] = acc_s[...] * corr + jnp.dot(
+                p, v, precision=jax.lax.Precision.HIGHEST)
+
+        # blocks wholly past the live prefix [0, cursor + S) mask to
+        # nothing: skip their FLOPs (their index map also re-points at
+        # the last live block, so they cost no HBM traffic either).
+        # Block 0 always runs — cursor >= 0 keys at least one position,
+        # so l is never zero at emit.
+        pl.when(k_start <= cursor + s_len - 1)(update)
+
+        @pl.when(kb == n_kb - 1)
+        def _emit():
+            l = jnp.maximum(l_s[...], 1e-30)
+            o_ref[...] = (acc_s[...] / l).astype(o_ref.dtype)
+    return kernel
+
+
+def decode_attention(q, k_cache, v_cache, pos, block_k=128):
+    """Cursor-bounded flash-decode read over a fixed-capacity KV cache.
+
+    ``q`` is (B, H, S, Dh) already-rotated queries, the caches are
+    (B, H, C, Dh) with the step's rows already written, and ``pos`` is
+    the (B,) per-row cursor (a scalar-cursor engine broadcasts before
+    calling). The per-(b, h) grid row walks C // block_k cache blocks,
+    but the scalar-prefetched cursor clamps the K/V index maps to the
+    last live block — dead blocks re-reference an already-resident
+    index, so HBM traffic is proportional to the live prefix
+    ``[0, cursor_b + S)``, not the capacity. Online-softmax (m, l, acc)
+    accumulates in f32 VMEM scratch; fp8 cache rows dequantize on read
+    inside the kernel. Returns f32 (B, H, S, Dh) — the caller casts.
+    """
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, H, S, Dh = q.shape
+    C = k_cache.shape[2]
+    block_k = _divisor_block(C, block_k)
+    scale = float(Dh) ** -0.5
+    qf = q.reshape(B * H, S, Dh)
+    kf = k_cache.reshape(B * H, C, Dh)
+    vf = v_cache.reshape(B * H, C, Dh)
+    # row cursor per (b, h) pair, b-major to match the reshape order
+    pos_bh = jnp.repeat(pos.astype(jnp.int32), H)
+
+    def _kv_map(b, j, pos_ref):
+        last_live = (pos_ref[b] + (S - 1)) // block_k
+        return (b, jnp.minimum(j, last_live), 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1, grid=(B * H, C // block_k),
+        in_specs=[
+            pl.BlockSpec((None, S, Dh), lambda b, j, pos_ref: (b, 0, 0)),
+            pl.BlockSpec((None, block_k, Dh), _kv_map),
+            pl.BlockSpec((None, block_k, Dh), _kv_map),
+        ],
+        out_specs=pl.BlockSpec((None, S, Dh),
+                               lambda b, j, pos_ref: (b, 0, 0)),
+        scratch_shapes=[pltpu.VMEM((S, 1), jnp.float32),
+                        pltpu.VMEM((S, 1), jnp.float32),
+                        pltpu.VMEM((S, Dh), jnp.float32)])
+    out = pallas_call(
+        _decode_attn_kernel(block_k, S, scale),
+        out_shape=jax.ShapeDtypeStruct((B * H, S, Dh), jnp.float32),
+        grid_spec=grid_spec)(pos_bh, qf, kf, vf)
+    return out.reshape(B, H, S, Dh)
 
 
 def _register_opt_variants():

@@ -1,4 +1,5 @@
-"""Post-training int8 quantization tier (weight-only, per-channel).
+"""Post-training quantization tiers (weight-only, per-channel):
+int8 and fp8 (``float8_e4m3fn`` storage + f32 scales).
 
 Serving is memory-bound: the bucket-ladder programs stream every weight
 matrix out of HBM per dispatch, so halving/quartering weight bytes
@@ -46,13 +47,26 @@ import jax.numpy as jnp
 from ..base import MXNetError, parse_bool, parse_int
 from .registry import OP_REGISTRY, get_op, register
 
-__all__ = ["INT8_TOL", "quantize_per_channel", "dequantize",
-           "quantize_symbol", "quantizable_weights"]
+__all__ = ["INT8_TOL", "FP8_TOL", "FP8_MAX", "quantize_per_channel",
+           "dequantize", "quantize_symbol", "quantizable_weights"]
 
 #: tolerance class for int8-vs-float OUTPUT comparison (per-channel
 #: symmetric weight-only PTQ introduces <= 1/254 relative weight error;
 #: tests and the serve gate compare against the float ladder with this)
 INT8_TOL = {"atol": 0.05, "rtol": 0.05}
+
+#: tolerance class for fp8-vs-float OUTPUT comparison: e4m3's 3-bit
+#: mantissa bounds per-weight relative error at 2^-4 (6.25%) after the
+#: per-channel amax/448 scaling, so outputs sit a bit wider than int8's
+FP8_TOL = {"atol": 0.15, "rtol": 0.15}
+
+#: max finite magnitude of float8_e4m3fn (the fp8 serving storage type)
+FP8_MAX = 448.0
+
+#: dtype aliases quantize surfaces accept -> canonical storage dtype
+_QUANT_DTYPES = {"int8": "int8",
+                 "fp8": "float8_e4m3fn",
+                 "float8_e4m3fn": "float8_e4m3fn"}
 
 #: ops the rewrite lowers, old op name -> quantized op name
 _QUANT_OPS = {"FullyConnected": "QuantizedFullyConnected",
@@ -60,22 +74,33 @@ _QUANT_OPS = {"FullyConnected": "QuantizedFullyConnected",
 
 
 # ----------------------------------------------------------- numerics
-def quantize_per_channel(arr, axis=0):
-    """Symmetric per-channel int8 quantization.
+def quantize_per_channel(arr, axis=0, dtype="int8"):
+    """Symmetric per-channel narrow-dtype quantization.
 
-    Returns ``(q, scale)``: ``q`` int8 shaped like ``arr``, ``scale``
-    f32 shaped ``(arr.shape[axis],)`` with ``arr ≈ q * scale`` along
-    ``axis``. All-zero channels get scale 1.0 (q is zero anyway).
+    Returns ``(q, scale)``: ``q`` shaped like ``arr`` in the storage
+    dtype (``int8`` or ``fp8``/``float8_e4m3fn``), ``scale`` f32 shaped
+    ``(arr.shape[axis],)`` with ``arr ≈ q * scale`` along ``axis``.
+    int8 maps amax to 127 with round-to-nearest; fp8 maps amax to the
+    e4m3 max finite (448) and lets the cast's mantissa rounding land
+    the rest. All-zero channels get scale 1.0 (q is zero anyway).
     """
+    storage = _QUANT_DTYPES.get(str(dtype))
+    if storage is None:
+        raise MXNetError(f"quantize: unsupported dtype {dtype!r} "
+                         "(int8 or fp8)")
     a = np.asarray(arr.asnumpy() if hasattr(arr, "asnumpy") else arr,
                    dtype=np.float32)
     red = tuple(i for i in range(a.ndim) if i != axis)
     amax = np.max(np.abs(a), axis=red) if red else np.abs(a)
-    scale = np.where(amax > 0, amax / 127.0, 1.0).astype(np.float32)
     bshape = [1] * a.ndim
     bshape[axis] = -1
-    q = np.clip(np.round(a / scale.reshape(bshape)), -127, 127)
-    return q.astype(np.int8), scale
+    if storage == "int8":
+        scale = np.where(amax > 0, amax / 127.0, 1.0).astype(np.float32)
+        q = np.clip(np.round(a / scale.reshape(bshape)), -127, 127)
+        return q.astype(np.int8), scale
+    scale = np.where(amax > 0, amax / FP8_MAX, 1.0).astype(np.float32)
+    q = np.clip(a / scale.reshape(bshape), -FP8_MAX, FP8_MAX)
+    return q.astype(np.dtype("float8_e4m3fn")), scale
 
 
 def dequantize(q, scale, axis=0):
@@ -164,7 +189,7 @@ def _qfc_eligible(attrs, in_shapes, in_dtypes):
     data_s, w_s = in_shapes[0], in_shapes[1]
     if len(data_s) != 2 or len(w_s) != 2:
         return False
-    if str(in_dtypes[1]) != "int8":
+    if str(in_dtypes[1]) not in ("int8", "float8_e4m3fn"):
         return False
     if w_s[1] > 16384 or str(in_dtypes[0]) not in (
             "float32", "bfloat16", "float16"):
@@ -240,7 +265,7 @@ def _qconv_eligible(attrs, in_shapes, in_dtypes):
     w_s = in_shapes[1]
     if len(in_shapes[0]) != 4 or len(w_s) != 4:
         return False
-    if str(in_dtypes[1]) != "int8":
+    if str(in_dtypes[1]) not in ("int8", "float8_e4m3fn"):
         return False
     if int(np.prod(w_s[1:])) > 65536 or str(in_dtypes[0]) not in (
             "float32", "bfloat16", "float16"):
@@ -258,13 +283,16 @@ def _qconv_eligible(attrs, in_shapes, in_dtypes):
 _QFC_KSPEC = {
     "tiles": [((256, 8192), "float32"), ((256, 16384), "int8"),
               ((256, 256), "float32")],
-    "dtypes": ("float32", "bfloat16", "float16", "int8"),
+    "dtypes": ("float32", "bfloat16", "float16", "int8",
+               "float8_e4m3fn"),
 }
 
-#: dequant rows pass at the _qconv_eligible bound: int8 in + f32 out
+#: dequant rows pass at the _qconv_eligible bound: 1-B weights in
+#: (int8 or fp8 — same residency) + f32 out
 _QCONV_KSPEC = {
     "tiles": [((256, 6144), "int8"), ((256, 6144), "float32")],
-    "dtypes": ("float32", "bfloat16", "float16", "int8"),
+    "dtypes": ("float32", "bfloat16", "float16", "int8",
+               "float8_e4m3fn"),
 }
 
 
@@ -318,16 +346,18 @@ def quantize_symbol(symbol, arg_params, dtype="int8"):
     """Rewrite a trained graph onto the quantized ops.
 
     Returns ``(qsymbol, qarg_params)``: every quantizable weight ``w``
-    is replaced in the params by ``w_q`` (int8) + ``w_scale`` (f32) and
-    its consumer nodes become Quantized* nodes (same node names, so
-    output names and downstream wiring are unchanged). Aux params are
-    untouched — pass the originals alongside.
+    is replaced in the params by ``w_q`` (the storage dtype — int8 or
+    fp8/float8_e4m3fn) + ``w_scale`` (f32) and its consumer nodes
+    become Quantized* nodes (same node names, so output names and
+    downstream wiring are unchanged). Aux params are untouched — pass
+    the originals alongside.
     """
     from ..ndarray import NDArray
     from ..symbol import Node, Symbol
-    if str(dtype) != "int8":
+    storage = _QUANT_DTYPES.get(str(dtype))
+    if storage is None:
         raise MXNetError(f"quantize: unsupported dtype {dtype!r} "
-                         "(int8 only)")
+                         "(int8 or fp8)")
     targets = set(quantizable_weights(symbol, arg_params))
     if not targets:
         raise MXNetError(
@@ -339,7 +369,7 @@ def quantize_symbol(symbol, arg_params, dtype="int8"):
     def qvar(name):
         if name not in qvars:
             qvars[name] = (
-                Node(None, f"{name}_q", extra={"__dtype__": "int8"}),
+                Node(None, f"{name}_q", extra={"__dtype__": storage}),
                 Node(None, f"{name}_scale",
                      extra={"__dtype__": "float32"}))
         return qvars[name]
@@ -372,7 +402,7 @@ def quantize_symbol(symbol, arg_params, dtype="int8"):
     qargs = {}
     for name, val in arg_params.items():
         if name in qvars:
-            q, s = quantize_per_channel(val, axis=0)
+            q, s = quantize_per_channel(val, axis=0, dtype=storage)
             qargs[f"{name}_q"] = NDArray(jnp.asarray(q))
             qargs[f"{name}_scale"] = NDArray(jnp.asarray(s))
         else:

@@ -50,12 +50,13 @@ def export_model(path, symbol, arg_params, aux_params, data_shapes,
     dtype (default float32) — recorded per input in the manifest and
     baked into the exported program's input avals, so bf16/int inputs
     (embedding ids, token streams) round-trip through the artifact.
-    ``quantize="int8"``: post-training per-channel weight quantization
-    at export — the graph's dense/conv weights are captured as int8 +
+    ``quantize="int8"`` / ``"fp8"``: post-training per-channel weight
+    quantization at export — the graph's dense/conv weights are
+    captured in the narrow storage dtype (int8 or float8_e4m3fn) +
     per-channel f32 scales (``ops/quant.py``) and the artifact embeds
     the quantized graph, so the ``.mxp`` ships ~4x smaller weights and
-    the serving tier can pin int8 rungs; outputs stay within
-    ``quant.INT8_TOL`` of the float export.
+    the serving tier can pin quantized rungs; outputs stay within
+    ``quant.INT8_TOL`` / ``quant.FP8_TOL`` of the float export.
     """
     import jax
     import jax.numpy as jnp
@@ -231,8 +232,8 @@ class Predictor:
 
     @property
     def quantize(self):
-        """The artifact's PTQ mode (``"int8"``) or None for float
-        exports (pre-quantization artifacts included)."""
+        """The artifact's PTQ mode (``"int8"`` / ``"fp8"``) or None for
+        float exports (pre-quantization artifacts included)."""
         return self._manifest.get("quantize")
 
     @property

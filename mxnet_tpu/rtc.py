@@ -651,9 +651,80 @@ def _register_attention():
 #   rewritten by the new sequence before its first read — slot reuse is
 #   bit-clean without touching the cache rows.
 # --------------------------------------------------------------------------
-def _attention_decode_fwd(attrs, inputs, aux, is_train, rng):
+def _decode_check_overflow(pos, S, capacity, per_slot):
+    """Overflow raises cleanly whenever the cursor is concrete (eager
+    dispatch); jitted paths enforce it host-side via the decode driver
+    (models.transformer.KVCacheDecoder) — dynamic_update_slice would
+    otherwise silently clamp the write."""
+    if isinstance(pos, jax.core.Tracer):
+        return
+    if per_slot:
+        over = [int(i) for i in np.nonzero(
+            np.asarray(pos) + S > capacity)[0]]
+        if over:
+            raise MXNetError(
+                f"attention_decode: cache overflow in slot(s) {over} "
+                f"(cursor + {S} > capacity {capacity}); retire the "
+                "sequence or re-bind with a larger capacity=")
+    elif int(pos) + S > capacity:
+        raise MXNetError(
+            f"attention_decode: cache overflow (pos {int(pos)} + {S} new "
+            f"tokens > capacity {capacity}); re-bind with a larger "
+            "capacity= or reset the cache")
+
+
+def _decode_rope_write(attrs, q, k, v, k_cache, v_cache, pos, per_slot):
+    """RoPE + cache write, shared verbatim by the XLA composition and
+    the Pallas decode variant (the kernel only replaces the attention
+    read) — so the write semantics stay bit-identical across tiers.
+    ``pos`` is a scalar (single-session) or a (B,) vector (slot pool).
+    Returns the rotated q and the updated caches."""
     from .base import parse_bool, parse_float
     from .ops.nn import rope_apply
+
+    B, H, S, Dh = q.shape
+    capacity = k_cache.shape[2]
+    if parse_bool(attrs.get("rope", False)):
+        base = parse_float(attrs.get("rope_base", 10000.0))
+        if per_slot:
+            positions = pos[:, None] + jnp.arange(S)[None, :]   # (B, S)
+        else:
+            positions = pos + jnp.arange(S)
+        q = rope_apply(q, positions, base)
+        k = rope_apply(k, positions, base)
+    if not per_slot:
+        k_cache = jax.lax.dynamic_update_slice(
+            k_cache, k.astype(k_cache.dtype), (0, 0, pos, 0))
+        v_cache = jax.lax.dynamic_update_slice(
+            v_cache, v.astype(v_cache.dtype), (0, 0, pos, 0))
+    elif S == 1:
+        # one-hot per-slot write: jnp.where keeps untouched cache
+        # positions bit-identical and lands each slot's token at its own
+        # cursor; a cursor past capacity matches nothing (no clamped
+        # write). Kept verbatim for S=1 so the steady-state decode
+        # program stays bit-identical to the pre-window pin.
+        key_pos = jnp.arange(capacity)                         # (C,)
+        write = (key_pos[None, :] == pos[:, None])[:, None, :, None]
+        k_cache = jnp.where(write, k.astype(k_cache.dtype), k_cache)
+        v_cache = jnp.where(write, v.astype(v_cache.dtype), v_cache)
+    else:
+        # window write: each slot lands its S rows at its own cursor.
+        # vmap over B means a slot only ever writes its OWN cache row,
+        # so the clamp DUS applies near capacity can't corrupt a
+        # batchmate — the driver guards pos + S <= capacity for every
+        # slot that is still live.
+        def _write_row(cache_row, new_row, p):
+            return jax.lax.dynamic_update_slice(cache_row, new_row,
+                                                (0, p, 0))
+        k_cache = jax.vmap(_write_row)(k_cache,
+                                       k.astype(k_cache.dtype), pos)
+        v_cache = jax.vmap(_write_row)(v_cache,
+                                       v.astype(v_cache.dtype), pos)
+    return q, k_cache, v_cache
+
+
+def _attention_decode_fwd(attrs, inputs, aux, is_train, rng):
+    from .base import parse_bool
 
     q, k, v = inputs                       # (B, H, S, Dh), S new tokens
     k_cache, v_cache, cursor = aux         # (B,H,C,Dh) x2 + cursor
@@ -666,25 +737,11 @@ def _attention_decode_fwd(attrs, inputs, aux, is_train, rng):
     B, H, S, Dh = q.shape
     capacity = k_cache.shape[2]
     pos = cursor.reshape(()).astype(jnp.int32)
-    # overflow raises cleanly whenever the cursor is concrete (eager
-    # dispatch); jitted paths enforce it host-side via the decode driver
-    # (models.transformer.KVCacheDecoder) — dynamic_update_slice would
-    # otherwise silently clamp the write
-    if not isinstance(pos, jax.core.Tracer) and int(pos) + S > capacity:
-        raise MXNetError(
-            f"attention_decode: cache overflow (pos {int(pos)} + {S} new "
-            f"tokens > capacity {capacity}); re-bind with a larger "
-            "capacity= or reset the cache")
+    _decode_check_overflow(pos, S, capacity, per_slot=False)
     scale = 1.0 / float(np.sqrt(Dh))
-    if parse_bool(attrs.get("rope", False)):
-        base = parse_float(attrs.get("rope_base", 10000.0))
-        positions = pos + jnp.arange(S)
-        q = rope_apply(q, positions, base)
-        k = rope_apply(k, positions, base)
-    k_cache = jax.lax.dynamic_update_slice(
-        k_cache, k.astype(k_cache.dtype), (0, 0, pos, 0))
-    v_cache = jax.lax.dynamic_update_slice(
-        v_cache, v.astype(v_cache.dtype), (0, 0, pos, 0))
+    q, k_cache, v_cache = _decode_rope_write(attrs, q, k, v, k_cache,
+                                             v_cache, pos,
+                                             per_slot=False)
     # same numerics shape as the full forward (ring_attention.attention):
     # f32 logits at HIGHEST precision, -inf causal mask, f32 softmax
     logits = jnp.einsum("bhqd,bhkd->bhqk", q, k_cache.astype(q.dtype),
@@ -711,49 +768,14 @@ def _attention_decode_per_slot(attrs, q, k, v, k_cache, v_cache, cursor):
     cursor via a per-row ``dynamic_update_slice`` and the causal mask
     runs over ``cursor[b] + arange(S)``, so one pinned program advances
     B staggered sequences by S positions per dispatch."""
-    from .base import parse_bool, parse_float
-    from .ops.nn import rope_apply
-
     B, H, S, Dh = q.shape
     capacity = k_cache.shape[2]
     pos = cursor.reshape((B,)).astype(jnp.int32)          # (B,)
-    if not isinstance(pos, jax.core.Tracer):
-        over = [int(i) for i in np.nonzero(
-            np.asarray(pos) + S > capacity)[0]]
-        if over:
-            raise MXNetError(
-                f"attention_decode: cache overflow in slot(s) {over} "
-                f"(cursor + {S} > capacity {capacity}); retire the "
-                "sequence or re-bind with a larger capacity=")
+    _decode_check_overflow(pos, S, capacity, per_slot=True)
     scale = 1.0 / float(np.sqrt(Dh))
-    if parse_bool(attrs.get("rope", False)):
-        base = parse_float(attrs.get("rope_base", 10000.0))
-        positions = pos[:, None] + jnp.arange(S)[None, :]  # (B, S)
-        q = rope_apply(q, positions, base)
-        k = rope_apply(k, positions, base)
+    q, k_cache, v_cache = _decode_rope_write(attrs, q, k, v, k_cache,
+                                             v_cache, pos, per_slot=True)
     key_pos = jnp.arange(capacity)                         # (C,)
-    if S == 1:
-        # one-hot per-slot write: jnp.where keeps untouched cache
-        # positions bit-identical and lands each slot's token at its own
-        # cursor; a cursor past capacity matches nothing (no clamped
-        # write). Kept verbatim for S=1 so the steady-state decode
-        # program stays bit-identical to the pre-window pin.
-        write = (key_pos[None, :] == pos[:, None])[:, None, :, None]
-        k_cache = jnp.where(write, k.astype(k_cache.dtype), k_cache)
-        v_cache = jnp.where(write, v.astype(v_cache.dtype), v_cache)
-    else:
-        # window write: each slot lands its S rows at its own cursor.
-        # vmap over B means a slot only ever writes its OWN cache row,
-        # so the clamp DUS applies near capacity can't corrupt a
-        # batchmate — the driver guards pos + S <= capacity for every
-        # slot that is still live.
-        def _write_row(cache_row, new_row, p):
-            return jax.lax.dynamic_update_slice(cache_row, new_row,
-                                                (0, p, 0))
-        k_cache = jax.vmap(_write_row)(k_cache,
-                                       k.astype(k_cache.dtype), pos)
-        v_cache = jax.vmap(_write_row)(v_cache,
-                                       v.astype(v_cache.dtype), pos)
     logits = jnp.einsum("bhqd,bhkd->bhqk", q, k_cache.astype(q.dtype),
                         precision=jax.lax.Precision.HIGHEST,
                         preferred_element_type=jnp.float32) * scale
@@ -770,6 +792,63 @@ def _attention_decode_per_slot(attrs, q, k, v, k_cache, v_cache, cursor):
                      preferred_element_type=jnp.float32)
     new_cursor = (pos + S).reshape((B, 1)).astype(jnp.int32)
     return [out.astype(q.dtype)], [k_cache, v_cache, new_cursor]
+
+
+def _attention_decode_pallas_variant(attrs, inputs, aux, is_train, rng):
+    """Flash-decode lowering: RoPE + cache writes stay the exact shared
+    XLA helpers (bit-identical cache contents across tiers); only the
+    attention READ — the cache-bandwidth-bound part — runs the Pallas
+    kernel (ops/pallas_kernels.decode_attention), whose scalar-prefetched
+    cursor bounds the K/V blocks actually fetched from HBM to the live
+    prefix ``[0, cursor_b + S)`` instead of the full capacity."""
+    from .base import parse_bool
+    from .ops.pallas_kernels import decode_attention
+
+    q, k, v = inputs
+    k_cache, v_cache, cursor = aux
+    if is_train:
+        raise MXNetError("attention_decode is an inference op (train "
+                         "with the full-sequence `attention` graph)")
+    B, H, S, Dh = q.shape
+    capacity = k_cache.shape[2]
+    per_slot = parse_bool(attrs.get("per_slot", False))
+    if per_slot:
+        pos = cursor.reshape((B,)).astype(jnp.int32)
+        new_cursor = (pos + S).reshape((B, 1)).astype(jnp.int32)
+    else:
+        pos = cursor.reshape(()).astype(jnp.int32)
+        new_cursor = (pos + S).reshape((1,)).astype(jnp.int32)
+    _decode_check_overflow(pos, S, capacity, per_slot=per_slot)
+    q, k_cache, v_cache = _decode_rope_write(attrs, q, k, v, k_cache,
+                                             v_cache, pos,
+                                             per_slot=per_slot)
+    # the kernel is row-cursor uniform: the scalar layout is the
+    # per-slot layout with every row at the same position
+    pos_rows = pos if per_slot else jnp.broadcast_to(pos, (B,))
+    out = decode_attention(q, k_cache, v_cache, pos_rows)
+    return [out.astype(q.dtype)], [k_cache, v_cache, new_cursor]
+
+
+def _attention_decode_eligible(attrs, in_shapes, in_dtypes):
+    """Decode windows up to the declared kspec bounds: S <= 64 head
+    rows resident, Dh <= 512, cache blocks tiling the capacity. The
+    cache may be the compute width or an fp8 storage dtype (dequantized
+    in-kernel on read). On a real TPU the head dim must be
+    lane-aligned; interpret mode (off-TPU parity tests) takes any."""
+    from .ops.pallas_kernels import _interpret
+    if len(in_shapes) < 6 or len(in_shapes[0]) != 4 \
+            or len(in_shapes[3]) != 4:
+        return False
+    b, h, s, dh = in_shapes[0]
+    c = in_shapes[3][2]
+    if s > 64 or dh > 512 or c < 1:
+        return False
+    if str(in_dtypes[0]) not in ("float32", "bfloat16", "float16"):
+        return False
+    if str(in_dtypes[3]) not in ("float32", "bfloat16", "float16",
+                                 "float8_e4m3fn", "float8_e5m2"):
+        return False
+    return (dh % 128 == 0 and c % 128 == 0) or _interpret()
 
 
 def _attention_decode_infer(attrs, in_shapes):
@@ -800,6 +879,37 @@ _ATTENTION_DECODE_KSPEC = {
     "dtypes": ("float32", "bfloat16", "float16"),
 }
 
+#: the flash-decode kernel's worst-case VMEM set at the eligibility
+#: bounds (S<=64, Dh<=512, 128-row cache blocks): q + one K + one V
+#: block + the f32 m/l/acc scratch + the out window. fp8 cache dtypes
+#: are in the gate set — the kernel dequantizes storage rows on read.
+_ATTENTION_DECODE_PALLAS_KSPEC = {
+    "tiles": [((64, 512), "float32"),      # q window
+              ((128, 512), "float32"),     # k_cache block
+              ((128, 512), "float32"),     # v_cache block
+              ((64, 512), "float32"),      # acc scratch
+              ((64, 128), "float32"),      # m + l scratch (lane-padded)
+              ((64, 512), "float32")],     # out window
+    "dtypes": ("float32", "bfloat16", "float16",
+               "float8_e4m3fn", "float8_e5m2"),
+}
+
+#: aliases accepted by the ``cache_dtype`` attr (fp8 KV storage)
+_CACHE_DTYPE_ALIASES = {"fp8": "float8_e4m3fn",
+                        "e4m3": "float8_e4m3fn",
+                        "e5m2": "float8_e5m2"}
+
+
+def _cache_dtype_of(attrs):
+    """Resolve the declared KV-cache storage dtype, or None for the
+    default (compute-width) cells. Used as a callable aux_dtypes entry
+    so only non-default graphs stamp ``__dtype__`` on the cache cells —
+    existing serialized graphs stay byte-identical."""
+    val = str(attrs.get("cache_dtype", "") or "").strip()
+    if not val:
+        return None
+    return _CACHE_DTYPE_ALIASES.get(val, val)
+
 
 def _register_attention_decode():
     if "attention_decode" in OP_REGISTRY:
@@ -811,12 +921,18 @@ def _register_attention_decode():
                  aux=("k_cache", "v_cache", "cache_pos"),
                  full=_attention_decode_fwd,
                  stateful_infer=True,
-                 aux_dtypes={"cache_pos": "int32"},
+                 aux_dtypes={"cache_pos": "int32",
+                             "k_cache": _cache_dtype_of,
+                             "v_cache": _cache_dtype_of},
                  infer_shape=_attention_decode_infer,
                  attr_spec={"capacity": (int, 256),
                             "rope": (None, False),
                             "rope_base": (float, 10000.0),
-                            "per_slot": (None, False)})
+                            "per_slot": (None, False),
+                            "cache_dtype": (str, "")},
+                 variants={"pallas": (_attention_decode_pallas_variant,
+                                      _attention_decode_eligible,
+                                      _ATTENTION_DECODE_PALLAS_KSPEC)})
 
 
 _register_flash()

@@ -157,20 +157,23 @@ class BucketEngine(_EngineBase):
         from ..context import current_context
         from ..module import BucketingModule
 
-        # compute_dtype="int8" selects the quantized inference tier:
-        # the symbol is rewritten onto the Quantized* ops and every
-        # dense/conv weight splits into an int8 cell + per-channel f32
-        # scales (ops/quant.py) BEFORE binding, so each ladder rung pins
-        # a quantized program and the warm-restart payload (serve/
+        # compute_dtype="int8" / "fp8" selects a quantized inference
+        # tier: the symbol is rewritten onto the Quantized* ops and
+        # every dense/conv weight splits into a narrow storage cell
+        # (int8 or float8_e4m3fn) + per-channel f32 scales
+        # (ops/quant.py) BEFORE binding, so each ladder rung pins a
+        # quantized program and the warm-restart payload (serve/
         # warm.py) persists the already-quantized symbol+params —
         # restores rebuild without re-quantizing. Activations stay
-        # float; outputs sit within quant.INT8_TOL of the float ladder.
+        # float; outputs sit within quant.INT8_TOL / quant.FP8_TOL of
+        # the float ladder.
         self.quantized = None
-        if compute_dtype is not None and str(compute_dtype) == "int8":
+        if compute_dtype is not None and str(compute_dtype) in (
+                "int8", "fp8", "float8_e4m3fn"):
             from ..ops import quant as _quant
             symbol, arg_params = _quant.quantize_symbol(
-                symbol, dict(arg_params or {}))
-            self.quantized = "int8"
+                symbol, dict(arg_params or {}), dtype=str(compute_dtype))
+            self.quantized = str(compute_dtype)
             compute_dtype = None
 
         if isinstance(data_shapes, dict):

@@ -156,8 +156,8 @@ class InferenceServer:
                 raise MXNetError(
                     f"register({name!r}) needs model=, predictor=, or "
                     "symbol= + params + data_shapes")
-            # MXNET_SERVE_QUANTIZE=int8 defaults every symbol-sourced
-            # registration onto the quantized ladder (explicit
+            # MXNET_SERVE_QUANTIZE=int8|fp8 defaults every symbol-
+            # sourced registration onto the quantized ladder (explicit
             # compute_dtype= wins)
             if compute_dtype is None:
                 import os as _os

@@ -624,6 +624,12 @@ def _create(op_name, input_syms, name=None, attr=None, **params):
         # cache cursor): stamp it onto the auto-created variable so
         # binding honors it (and the mixed-precision cast exempts it)
         adt = opdef.aux_dtypes.get(anm)
+        if callable(adt):
+            # attr-dependent cells (attention_decode's fp8 KV storage):
+            # the callable sees the node attrs and returns None for the
+            # default compute-width cell (no stamp — unchanged graphs
+            # serialize byte-identically)
+            adt = adt(attrs or {})
         if adt is not None:
             aux_extra["__dtype__"] = str(np.dtype(adt))
         vnode = Node(None, f"{node_name}_{anm}", extra=aux_extra)

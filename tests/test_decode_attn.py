@@ -1,0 +1,258 @@
+"""Decode-attention Pallas kernel (ISSUE 19): flash-decode parity,
+selection discipline, and the zero-compile serving contract.
+
+The kernel replaces only the attention READ of ``attention_decode`` —
+RoPE and the cache writes stay the shared XLA helpers — so the parity
+gates here assert three things at once: outputs within the tier
+tolerance, cache contents BIT-identical across tiers, and cursors
+equal. Both cursor layouts (scalar single-session and per_slot pool),
+both window sizes (S=1 steady state, S>1 chunked prefill), staggered
+cursors including slot reuse, and the fp8 KV-cache storage tier all
+run through the same harness. Selection rides the standard kernel-tier
+rules: a scripted slower measurement can never pick the kernel, and
+with the kernel + fp8 cache armed the decode engine compiles nothing
+after warmup at any rung.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import kernel_tier, program_cache
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.ops.registry import get_op
+
+OP = get_op("attention_decode")
+B, H, DH, C = 2, 2, 8, 32
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    monkeypatch.delenv("MXNET_KERNEL_TIER", raising=False)
+    monkeypatch.delenv("MXNET_LM_CACHE_DTYPE", raising=False)
+    kernel_tier.clear()
+    yield
+    kernel_tier.clear()
+
+
+def _attrs(per_slot=False, cache_dtype="", rope=False, capacity=C):
+    return OP.normalize_attrs({"capacity": capacity, "per_slot": per_slot,
+                               "cache_dtype": cache_dtype, "rope": rope})
+
+
+def _state(S=1, dtype="float32", per_slot=False, cursors=None,
+           cache_dtype=None, seed=0, capacity=C):
+    """Random q/k/v + a cache whose live prefix holds real rows."""
+    rng = np.random.RandomState(seed)
+    dt = np.dtype(dtype)
+    q, k, v = (jnp.asarray(rng.randn(B, H, S, DH), dt) for _ in range(3))
+    cdt = np.dtype(cache_dtype) if cache_dtype else dt
+    k_cache = jnp.asarray(rng.randn(B, H, capacity, DH), cdt)
+    v_cache = jnp.asarray(rng.randn(B, H, capacity, DH), cdt)
+    if cursors is None:
+        cursors = [3] * B if per_slot else 3
+    cur = jnp.asarray(np.reshape(cursors, (B, 1)), jnp.int32) \
+        if per_slot else jnp.asarray([cursors], jnp.int32)
+    return [q, k, v], [k_cache, v_cache, cur]
+
+
+def _both(attrs, inputs, aux):
+    ref_o, ref_a = OP.forward(attrs, inputs, aux, False, None)
+    pal_o, pal_a = OP.variants["pallas"]["fn"](attrs, inputs, aux,
+                                               False, None)
+    return ref_o[0], ref_a, pal_o[0], pal_a
+
+
+def _assert_parity(attrs, inputs, aux, tol):
+    ref, ref_aux, pal, pal_aux = _both(attrs, inputs, aux)
+    assert ref.dtype == pal.dtype
+    np.testing.assert_allclose(np.asarray(ref, np.float32),
+                               np.asarray(pal, np.float32), atol=tol,
+                               rtol=tol)
+    # cache writes are the SHARED helper: bit-identical, dtype kept
+    for r, p in zip(ref_aux[:2], pal_aux[:2]):
+        assert r.dtype == p.dtype
+        assert np.array_equal(np.asarray(r, np.float32),
+                              np.asarray(p, np.float32))
+    assert np.array_equal(np.asarray(ref_aux[2]), np.asarray(pal_aux[2]))
+
+
+# ------------------------------------------------------------- parity
+@pytest.mark.parametrize("dtype,tol", [("float32", 2e-4),
+                                       ("bfloat16", 2e-2)])
+@pytest.mark.parametrize("per_slot", [False, True])
+@pytest.mark.parametrize("S", [1, 4])
+def test_decode_kernel_parity(dtype, tol, per_slot, S):
+    cursors = [1, 9] if per_slot else 5
+    inputs, aux = _state(S=S, dtype=dtype, per_slot=per_slot,
+                         cursors=cursors)
+    _assert_parity(_attrs(per_slot=per_slot), inputs, aux, tol)
+
+
+def test_decode_kernel_parity_rope():
+    inputs, aux = _state(S=1, per_slot=True, cursors=[2, 7])
+    _assert_parity(_attrs(per_slot=True, rope=True), inputs, aux, 2e-4)
+
+
+def test_decode_kernel_parity_staggered_and_edge_cursors():
+    """Slots at position 0, mid-stream, and at the last legal window
+    start — the cursor-bounded HBM read must still cover exactly the
+    live prefix of every row."""
+    inputs, aux = _state(S=1, per_slot=True, cursors=[0, C - 1])
+    _assert_parity(_attrs(per_slot=True), inputs, aux, 2e-4)
+
+
+def test_decode_kernel_parity_slot_reuse():
+    """Retire-and-rejoin: advance both slots, reset slot 0's cursor to
+    0 (the pool's join path resets ONLY the cursor), decode again —
+    the kernel's bounded read must mask the stale suffix exactly like
+    the XLA composition's -inf mask."""
+    attrs = _attrs(per_slot=True)
+    inputs, aux = _state(S=1, per_slot=True, cursors=[4, 11])
+    _, ref_aux, _, pal_aux = _both(attrs, inputs, aux)
+    rng = np.random.RandomState(9)
+    nxt = [jnp.asarray(rng.randn(B, H, 1, DH), jnp.float32)
+           for _ in range(3)]
+    rejoin = jnp.asarray([[0], [12]], jnp.int32)    # slot 0 reused
+    _assert_parity(attrs, nxt, [ref_aux[0], ref_aux[1], rejoin], 2e-4)
+
+
+def test_decode_kernel_fp8_cache():
+    """The fp8 storage tier: cache cells stay float8_e4m3fn through the
+    step (writes cast on store, reads dequantize), and the kernel
+    matches the XLA composition reading the SAME fp8 cells."""
+    inputs, aux = _state(S=1, per_slot=True, cursors=[2, 6],
+                         cache_dtype="float8_e4m3fn")
+    attrs = _attrs(per_slot=True, cache_dtype="fp8")
+    ref, ref_aux, pal, pal_aux = _both(attrs, inputs, aux)
+    assert ref_aux[0].dtype == np.dtype("float8_e4m3fn")
+    assert pal_aux[0].dtype == np.dtype("float8_e4m3fn")
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(pal),
+                               atol=2e-4, rtol=2e-4)
+    assert np.array_equal(np.asarray(ref_aux[0], np.float32),
+                          np.asarray(pal_aux[0], np.float32))
+
+
+def test_pallas_variant_rejects_training():
+    inputs, aux = _state()
+    with pytest.raises(MXNetError, match="inference"):
+        OP.variants["pallas"]["fn"](_attrs(), inputs, aux, True, None)
+
+
+# -------------------------------------------------- eligibility + gate
+def test_decode_eligibility_bounds():
+    elig = OP.variants["pallas"]["eligible"]
+    qs = (B, H, 1, DH)
+    cs = (B, H, C, DH)
+    shapes = [qs, qs, qs, cs, cs, (B, 1)]
+    f32 = ["float32"] * 5 + ["int32"]
+    assert elig(_attrs(), shapes, f32)
+    # fp8 cache cells are in the gate set
+    fp8 = ["float32"] * 3 + ["float8_e4m3fn"] * 2 + ["int32"]
+    assert elig(_attrs(cache_dtype="fp8"), shapes, fp8)
+    # bounds: window rows, head dim, q dtype
+    big_s = [(B, H, 65, DH)] + shapes[1:]
+    assert not elig(_attrs(), big_s, f32)
+    wide = [(B, H, 1, 513)] * 3 + [(B, H, C, 513)] * 2 + [(B, 1)]
+    assert not elig(_attrs(), wide, f32)
+    assert not elig(_attrs(), shapes, ["int8"] + f32[1:])
+
+
+def test_decode_numerics_gate():
+    qs, cs = (B, H, 1, DH), (B, H, C, DH)
+    ok, err = kernel_tier.numerics_gate(
+        OP, _attrs(per_slot=True), [qs, qs, qs, cs, cs, (B, 1)],
+        ["float32"] * 5 + ["int32"], is_train=False)
+    assert ok, f"max_abs_err={err}"
+
+
+def test_decode_pallas_never_selected_when_slower(monkeypatch):
+    """The decode kernel rides the same scripted-timer autotune as every
+    other variant: a slower measurement can never select it."""
+    qs, cs = (B, H, 1, DH), (B, H, C, DH)
+    shapes = [qs, qs, qs, cs, cs, (B, 1)]
+    dtypes = ["float32"] * 5 + ["int32"]
+    times = iter([1.0, 3.0])                   # xla 1ms, pallas 3ms
+    monkeypatch.setattr(kernel_tier, "_backend", lambda: "tpu")
+    monkeypatch.setattr(kernel_tier, "_device_kind", lambda: "TPU test")
+    monkeypatch.setattr(kernel_tier, "_time_variant",
+                        lambda run, r, x, reps: next(times) / 1e3)
+    assert kernel_tier.resolve(OP, _attrs(per_slot=True), shapes,
+                               dtypes, False) == "xla"
+    assert "slower" in kernel_tier.decisions()[-1]["reason"]
+
+
+# ------------------------------------------- serving: zero compiles
+V, D, L, NH, CAP = 64, 32, 2, 4, 32
+
+
+def _decoder_args():
+    from mxnet_tpu.models import transformer as tfm
+    np.random.seed(0)
+    sym = tfm.get_symbol(vocab_size=V, d_model=D, n_layer=L, n_head=NH,
+                         seq_len=8, include_loss=False, max_seq_len=CAP)
+    mod = mx.mod.Module(sym, label_names=[])
+    mod.bind([("data", (1, 8))], None, for_training=False)
+    mod.init_params(mx.initializer.Xavier(rnd_type="gaussian",
+                                          magnitude=2))
+    args, _ = mod.get_params()
+    return args
+
+
+@pytest.mark.parametrize("cache_dtype", [None, "fp8"])
+def test_decode_engine_zero_compiles_with_kernel_armed(monkeypatch,
+                                                       cache_dtype):
+    """The acceptance gate: MXNET_KERNEL_TIER=pallas (+ the fp8 cache
+    tier) armed, compile_count() delta == 0 after warmup at EVERY
+    ladder rung, with requests joining and retiring across rungs."""
+    from mxnet_tpu.models import transformer as tfm
+    monkeypatch.setenv("MXNET_KERNEL_TIER", "pallas")
+    kernel_tier.clear()
+    args = _decoder_args()
+    dsym = tfm.get_decode_symbol(vocab_size=V, d_model=D, n_layer=L,
+                                 n_head=NH, capacity=CAP, per_slot=True,
+                                 max_seq_len=CAP,
+                                 cache_dtype=cache_dtype)
+    sched = mx.serve.serve_decoder(dsym, args, name=f"za{cache_dtype}",
+                                   ladder=[1, 2, 4], start=True)
+    try:
+        rs = np.random.RandomState(0)
+        # warmup pinned every rung at engine build; steady state now
+        mark = program_cache.compile_count()
+        handles = [sched.submit(rs.randint(0, V, 4).tolist(),
+                                max_new_tokens=6) for _ in range(6)]
+        outs = [h.result(timeout=600) for h in handles]
+        assert all(len(o) == 6 for o in outs)
+        assert program_cache.compile_count() - mark == 0
+        assert sched.stats()["compiles_since_warmup"] == 0
+    finally:
+        sched.stop()
+
+
+def test_decode_driver_kernel_vs_xla_logits(monkeypatch):
+    """End to end through Module + KVCacheDecoder: the forced-kernel
+    decode chain reproduces the default chain's logits step for step."""
+    from mxnet_tpu.models import transformer as tfm
+    args = _decoder_args()
+    tokens = np.random.RandomState(3).randint(0, V, (2, 8))
+
+    def _run():
+        dsym = tfm.get_decode_symbol(vocab_size=V, d_model=D, n_layer=L,
+                                     n_head=NH, capacity=CAP,
+                                     max_seq_len=CAP)
+        dec = mx.mod.Module(dsym, label_names=[])
+        dec.bind([("data", (2, 1))], None, for_training=False)
+        dec.init_params(initializer=None, arg_params=args,
+                        aux_params={}, allow_missing=True)
+        drv = tfm.KVCacheDecoder(dec, capacity=CAP)
+        return [drv.step(tokens[:, t:t + 1]).asnumpy()
+                for t in range(tokens.shape[1])]
+
+    base = _run()
+    monkeypatch.setenv("MXNET_KERNEL_TIER", "pallas")
+    kernel_tier.clear()
+    forced = _run()
+    for a, b in zip(base, forced):
+        np.testing.assert_allclose(a, b, atol=2e-4, rtol=2e-4)
+        assert np.array_equal(a.argmax(-1), b.argmax(-1))

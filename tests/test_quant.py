@@ -1,9 +1,10 @@
-"""Int8 PTQ tier: per-channel quantization, graph rewrite, kernel-tier
-gates, export round-trip, quantized serving.
+"""Int8/fp8 PTQ tiers: per-channel quantization, graph rewrite,
+kernel-tier gates, export round-trip, quantized serving.
 
 Everything on the CPU mesh (Pallas interpret mode); the tolerance class
-is quant.INT8_TOL for int8-vs-float comparisons and the standard tier
-tolerances for pallas-vs-xla of the SAME quantized op.
+is quant.INT8_TOL (int8-vs-float) / quant.FP8_TOL (fp8-vs-float) and
+the standard tier tolerances for pallas-vs-xla of the SAME quantized
+op.
 """
 import os
 import tempfile
@@ -237,6 +238,132 @@ def test_serve_quantize_env_default(monkeypatch):
     try:
         eng = server._registry.entries()[0].engine
         assert eng.quantized == "int8"
+    finally:
+        server.stop()
+
+
+# ----------------------------------------------------------- fp8 tier
+def test_fp8_quantize_per_channel_roundtrip():
+    rng = np.random.RandomState(0)
+    w = rng.randn(16, 64).astype(np.float32) * np.linspace(
+        0.01, 3.0, 16)[:, None]
+    q, s = quant.quantize_per_channel(w, dtype="fp8")
+    assert q.dtype == np.dtype("float8_e4m3fn") and s.shape == (16,)
+    back = np.asarray(quant.dequantize(jnp.asarray(q), jnp.asarray(s)))
+    # e4m3 keeps 3 mantissa bits: relative error <= 2^-4 per element
+    rel = np.abs(back - w) / (np.abs(w) + 1e-9)
+    assert float(rel.max()) <= 2 ** -4
+    zero = np.zeros((4, 8), np.float32)
+    qz, sz = quant.quantize_per_channel(zero, dtype="fp8")
+    assert np.all(np.asarray(qz, np.float32) == 0) and np.all(sz == 1.0)
+
+
+def test_quantize_symbol_fp8_structure():
+    sym = _mlp_symbol()
+    mod = _bound(sym, (4, 16))
+    ap, _ = mod.get_params()
+    qsym, qargs = quant.quantize_symbol(sym, ap, dtype="fp8")
+    ops = {n.op for n in qsym._topo_nodes() if not n.is_variable}
+    assert "QuantizedFullyConnected" in ops
+    assert qargs["f1_weight_q"].dtype == np.dtype("float8_e4m3fn")
+    assert qsym.list_outputs() == sym.list_outputs()
+
+
+def test_quantize_symbol_rejects_unknown_dtype():
+    sym = _mlp_symbol()
+    mod = _bound(sym, (4, 16))
+    ap, _ = mod.get_params()
+    with pytest.raises(mx.base.MXNetError, match="int8 or fp8"):
+        quant.quantize_symbol(sym, ap, dtype="int4")
+
+
+def test_fp8_quantized_outputs_within_tolerance():
+    for sym_fn, shape in ((_mlp_symbol, (4, 16)),
+                          (_convnet_symbol, (4, 3, 8, 8))):
+        sym = sym_fn()
+        mod = _bound(sym, shape)
+        ap, xp = mod.get_params()
+        qsym, qargs = quant.quantize_symbol(sym, ap, dtype="fp8")
+        qmod = mx.mod.Module(qsym, context=mx.cpu())
+        qmod.bind([("data", shape)], [("softmax_label", (shape[0],))],
+                  for_training=False)
+        qmod.init_params(initializer=None, arg_params=qargs,
+                         aux_params=xp)
+        # the fp8 weights bind fp8 CELLS (no silent f32 upcast)
+        wq = qmod._exec_group.executor.arg_dict
+        qnames = [n for n in wq if n.endswith("_q")]
+        assert qnames and all(
+            wq[n].dtype == np.dtype("float8_e4m3fn") for n in qnames)
+        x = np.random.RandomState(1).rand(*shape).astype(np.float32)
+        batch = mx.io.DataBatch([mx.nd.array(x)], [])
+        mod.forward(batch, is_train=False)
+        ref = mod.get_outputs()[0].asnumpy()
+        qmod.forward(batch, is_train=False)
+        got = qmod.get_outputs()[0].asnumpy()
+        assert np.allclose(ref, got, **quant.FP8_TOL)
+
+
+def test_export_quantize_fp8_roundtrip(tmp_path):
+    from mxnet_tpu.predict import export_model, Predictor
+    sym = _mlp_symbol()
+    mod = _bound(sym, (4, 16))
+    ap, xp = mod.get_params()
+    pf = export_model(str(tmp_path / "f.mxp"), sym, ap, xp,
+                      {"data": (4, 16)})
+    pq = export_model(str(tmp_path / "q8.mxp"), sym, ap, xp,
+                      {"data": (4, 16)}, quantize="fp8")
+    assert os.path.getsize(pq) < os.path.getsize(pf)
+    predf, predq = Predictor(pf), Predictor(pq)
+    assert predq.quantize == "fp8"
+    x = np.random.RandomState(2).rand(4, 16).astype(np.float32)
+    of = predf.forward(data=x)[0].asnumpy()
+    oq = predq.forward(data=x)[0].asnumpy()
+    assert np.allclose(of, oq, **quant.FP8_TOL)
+    assert not np.array_equal(of, oq)       # it IS quantized
+
+
+def test_fp8_serve_zero_compiles_and_tolerance():
+    """The fp8 acceptance gate: compile_count() delta == 0 after warmup
+    on the fp8 ladder, outputs within FP8_TOL of the float ladder."""
+    sym = _mlp_symbol()
+    mod = _bound(sym, (8, 16))
+    ap, xp = mod.get_params()
+    server = mx.serve.serve(mod, name="q8f", ladder=[1, 2, 4, 8],
+                            compute_dtype="fp8", start=False)
+    try:
+        eng = server._registry.entries()[0].engine
+        assert eng.quantized == "fp8"
+        assert eng.warmup_compiles > 0
+        x = np.random.RandomState(3).rand(8, 16).astype(np.float32)
+        mark = program_cache.compile_count()
+        outs = []
+        for n in (1, 2, 4, 8):          # every rung stays pinned
+            outs.append(eng.forward(n, {"data": x[:n]})[0].asnumpy())
+        assert program_cache.compile_count() - mark == 0
+        assert eng.compiles_since_warmup() == 0
+        assert server.stats()["models"]["q8f"]["quantized"] == "fp8"
+        batch = mx.io.DataBatch([mx.nd.array(x[:8])], [])
+        fmod = mx.mod.Module(sym, context=mx.cpu())
+        fmod.bind([("data", (8, 16))], [("softmax_label", (8,))],
+                  for_training=False)
+        fmod.init_params(initializer=None, arg_params=ap,
+                         aux_params=xp)
+        fmod.forward(batch, is_train=False)
+        ref = fmod.get_outputs()[0].asnumpy()
+        assert np.allclose(ref, outs[-1], **quant.FP8_TOL)
+    finally:
+        server.stop()
+
+
+def test_serve_quantize_env_fp8(monkeypatch):
+    monkeypatch.setenv("MXNET_SERVE_QUANTIZE", "fp8")
+    sym = _mlp_symbol()
+    mod = _bound(sym, (4, 16))
+    server = mx.serve.serve(mod, name="envq8", ladder=[1, 4],
+                            start=False)
+    try:
+        eng = server._registry.entries()[0].engine
+        assert eng.quantized == "fp8"
     finally:
         server.stop()
 

@@ -144,8 +144,8 @@ def _with_batch(shapes, batch):
     return {nm: (batch,) + tuple(s[1:]) for nm, s in shapes.items()}
 
 
-def _quantized(build, shapes):
-    """Int8 quant-rewrite of one corpus model (convnets/mlps only)."""
+def _quantized(build, shapes, dtype="int8"):
+    """Quant-rewrite of one corpus model (int8 or fp8 storage)."""
     import numpy as np
     from mxnet_tpu.ndarray import NDArray
     from mxnet_tpu.ops.quant import quantize_symbol
@@ -158,13 +158,13 @@ def _quantized(build, shapes):
     args = {nm: NDArray(jnp.zeros(s, np.float32))
             for nm, s in zip(sym.list_arguments(), arg_shapes)
             if nm not in shapes}
-    return quantize_symbol(sym, args)[0]
+    return quantize_symbol(sym, args, dtype=dtype)[0]
 
 
 def run_precision_audit(out, compute_dtypes=("float32", "bfloat16"),
                         as_json=False, quiet=False):
     """QT7xx pass over the bundled models per compute tier, plus the
-    int8 quant-rewritten variants; returns the findings list."""
+    int8 and fp8 quant-rewritten variants; returns the findings list."""
     from mxnet_tpu import analysis
 
     findings = []
@@ -174,6 +174,10 @@ def run_precision_audit(out, compute_dtypes=("float32", "bfloat16"),
         if name.startswith("models/"):
             variants.append((f"{name}@int8",
                              lambda b=build, s=shapes: _quantized(b, s),
+                             None))
+            variants.append((f"{name}@fp8",
+                             lambda b=build, s=shapes: _quantized(
+                                 b, s, dtype="fp8"),
                              None))
         for target, make, cd in variants:
             try:

@@ -70,6 +70,9 @@ VARIANT_PATHS = [
     (("decode_batch", "prefix_hit_rate"), "up"),
     (("spmd", "spmd_vs_kvstore"), "up"),
     (("ckpt", "exposed_ratio"), "down"),
+    (("lm_mfu", "train_mfu_pct"), "up"),
+    (("lm_mfu", "decode_fp8_tokens_per_sec"), "up"),
+    (("lm_mfu", "decode_attn_speedup"), "up"),
 ]
 
 # per-series tolerance overrides (substring match on the series name);
