@@ -12,7 +12,12 @@ static memory planner (``memory_planner``, ME8xx — peak HBM predicted
 before anything compiles; ``memplan.py``). Registration-time siblings:
 ``kernelcheck.py`` validates Pallas kernel specs at ``add_variant``
 (PK9xx), ``envaudit.py`` keeps MXNET_* env reads and docs/env_var.md
-in lockstep.
+in lockstep. Dynamic-behavior passes cover the host plane the serving
+and checkpoint PRs made load-bearing: ``racecheck.py`` (RC2xx
+cross-thread shared-state lint over serve/checkpoint/telemetry/faults),
+``cachekey.py`` (CK3xx program-cache-key completeness against a
+declared knob registry), and ``determinism.py`` (DT4xx replay audit:
+wall-clock seam, global RNG, set-order nondeterminism).
 
 Three surfaces:
 
@@ -36,11 +41,13 @@ from .passes import (AnalysisContext, PASSES, run_passes, lint_symbol,
                      lint_executor, lint_module, lint_json,
                      validate_executor, validate_module, resolve_mode,
                      attr_cache_stable)
-from . import envaudit, kernelcheck, memplan, metricaudit, precision
+from . import (envaudit, kernelcheck, memplan, metricaudit, precision,
+               racecheck, cachekey, determinism)
 
 __all__ = ["Diagnostic", "Report", "RULES", "SEVERITIES",
            "AnalysisContext", "PASSES", "run_passes", "lint_symbol",
            "lint_executor", "lint_module", "lint_json",
            "validate_executor", "validate_module", "resolve_mode",
            "attr_cache_stable", "envaudit", "kernelcheck", "memplan",
-           "metricaudit", "precision"]
+           "metricaudit", "precision", "racecheck", "cachekey",
+           "determinism"]

@@ -1,0 +1,481 @@
+"""Program-cache-key completeness verifier (CK3xx).
+
+The program cache's correctness contract is that its key enumerates
+EVERY knob that changes what a trace computes — and that contract has
+already broken twice: PR 11's remat token leaking across autotune
+selections, and PR 17 retrofitting ``("health", armed)`` into the
+fused-program key after an armed run silently reused an unarmed trace.
+Both were found by accident at runtime. This pass makes the contract a
+declared, statically checked registry instead:
+
+* :data:`KNOBS` declares every shape-affecting knob — its read markers
+  (env literal, dotted accessor, bare identifier), how the key must
+  carry it (a tagged ``("token", value)`` element, a bare ``element``
+  identifier, or coverage through another knob such as the symbol
+  signature), whether it is ``required`` to appear somewhere in the
+  corpus, and whether the kernel-tier ``autotune`` key must carry it
+  too;
+* the pass parses the key-composition corpus (``executor.py``,
+  ``module/executor_group.py``, ``program_cache.py``,
+  ``kernel_tier.py``), finds every *construction scope* (a function
+  that calls ``program_cache_key``, assigns ``_prog_cache_base`` or
+  ``_fused_cache_key``, or extends a key with ``+ ("scan", K)``), and
+  resolves what each scope's key actually contains — including one
+  level of local dataflow (``extras = (...)`` feeding
+  ``program_cache_key(kind, *extras)``) and key inheritance (the scan
+  key extends the fused key, which calls ``program_cache_key``, which
+  appends ``_prog_cache_base``).
+
+Rules:
+
+* **CK301** — a registered knob is read inside a construction scope but
+  its key token never lands in that scope's (inherited) key — the
+  PR-11/PR-17 bug shape, caught at lint time; also fired corpus-wide
+  when a ``required`` knob appears in no key at all (the knob read at
+  trace time in a different module entirely, e.g. the kernel tier);
+* **CK302** — a tagged key element maps to no registered knob (dead or
+  undeclared key freight: the registry and the key drifted);
+* **CK303** — autotune-key/program-key divergence: a knob the registry
+  marks ``autotune`` is missing from ``kernel_tier._key`` (a winner
+  measured under one setting would leak to another), or the autotune
+  key tags a knob the registry says does not affect it.
+
+The static half is backed by a *runtime* cross-check
+(``test_utils.check_cache_key_knob``): flip each registered knob, run
+the same workload, and assert ``program_cache.compile_count()`` moves
+while an unflipped replay stays at zero compiles.
+
+Adding a knob: docs/analysis.md, "Cache-key registry" how-to.
+
+CLI: ``python tools/mxlint.py --cachekey-audit`` (and inside
+``--check``). Test/CLI-time only — no bind-time cost.
+"""
+from __future__ import annotations
+
+import ast
+import os
+
+__all__ = ["KNOBS", "audit", "CORPUS"]
+
+#: key-composition corpus, relative to mxnet_tpu/
+CORPUS = ("executor.py", os.path.join("module", "executor_group.py"),
+          "program_cache.py", "kernel_tier.py")
+
+#: the declared registry of shape-affecting knobs. ``token``: tag of a
+#: ``("token", value)`` key element; ``element``: identifier(s) whose
+#: presence in the key expression carries the knob; ``covered_by``:
+#: knob rides another's element (graph attrs ride the symbol
+#: signature); ``reads``: markers whose presence in a construction
+#: scope means the knob is read there ("MXNET_*" literals, dotted
+#: accessors matched by suffix, bare identifiers); ``required``: must
+#: appear in at least one key corpus-wide; ``autotune``: must also tag
+#: kernel_tier's autotune key.
+KNOBS = (
+    dict(name="remat_policy", token="remat",
+         reads=("MXNET_REMAT_POLICY", "remat.active", "remat_policy"),
+         required=True, autotune=True,
+         doc="gradient rematerialization policy (none|dots|all)"),
+    dict(name="kernel_tier", token="ktier",
+         reads=("MXNET_KERNEL_TIER", "ktier.mode", "kernel_tier.mode"),
+         required=True,
+         doc="kernel implementation tier (auto|xla|pallas), read at "
+             "trace time by kernel_tier.resolve()"),
+    dict(name="health_armed", token="health",
+         reads=("MXNET_TRAIN_HEALTH", "health.armed", "health_armed"),
+         required=True,
+         doc="training-health plane arming (extra in-program stat ys)"),
+    dict(name="comm_plan", token="comm",
+         reads=("zero_armed",), required=True,
+         doc="collective plan: replicated all-reduce vs ZeRO "
+             "reduce-scatter"),
+    dict(name="scan_length", token="scan", reads=(), required=True,
+         doc="steps_per_dispatch K of the scan-fused train step"),
+    dict(name="keep_grads", element=("keep_grads",),
+         reads=("MXNET_FUSED_KEEP_GRADS",), required=True,
+         doc="gradients materialized as fused-program outputs"),
+    dict(name="optimizer_plan", element=("fused_plan_token",),
+         reads=(), required=True,
+         doc="optimizer update rule + hyper-structure token"),
+    dict(name="watched_params", element=("watched", "_watched"),
+         reads=(), required=True,
+         doc="the watched (grad-taking) parameter set"),
+    dict(name="metric_pairs", element=("metric_pairs",),
+         reads=(), required=True,
+         doc="(output, label) pairings of the in-program metrics"),
+    dict(name="compute_dtype", element=("compute_dtype",),
+         reads=(), required=True,
+         doc="compute dtype tier (f32/bf16/quantized serving tiers)"),
+    dict(name="mesh_axes", element=("_mesh_token",),
+         reads=(), required=True,
+         doc="SpmdPlan mesh axes/shape token (data/model partitioning)"),
+    dict(name="layout_opt", element=("layout_opt_enabled",),
+         reads=(), required=True,
+         doc="layout-optimization pass arming"),
+    dict(name="device_type", element=("device_type",),
+         reads=(), required=True,
+         doc="bound device type (cpu/gpu/tpu trace targets differ)"),
+    dict(name="remat_segments", element=("_remat_segments",),
+         reads=(), required=True,
+         doc="explicit remat segment boundaries of the binding"),
+    dict(name="symbol_signature", element=("symbol_signature",),
+         reads=(), required=True,
+         doc="graph-structure hash: op graph + every op attr"),
+    # graph-attribute knobs: distinct symbols by construction, so they
+    # ride the symbol signature (and the shape tuple) — registered so
+    # the runtime flip check covers them and the registry is the one
+    # complete list
+    dict(name="decode_per_slot", covered_by="symbol_signature",
+         doc="per-slot decode cache layout (get_decode_symbol)"),
+    dict(name="decode_step_len", covered_by="symbol_signature",
+         doc="decode window length S (chunked prefill / verify)"),
+    dict(name="spec_k", covered_by="symbol_signature",
+         doc="speculative proposal depth K (the verify window graph)"),
+    dict(name="cache_dtype", covered_by="symbol_signature",
+         doc="KV-cache storage dtype of the decode graph"),
+)
+
+
+def _knob(d):
+    """Normalized view of one registry row."""
+    return {"name": d["name"], "token": d.get("token"),
+            "element": tuple(d.get("element") or ()),
+            "reads": tuple(d.get("reads") or ()),
+            "required": bool(d.get("required")),
+            "autotune": bool(d.get("autotune")),
+            "covered_by": d.get("covered_by"),
+            "doc": d.get("doc", "")}
+
+
+def _dotted(node):
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _is_name(node, name):
+    return (isinstance(node, ast.Name) and node.id == name) or \
+        (isinstance(node, ast.Attribute) and node.attr == name)
+
+
+def _references(tree, name):
+    return any(_is_name(n, name) for n in ast.walk(tree))
+
+
+class _Scope:
+    """One construction scope's resolved key facts."""
+
+    def __init__(self, fname, func):
+        self.file = fname
+        self.func = func
+        self.name = func.name
+        self.key_exprs = []
+        self.tags = set()
+        self.idents = set()
+        self.mentions = set()       # read-marker surface of the scope
+        self.dotted = set()
+        self.calls_pck = False
+        self.refs_fused = False
+        self.refs_base = False
+        self._collect()
+
+    def _collect(self):
+        func = self.func
+        local_assigns = {}
+        key_arg_names = set()
+        for node in ast.walk(func):
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        local_assigns[t.id] = node.value
+                    if _is_name(t, "_prog_cache_base"):
+                        self.key_exprs.append(node.value)
+                    if _is_name(t, "_fused_cache_key") and \
+                            not isinstance(node.value, ast.Call):
+                        self.key_exprs.append(node.value)
+            if isinstance(node, ast.Call):
+                callee = node.func
+                if isinstance(callee, ast.Attribute) and \
+                        callee.attr == "program_cache_key":
+                    self.calls_pck = True
+                    for arg in node.args:
+                        inner = arg.value if isinstance(
+                            arg, ast.Starred) else arg
+                        self.key_exprs.append(inner)
+                        if isinstance(inner, ast.Name):
+                            key_arg_names.add(inner.id)
+            if isinstance(node, ast.BinOp) and \
+                    isinstance(node.op, ast.Add):
+                if _references(node.left, "_fused_cache_key") or \
+                        _references(node.left, "_prog_cache_base"):
+                    self.key_exprs.append(node.right)
+            # scope read-marker surface
+            if isinstance(node, ast.Constant) and \
+                    isinstance(node.value, str):
+                self.mentions.add(node.value)
+            elif isinstance(node, ast.Name):
+                self.mentions.add(node.id)
+            elif isinstance(node, ast.Attribute):
+                self.mentions.add(node.attr)
+                d = _dotted(node)
+                if d:
+                    self.dotted.add(d)
+        # one level of dataflow: a bare name passed (or starred) into
+        # the key call resolves to its local assignment
+        for nm in key_arg_names:
+            if nm in local_assigns:
+                self.key_exprs.append(local_assigns[nm])
+        self.refs_fused = _references(func, "_fused_cache_key")
+        self.refs_base = _references(func, "_prog_cache_base")
+        for expr in self.key_exprs:
+            for node in ast.walk(expr):
+                if isinstance(node, ast.Tuple) and node.elts and \
+                        isinstance(node.elts[0], ast.Constant) and \
+                        isinstance(node.elts[0].value, str):
+                    self.tags.add(node.elts[0].value)
+                if isinstance(node, ast.Name):
+                    self.idents.add(node.id)
+                elif isinstance(node, ast.Attribute):
+                    self.idents.add(node.attr)
+
+    def reads(self, knob):
+        """Does this scope read the knob (any marker present)?"""
+        for marker in knob["reads"]:
+            if marker.startswith("MXNET_"):
+                if marker in self.mentions:
+                    return True
+            elif "." in marker:
+                if any(d == marker or d.endswith("." + marker) or
+                       d.endswith(marker) for d in self.dotted):
+                    return True
+            else:
+                if marker in self.mentions or \
+                        "_" + marker in self.mentions:
+                    return True
+        return False
+
+
+def _is_construction_scope(func):
+    for node in ast.walk(func):
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "program_cache_key":
+            return True
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if _is_name(t, "_prog_cache_base") or \
+                        _is_name(t, "_fused_cache_key"):
+                    return True
+        if isinstance(node, ast.BinOp) and \
+                isinstance(node.op, ast.Add) and \
+                (_references(node.left, "_fused_cache_key") or
+                 _references(node.left, "_prog_cache_base")):
+            return True
+    return False
+
+
+def _autotune_tags(tree):
+    """Tag set of the ``_key`` autotune-key function, or None."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef) and node.name == "_key":
+            tags = set()
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Tuple) and sub.elts and \
+                        isinstance(sub.elts[0], ast.Constant) and \
+                        isinstance(sub.elts[0].value, str):
+                    tags.add(sub.elts[0].value)
+            return tags
+    return None
+
+
+def _covered(knob, tags, idents, by_name):
+    if knob["token"] is not None and knob["token"] in tags:
+        return True
+    if knob["element"] and any(e in idents for e in knob["element"]):
+        return True
+    cov = knob["covered_by"]
+    if cov is not None and cov in by_name:
+        return _covered(by_name[cov], tags, idents, by_name)
+    return False
+
+
+def audit(repo_root=None, sources=None, knobs=None):
+    """Run the cache-key completeness audit; returns a result dict.
+
+    ``sources`` (name -> source text) replaces the repo corpus for the
+    seeded fixtures; ``knobs`` overrides the registry the same way.
+    ``findings`` carries the CK3xx dicts; ``coverage`` maps each knob
+    to where its key element was found (the registry's receipts).
+    """
+    rows = [_knob(d) for d in (knobs if knobs is not None else KNOBS)]
+    by_name = {k["name"]: k for k in rows}
+    texts = {}
+    if sources is not None:
+        texts = dict(sources)
+    else:
+        for rel in CORPUS:
+            path = os.path.join(repo_root, "mxnet_tpu", rel)
+            try:
+                with open(path) as f:
+                    texts[rel.replace(os.sep, "/")] = f.read()
+            except OSError:
+                continue
+
+    findings = []
+    scopes = []
+    autotune_tags = None
+    autotune_file = None
+    for fname in sorted(texts):
+        try:
+            tree = ast.parse(texts[fname], filename=fname)
+        except SyntaxError as e:
+            findings.append({"target": fname, "rule": "XX001",
+                             "severity": "info", "node": None,
+                             "message": f"cachekey could not parse: {e}",
+                             "hint": None})
+            continue
+        tags = _autotune_tags(tree)
+        if tags is not None:
+            autotune_tags, autotune_file = tags, fname
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)) and \
+                    _is_construction_scope(node):
+                scopes.append(_Scope(fname, node))
+
+    # key inheritance: base -> program_cache_key -> fused -> scan
+    base_tags, base_ids = set(), set()
+    for s in scopes:
+        if any(_is_name(t, "_prog_cache_base")
+               for n in ast.walk(s.func) if isinstance(n, ast.Assign)
+               for t in n.targets):
+            base_tags |= s.tags
+            base_ids |= s.idents
+    pck_tags, pck_ids = set(base_tags), set(base_ids)
+    for s in scopes:
+        if s.name == "program_cache_key":
+            pck_tags |= s.tags
+            pck_ids |= s.idents
+    fused_tags, fused_ids = set(pck_tags), set(pck_ids)
+    for s in scopes:
+        if any(_is_name(t, "_fused_cache_key")
+               for n in ast.walk(s.func) if isinstance(n, ast.Assign)
+               for t in n.targets):
+            fused_tags |= s.tags | (pck_tags if s.calls_pck else set())
+            fused_ids |= s.idents
+
+    def effective(s):
+        tags, idents = set(s.tags), set(s.idents)
+        if s.calls_pck:
+            tags |= pck_tags
+            idents |= pck_ids
+        if s.refs_base:
+            tags |= base_tags
+            idents |= base_ids
+        if s.refs_fused:
+            tags |= fused_tags
+            idents |= fused_ids
+        return tags, idents
+
+    # CK301 (scope form): knob read inside a construction scope whose
+    # key never carries it
+    for s in scopes:
+        tags, idents = effective(s)
+        for knob in rows:
+            if not knob["reads"] or not s.reads(knob):
+                continue
+            if not _covered(knob, tags, idents, by_name):
+                findings.append({
+                    "target": s.file, "rule": "CK301",
+                    "severity": "error", "node": knob["name"],
+                    "line": s.func.lineno,
+                    "message": f"{s.file}:{s.name}() reads "
+                               f"{knob['name']} (markers "
+                               f"{list(knob['reads'])}) while composing "
+                               "a program-cache key that never carries "
+                               "it — a flipped knob would silently "
+                               "reuse a stale program",
+                    "hint": f"add a (\"{knob['token']}\", <value>) "
+                            "element (or the registered element "
+                            "identifier) to the key, or fix the "
+                            "registry row" if knob["token"] else
+                            "add the registered element to the key or "
+                            "fix the registry row"})
+
+    # CK301 (corpus form): a required knob appears in no key anywhere
+    all_tags, all_ids = set(), set()
+    for s in scopes:
+        t, i = effective(s)
+        all_tags |= t
+        all_ids |= i
+    coverage = {}
+    for knob in rows:
+        cov = _covered(knob, all_tags, all_ids, by_name)
+        coverage[knob["name"]] = cov
+        if knob["required"] and not cov:
+            findings.append({
+                "target": "cachekey-registry", "rule": "CK301",
+                "severity": "error", "node": knob["name"], "line": 0,
+                "message": f"registered knob {knob['name']} "
+                           f"({knob['doc'] or 'shape-affecting'}) "
+                           "appears in no program-cache key across "
+                           "the corpus — programs traced under "
+                           "different settings would share a cache "
+                           "entry",
+                "hint": "thread the knob into program_cache_key (or "
+                        "the fused key) where the program is built"})
+
+    # CK302: tagged key elements no registry row declares
+    tokens = {k["token"] for k in rows if k["token"]}
+    for s in scopes:
+        for tag in sorted(s.tags - tokens):
+            findings.append({
+                "target": s.file, "rule": "CK302",
+                "severity": "error", "node": tag,
+                "line": s.func.lineno,
+                "message": f"{s.file}:{s.name}() tags a key element "
+                           f"(\"{tag}\", ...) that no registry knob "
+                           "declares — dead key freight or an "
+                           "undeclared knob",
+                "hint": "register the knob in analysis/cachekey.KNOBS "
+                        "(docs/analysis.md how-to) or drop the "
+                        "element"})
+
+    # CK303: autotune-key / program-key divergence
+    if autotune_tags is not None:
+        for knob in rows:
+            if knob["autotune"] and knob["token"] and \
+                    knob["token"] not in autotune_tags:
+                findings.append({
+                    "target": autotune_file, "rule": "CK303",
+                    "severity": "error", "node": knob["name"],
+                    "line": 0,
+                    "message": f"knob {knob['name']} is registered as "
+                               "autotune-affecting but kernel_tier's "
+                               "_key() never carries its "
+                               f"(\"{knob['token']}\", ...) element — "
+                               "a winner measured under one setting "
+                               "leaks to another",
+                    "hint": "add the element to kernel_tier._key (the "
+                            "PR-11 remat bug shape)"})
+        for tag in sorted(autotune_tags & tokens):
+            owner = next(k for k in rows if k["token"] == tag)
+            if not owner["autotune"]:
+                findings.append({
+                    "target": autotune_file, "rule": "CK303",
+                    "severity": "error", "node": owner["name"],
+                    "line": 0,
+                    "message": f"kernel_tier's _key() carries "
+                               f"(\"{tag}\", ...) but the registry "
+                               f"says {owner['name']} does not affect "
+                               "autotune — registry/key divergence",
+                    "hint": "mark the registry row autotune=True or "
+                            "drop the element from _key"})
+
+    return {"findings": findings, "coverage": coverage,
+            "scopes": [f"{s.file}:{s.name}" for s in scopes],
+            "ok": not findings}

@@ -1,0 +1,232 @@
+"""Determinism/replay audit (DT4xx).
+
+The serve plane's replay contract (record a trace, replay it
+bit-identically), the program cache (same key => same program), and the
+health plane's divergence triage all assume program construction and
+scheduler decisions are deterministic functions of their declared
+inputs. Three nondeterminism sources keep sneaking in:
+
+* **DT401** — wall-clock reads (``time.time`` / ``time.monotonic`` /
+  ``time.perf_counter``) off the injectable-clock seam.  Everything in
+  ``serve/`` must route timing through ``serve.clock`` so replay can
+  substitute the recorded clock; a direct read makes latency-dependent
+  decisions unreplayable.
+* **DT402** — unseeded global-RNG draws (``random.random()``,
+  ``np.random.rand()`` …) inside graph build or scheduler decisions.
+  Sampling must flow through an explicitly seeded generator
+  (``np.random.Generator(PCG64(seed))``, ``jax.random`` keys);
+  module-global draws make two builds of the same symbol differ.
+* **DT403** — iteration over an unordered ``set`` feeding program
+  structure or key order.  ``for x in {...}`` (or ``tuple(set(...))``)
+  hashes differently across processes (PYTHONHASHSEED), so op order —
+  and therefore the traced program and its cache key — changes between
+  runs.  ``sorted(...)`` over the set is the fix and is exempt.
+
+Scope: the replayable serve path (``serve/*.py``, minus ``clock.py``
+which *is* the seam) plus program construction (``executor.py``,
+``module/executor_group.py``, ``program_cache.py``,
+``kernel_tier.py``).  A ``# mxlint: allow(DT40x)`` comment on the line
+suppresses a finding with intent recorded (e.g. a log-only timestamp).
+
+CLI: ``python tools/mxlint.py --determinism-audit`` (and inside
+``--check``). Test/CLI-time only — no bind-time cost.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+__all__ = ["audit", "scan_source", "SCAN_FILES"]
+
+#: scanned files, relative to mxnet_tpu/. serve/ is globbed; clock.py
+#: is the injectable seam itself and is exempt from DT401.
+SCAN_FILES = ("executor.py", os.path.join("module", "executor_group.py"),
+              "program_cache.py", "kernel_tier.py")
+
+_ALLOW_RE = re.compile(r"#\s*mxlint:\s*allow\(\s*(DT4\d\d)\s*\)")
+
+#: wall-clock entry points (DT401). time.sleep is not a clock *read*.
+_CLOCK_FNS = {"time", "monotonic", "perf_counter", "monotonic_ns",
+              "perf_counter_ns", "time_ns"}
+
+#: module-global draw functions of random / numpy.random (DT402).
+_DRAW_FNS = {"random", "randint", "randrange", "uniform", "choice",
+             "choices", "shuffle", "sample", "gauss", "normal",
+             "rand", "randn", "permutation", "standard_normal",
+             "exponential", "poisson", "binomial", "beta", "gamma"}
+
+#: receivers whose draws are module-global state (seeded generator
+#: objects and jax.random are fine and keyed explicitly)
+_GLOBAL_RNG = {"random", "np.random", "numpy.random"}
+
+
+def _dotted(node):
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _is_set_expr(node):
+    """Expression that evaluates to an unordered set."""
+    if isinstance(node, (ast.Set, ast.SetComp)):
+        return True
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Name) \
+            and node.func.id in ("set", "frozenset"):
+        return True
+    if isinstance(node, ast.BinOp) and isinstance(
+            node.op, (ast.BitOr, ast.BitAnd, ast.Sub, ast.BitXor)):
+        # set algebra: s | t, s & t, s - t, s ^ t on set displays
+        return _is_set_expr(node.left) or _is_set_expr(node.right)
+    return False
+
+
+class _Visitor(ast.NodeVisitor):
+    def __init__(self, rel_path, allow, dt401_exempt):
+        self.rel = rel_path
+        self.allow = allow          # line -> set of allowed rules
+        self.dt401_exempt = dt401_exempt
+        self.findings = []
+        self.allowed = []           # suppressed-with-intent records
+        self._sorted_depth = 0
+
+    def _emit(self, rule, node, message, hint):
+        line = getattr(node, "lineno", 0)
+        if rule in self.allow.get(line, ()):
+            self.allowed.append({"file": self.rel, "rule": rule,
+                                 "line": line})
+            return
+        self.findings.append({"target": self.rel, "rule": rule,
+                              "severity": "error", "node": None,
+                              "line": line, "message": message,
+                              "hint": None or hint})
+
+    def visit_Call(self, node):
+        d = _dotted(node.func)
+        if d:
+            root, _, leaf = d.rpartition(".")
+            if not self.dt401_exempt and root.split(".")[-1] == "time" \
+                    and leaf in _CLOCK_FNS:
+                self._emit(
+                    "DT401", node,
+                    f"{self.rel}:{node.lineno} reads the wall clock "
+                    f"({d}()) off the injectable-clock seam — "
+                    "replay cannot substitute the recorded time",
+                    "route through serve.clock (now()/monotonic()) or "
+                    "annotate the line `# mxlint: allow(DT401)` for "
+                    "log-only timestamps")
+            if leaf in _DRAW_FNS and root in _GLOBAL_RNG:
+                self._emit(
+                    "DT402", node,
+                    f"{self.rel}:{node.lineno} draws from the "
+                    f"module-global RNG ({d}()) inside graph build or "
+                    "scheduler code — two builds of the same inputs "
+                    "diverge",
+                    "draw from an explicitly seeded "
+                    "np.random.Generator(PCG64(seed)) / jax.random "
+                    "key, or annotate `# mxlint: allow(DT402)`")
+        if isinstance(node.func, ast.Name) and \
+                node.func.id == "sorted":
+            self._sorted_depth += 1
+            self.generic_visit(node)
+            self._sorted_depth -= 1
+            return
+        if isinstance(node.func, ast.Name) and \
+                node.func.id in ("tuple", "list") and node.args and \
+                _is_set_expr(node.args[0]) and not self._sorted_depth:
+            self._emit(
+                "DT403", node,
+                f"{self.rel}:{node.lineno} materializes a set in "
+                "arbitrary iteration order "
+                f"({node.func.id}(set-expr)) — order varies with "
+                "PYTHONHASHSEED and can reach program structure or "
+                "key order",
+                "wrap in sorted(...) so the order is a pure function "
+                "of the contents, or annotate "
+                "`# mxlint: allow(DT403)`")
+        self.generic_visit(node)
+
+    def visit_For(self, node):
+        if _is_set_expr(node.iter):
+            self._emit(
+                "DT403", node,
+                f"{self.rel}:{node.lineno} iterates a set in "
+                "arbitrary order — order varies with PYTHONHASHSEED "
+                "and can reach program structure or key order",
+                "iterate sorted(...) of the set, or annotate "
+                "`# mxlint: allow(DT403)`")
+        self.generic_visit(node)
+
+
+def scan_source(source, rel_path, dt401_exempt=False):
+    """Scan one file's source; returns (findings, allowed)."""
+    try:
+        tree = ast.parse(source, filename=rel_path)
+    except SyntaxError as e:
+        return ([{"target": rel_path, "rule": "XX001",
+                  "severity": "info", "node": None,
+                  "line": getattr(e, "lineno", 0) or 0,
+                  "message": f"determinism audit could not parse: {e}",
+                  "hint": None}], [])
+    allow = {}
+    for i, text in enumerate(source.splitlines(), 1):
+        m = _ALLOW_RE.search(text)
+        if m:
+            allow.setdefault(i, set()).add(m.group(1))
+    v = _Visitor(rel_path, allow, dt401_exempt)
+    v.visit(tree)
+    return v.findings, v.allowed
+
+
+def _corpus(repo_root):
+    pkg = os.path.join(repo_root, "mxnet_tpu")
+    out = []
+    serve = os.path.join(pkg, "serve")
+    if os.path.isdir(serve):
+        for fn in sorted(os.listdir(serve)):
+            if fn.endswith(".py"):
+                out.append((os.path.join("serve", fn),
+                            fn == "clock.py"))
+    for rel in SCAN_FILES:
+        out.append((rel, False))
+    return out
+
+
+def audit(repo_root=None, sources=None):
+    """Run the determinism audit; returns a result dict.
+
+    ``sources`` maps rel_path -> source text for the seeded fixtures
+    (clock.py basenames stay DT401-exempt, matching the real seam).
+    """
+    findings, allowed = [], []
+    files = 0
+    if sources is not None:
+        items = [(rel, os.path.basename(rel) == "clock.py")
+                 for rel in sorted(sources)]
+        read = lambda rel: sources[rel]
+    else:
+        items = _corpus(repo_root)
+        pkg = os.path.join(repo_root, "mxnet_tpu")
+
+        def read(rel):
+            with open(os.path.join(pkg, rel)) as f:
+                return f.read()
+    for rel, exempt in items:
+        try:
+            src = read(rel)
+        except OSError:
+            continue
+        files += 1
+        f, a = scan_source(src, rel.replace(os.sep, "/"),
+                           dt401_exempt=exempt)
+        findings.extend(f)
+        allowed.extend(a)
+    return {"findings": findings, "allowed": allowed,
+            "files_scanned": files,
+            "ok": not [f for f in findings
+                       if f["severity"] == "error"]}

@@ -12,12 +12,15 @@ Rule id scheme (the NNVM-pass analog of compiler warning numbers):
 * ``GV1xx`` — graph verifier (shapes, dtypes, structure)
 * ``DA2xx`` — donation / aliasing hazards
 * ``CO3xx`` — collective dispatch order
+* ``RC2xx`` — host-concurrency race lint (shared state across threads)
 * ``RC4xx`` — retrace / program-cache churn
 * ``HS5xx`` — host synchronization in the fit hot path
 * ``MF6xx`` — MFU/cost-metadata coverage
 * ``QT7xx`` — precision flow (mixed precision + the int8 quant rewrite)
 * ``ME8xx`` — static memory planner (predicted-OOM before compile)
 * ``PK9xx`` — Pallas kernel registration (VMEM/tiling/dtype feasibility)
+* ``CK3xx`` — program-cache-key completeness (knob registry vs. key)
+* ``DT4xx`` — determinism/replay audit (clock, RNG, set order)
 * ``XX0xx`` — analysis-infrastructure notices
 
 Severities: ``error`` (the program is wrong or will crash/deadlock),
@@ -63,6 +66,13 @@ RULES = {
                        "with a dist kvstore reduction"),
     "CO303": ("error", "in-program collective order diverges from the "
                        "parameter declaration order"),
+    # ---- host-concurrency race lint -------------------------------------
+    "RC201": ("error", "shared attribute written cross-thread with no "
+                       "common lock on every access path"),
+    "RC202": ("error", "shared attribute guarded inconsistently (two "
+                       "different locks, no common guard)"),
+    "RC203": ("error", "two locks acquired in opposite orders on "
+                       "different paths (deadlock shape)"),
     # ---- retrace / cache churn -----------------------------------------
     "RC401": ("warning", "op attr value is not cache-key stable "
                          "(identity repr, array, or non-finite float)"),
@@ -109,6 +119,20 @@ RULES = {
                        "alignment (last dim % 128, dtype sublane rows)"),
     "PK903": ("error", "kernel variant declares no (or unsupported) "
                        "dtype coverage for the numerics gate"),
+    # ---- program-cache-key completeness ---------------------------------
+    "CK301": ("error", "shape-affecting knob read during program "
+                       "construction but absent from the cache key"),
+    "CK302": ("error", "tagged cache-key element that no registered "
+                       "knob declares (dead or undeclared freight)"),
+    "CK303": ("error", "autotune-key/program-key divergence for one "
+                       "registered knob"),
+    # ---- determinism / replay audit -------------------------------------
+    "DT401": ("error", "wall-clock read off the injectable-clock seam "
+                       "in the replayable serve path"),
+    "DT402": ("error", "module-global RNG draw inside graph build or "
+                       "scheduler decisions"),
+    "DT403": ("error", "unordered set iteration feeding program "
+                       "structure or cache-key order"),
     # ---- infrastructure -------------------------------------------------
     "XX001": ("info", "an analysis pass failed to run"),
 }

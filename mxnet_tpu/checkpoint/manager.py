@@ -206,6 +206,9 @@ class CheckpointManager:
 
         self._queue = queue.Queue(maxsize=2)
         self._thread = None
+        # guards the writer thread's shared failure state (_error and
+        # the quarantined list) against the caller-side wait()/readers
+        self._state_lock = threading.Lock()
         self._error = None              # first writer failure, for wait()
         self._ticks = 0                 # fit-loop cadence counter
         self._closed = False
@@ -302,9 +305,10 @@ class CheckpointManager:
                 # sweeps its staging dir per attempt)
                 try:
                     seq = item[0]
-                    if self._error is None:
-                        self._error = exc
-                    self.quarantined.append(seq)
+                    with self._state_lock:
+                        if self._error is None:
+                            self._error = exc
+                        self.quarantined.append(seq)
                     _telemetry.counter("ckpt.failures").inc()
                     _telemetry.counter("ckpt.quarantined").inc()
                     _telemetry.flightrec.note(
@@ -420,7 +424,8 @@ class CheckpointManager:
         first writer failure (once)."""
         if self._thread is not None:
             self._queue.join()
-        err, self._error = self._error, None
+        with self._state_lock:
+            err, self._error = self._error, None
         if err is not None:
             raise err
 

@@ -601,7 +601,12 @@ class Executor:
         the fused/scan train steps)."""
         if self._prog_cache_base is None:
             return None
-        return self._prog_cache_base + (kind,) + extras
+        # the kernel tier is read at trace time (kernel_tier.resolve()
+        # inside every op dispatch), so programs traced under different
+        # tiers differ even for an identical graph — it must ride every
+        # key or a flipped MXNET_KERNEL_TIER reuses stale programs
+        return self._prog_cache_base + \
+            (("ktier", _kernel_tier.mode()),) + (kind,) + extras
 
     def _get_program(self, kind):
         from . import remat as _remat

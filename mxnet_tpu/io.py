@@ -346,11 +346,15 @@ class PrefetchingIter(DataIter):
         silent retry of the same cursor), and a failure under the
         ``skip`` policy records and moves on. StopIteration always
         propagates — end-of-epoch is not a failure."""
+        # benign race with reset()'s re-zero: reset() joins the producer
+        # first (so overlap needs a >1s wedged join), and the value is a
+        # GIL-atomic int only this counter's own error path reads — a
+        # lost reset costs one extra counted skip, never control flow
         while True:
             try:
                 batches = [i.next() for i in self.iters]
                 _faults.point("io.decode")
-                self._consecutive_skips = 0
+                self._consecutive_skips = 0  # mxlint: guarded-by(gil)
                 return batches
             except StopIteration:
                 raise
@@ -371,12 +375,16 @@ class PrefetchingIter(DataIter):
                         "broken, not the records") from exc
 
     def _producer(self):
+        # _stack_k/_device are GIL-atomic snapshots of caller-side
+        # config (stage()/ensure_device() both restart the producer via
+        # reset() after writing); a stale read can only affect batches
+        # the restart discards with the old queue
         while not self._stop.is_set():
             try:
-                k = self._stack_k
+                k = self._stack_k  # mxlint: guarded-by(gil)
                 if k <= 1:
                     batches = self._next_batches()
-                    if self._device is not None:
+                    if self._device is not None:  # mxlint: guarded-by(gil)
                         batches = [self._to_device(b) for b in batches]
                     self._queue.put(batches)
                     continue

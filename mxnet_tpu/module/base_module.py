@@ -438,7 +438,12 @@ class BaseModule:
         if getattr(self, "_dead_handled", False):
             return                  # the training thread got there
         from ..checkpoint import reexec_survivor
-        self._dead_handled = True
+        # benign race by design: _dead_handled is a GIL-atomic bool
+        # handshake (training thread sets it at a batch boundary, this
+        # watchdog checks after the grace window); the worst overlap is
+        # both sides acting, and re-exec is idempotent on a committed
+        # checkpoint
+        self._dead_handled = True  # mxlint: guarded-by(gil)
         _telemetry.counter("recovery.wedged").inc()
         _telemetry.flightrec.note("recovery.wedged",
                                   ranks=list(dead_ranks),

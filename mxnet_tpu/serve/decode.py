@@ -1151,8 +1151,10 @@ class DecodeScheduler:
             with self._lock:
                 if not self._has_work():
                     break
-            if self._iterate() == 0 and not self._queue:
-                break
+            emitted = self._iterate()
+            with self._lock:
+                if emitted == 0 and not self._queue:
+                    break
             done += 1
         return done
 

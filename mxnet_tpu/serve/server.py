@@ -176,7 +176,11 @@ class InferenceServer:
             name, engine.ladder.sizes, engine.warmup_compiles,
             {b: f"{s * 1e3:.2f}ms" for b, s in est.items()})
         self._registry.add(engine)
-        self._warm_mark = _progcache.compile_count()
+        # benign race: a single int reference swapped atomically under
+        # the GIL; the dispatch-thread reader only subtracts it from a
+        # monotone counter for a gauge, so a stale read skews one
+        # scrape, never control flow
+        self._warm_mark = _progcache.compile_count()  # mxlint: guarded-by(gil)
         # the serving gauges exist from registration (scrapes before the
         # first request see zeros, not absent series)
         _telemetry.gauge("serve.queue.depth", model=name).set(0)
@@ -499,11 +503,14 @@ class InferenceServer:
         if tr.session:
             _trace.record(tr, "serve.decode.session", tr.start_s,
                           resp_end, span_id=tr.root, model=name)
-        # the per-model slowest completed trace (stats() surfaces it)
+        # the per-model slowest completed trace (stats() surfaces it);
+        # the read-compare-write races the caller-thread stats() reader
+        # without the lock
         lat = resp_end - r.arrival
-        worst = self._slowest.get(name)
-        if worst is None or lat > worst[1]:
-            self._slowest[name] = (tr.trace_id, lat)
+        with self._lock:
+            worst = self._slowest.get(name)
+            if worst is None or lat > worst[1]:
+                self._slowest[name] = (tr.trace_id, lat)
 
     # ----------------------------------------------------------- drive modes
     def pump(self, max_dispatches=None):
@@ -601,6 +608,11 @@ class InferenceServer:
             h = _telemetry.get_metric("serve.request.latency.seconds",
                                       model=name)
             rows_v, pad_v = c("serve.rows"), c("serve.padded_rows")
+            with self._lock:
+                worst = self._slowest.get(name)
+            slowest = None if worst is None else {
+                "trace": worst[0],
+                "latency_ms": round(worst[1] * 1e3, 3)}
             models[name] = {
                 "requests": c("serve.requests"),
                 "responses": c("serve.responses"),
@@ -620,9 +632,7 @@ class InferenceServer:
                 # p99 number links to a request you can reconstruct
                 # with telemetry.trace.tree()
                 "p99_trace": None if h is None else h.exemplar(0.99),
-                "slowest_trace": None if name not in self._slowest else {
-                    "trace": self._slowest[name][0],
-                    "latency_ms": round(self._slowest[name][1] * 1e3, 3)},
+                "slowest_trace": slowest,
                 "batch_occupancy": round(rows_v / pad_v, 4)
                 if pad_v else None,
                 "padding_waste_pct": round(100 * (1 - rows_v / pad_v), 2)

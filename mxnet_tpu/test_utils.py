@@ -392,3 +392,39 @@ def check_speed(sym, location=None, ctx=None, N=20, grad_req=None,
         toc = time.time()
         return (toc - tic) / N
     raise ValueError("typ can only be whole or forward")
+
+
+def check_cache_key_knob(builder, flip, restore=None, name="knob"):
+    """Runtime half of the CK3xx cache-key completeness contract
+    (analysis/cachekey.py): assert that one registered shape-affecting
+    knob actually lands in the program-cache key.
+
+    ``builder()`` runs a program-building workload (bind + step).  The
+    check replays it unflipped and requires ZERO new compiles (the key
+    is not over-wide), then applies ``flip()`` (set the env var, swap
+    the symbol, change the dtype) and requires at least one new compile
+    (the key is not under-wide — a flipped knob must not silently reuse
+    a stale program, the PR-11/PR-17 bug class).  ``restore()`` undoes
+    the flip; it runs even when the assertion fails."""
+    from . import program_cache as _progcache
+
+    builder()
+    c0 = _progcache.compile_count()
+    builder()
+    c_replay = _progcache.compile_count()
+    assert c_replay == c0, (
+        f"cache-key check for {name!r}: unflipped replay recompiled "
+        f"({c_replay - c0} new compile(s)) — the key carries something "
+        "that changes between identical runs")
+    try:
+        flip()
+        builder()
+        c_flip = _progcache.compile_count()
+        assert c_flip > c0, (
+            f"cache-key check for {name!r}: flipping the knob added "
+            "zero compiles — the program cache replayed a stale "
+            "program traced under the other setting (knob missing "
+            "from the key)")
+    finally:
+        if restore is not None:
+            restore()

@@ -1,0 +1,643 @@
+"""Dynamic-behavior static-analysis tests: host race lint (RC2xx),
+program-cache-key completeness (CK3xx), determinism/replay audit
+(DT4xx).
+
+Three layers, matching the contract in docs/analysis.md:
+
+* seeded fixtures — one minimal source per rule, each tripping exactly
+  that rule, plus the suppression paths (``guarded-by`` / ``allow``);
+* clean-corpus gates — the real tree must audit clean, the registry
+  must be fully covered, and the rule ids must sit in the catalog;
+* the runtime half of CK3xx — for EVERY registered knob, flip it and
+  prove the program cache recompiles (and replays with zero compiles
+  unflipped).  The static verifier says the knob is *in the key
+  expression*; this battery says the key *actually moves*.
+"""
+import os
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.analysis import RULES, cachekey, determinism, racecheck
+from mxnet_tpu.models.transformer import get_decode_symbol
+from mxnet_tpu.test_utils import check_cache_key_knob
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _mxlint_main():
+    tools = os.path.join(REPO_ROOT, "tools")
+    if tools not in sys.path:
+        sys.path.insert(0, tools)
+    import mxlint
+    return mxlint.main
+
+
+def _rules(findings):
+    return sorted(f["rule"] for f in findings)
+
+
+# ===================================================== RC2xx fixtures
+RC201_SRC = textwrap.dedent("""
+    import threading
+
+    class Pump:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._n = 0
+            self._t = None
+
+        def start(self):
+            self._t = threading.Thread(target=self._loop)
+            self._t.start()
+
+        def _loop(self):
+            self._n += 1
+
+        def poll(self):
+            return self._n
+""")
+
+RC202_SRC = textwrap.dedent("""
+    import threading
+
+    class Pump:
+        def __init__(self):
+            self._a = threading.Lock()
+            self._b = threading.Lock()
+            self._n = 0
+            self._t = None
+
+        def start(self):
+            self._t = threading.Thread(target=self._loop)
+            self._t.start()
+
+        def _loop(self):
+            with self._a:
+                self._n += 1
+
+        def poll(self):
+            with self._b:
+                return self._n
+""")
+
+RC203_SRC = textwrap.dedent("""
+    import threading
+
+    class Pump:
+        def __init__(self):
+            self._a = threading.Lock()
+            self._b = threading.Lock()
+            self._n = 0
+            self._t = None
+
+        def start(self):
+            self._t = threading.Thread(target=self._loop)
+            self._t.start()
+
+        def _loop(self):
+            with self._a:
+                with self._b:
+                    self._n += 1
+
+        def poll(self):
+            with self._b:
+                with self._a:
+                    self._n -= 1
+""")
+
+
+def test_rc201_unguarded_cross_thread_write():
+    res = racecheck.audit(None, sources={"fix.py": RC201_SRC})
+    assert _rules(res["findings"]) == ["RC201"]
+    (f,) = res["findings"]
+    assert f["node"] == "Pump._n" and f["severity"] == "error"
+    assert not res["ok"]
+
+
+def test_rc202_inconsistent_guard():
+    res = racecheck.audit(None, sources={"fix.py": RC202_SRC})
+    assert "RC202" in _rules(res["findings"])
+    assert not any(f["rule"] == "RC201" for f in res["findings"])
+
+
+def test_rc203_lock_order_inversion():
+    res = racecheck.audit(None, sources={"fix.py": RC203_SRC})
+    assert "RC203" in _rules(res["findings"])
+
+
+def test_rc_guarded_by_annotation_suppresses_and_records():
+    src = RC201_SRC.replace("self._n += 1",
+                            "self._n += 1  # mxlint: guarded-by(gil)")
+    res = racecheck.audit(None, sources={"fix.py": src})
+    assert res["ok"], _rules(res["findings"])
+    assert len(res["annotated"]) >= 1
+    assert any("gil" in str(a) for a in res["annotated"])
+
+
+def test_rc_single_threaded_class_is_clean():
+    src = textwrap.dedent("""
+        class Plain:
+            def __init__(self):
+                self._n = 0
+
+            def bump(self):
+                self._n += 1
+    """)
+    res = racecheck.audit(None, sources={"fix.py": src})
+    assert res["ok"], _rules(res["findings"])
+
+
+# ===================================================== CK3xx fixtures
+CK301_SCOPE_SRC = textwrap.dedent("""
+    import os
+
+    class Exec:
+        def build(self):
+            armed = os.environ.get("MXNET_TRAIN_HEALTH") == "1"
+            return self.program_cache_key("fused", ("remat", "none"))
+""")
+
+CK301_SCOPE_KNOBS = (
+    dict(name="health_armed", token="health",
+         reads=("MXNET_TRAIN_HEALTH",), required=False),
+    dict(name="remat_policy", token="remat", reads=(), required=False),
+)
+
+
+def test_ck301_scope_form_knob_read_but_not_keyed():
+    """The PR-17-shape bug: a knob consulted while composing a key that
+    never carries it."""
+    res = cachekey.audit(sources={"executor.py": CK301_SCOPE_SRC},
+                         knobs=CK301_SCOPE_KNOBS)
+    assert _rules(res["findings"]) == ["CK301"]
+    (f,) = res["findings"]
+    assert f["node"] == "health_armed"
+    assert not res["ok"]
+
+
+def test_ck301_scope_form_clean_when_keyed():
+    src = CK301_SCOPE_SRC.replace(
+        '("remat", "none"))', '("remat", "none"), ("health", armed))')
+    res = cachekey.audit(sources={"executor.py": src},
+                         knobs=CK301_SCOPE_KNOBS)
+    assert res["ok"], _rules(res["findings"])
+
+
+CK301_CORPUS_SRC = textwrap.dedent("""
+    class Exec:
+        def build(self):
+            return self.program_cache_key("fwd", ("remat", "none"))
+""")
+
+
+def test_ck301_corpus_form_required_knob_in_no_key():
+    knobs = (dict(name="remat_policy", token="remat", reads=(),
+                  required=True),
+             dict(name="kernel_tier", token="ktier", reads=(),
+                  required=True))
+    res = cachekey.audit(sources={"executor.py": CK301_CORPUS_SRC},
+                         knobs=knobs)
+    assert _rules(res["findings"]) == ["CK301"]
+    (f,) = res["findings"]
+    assert f["target"] == "cachekey-registry"
+    assert f["node"] == "kernel_tier"
+    assert res["coverage"] == {"remat_policy": True, "kernel_tier": False}
+
+
+def test_ck302_undeclared_key_element():
+    src = textwrap.dedent("""
+        class Exec:
+            def build(self, x):
+                return self.program_cache_key("fwd", ("mystery", x))
+    """)
+    knobs = (dict(name="remat_policy", token="remat", reads=(),
+                  required=False),)
+    res = cachekey.audit(sources={"executor.py": src}, knobs=knobs)
+    assert _rules(res["findings"]) == ["CK302"]
+    assert res["findings"][0]["node"] == "mystery"
+
+
+CK303_KEY_SRC = textwrap.dedent("""
+    def _key(op, shapes):
+        return (("op", op), ("shape", tuple(shapes)))
+""")
+
+
+def test_ck303_autotune_key_missing_autotune_knob():
+    knobs = (dict(name="remat_policy", token="remat", reads=(),
+                  required=False, autotune=True),)
+    res = cachekey.audit(sources={"kernel_tier.py": CK303_KEY_SRC},
+                         knobs=knobs)
+    assert _rules(res["findings"]) == ["CK303"]
+    assert res["findings"][0]["node"] == "remat_policy"
+
+
+def test_ck303_autotune_key_carries_non_autotune_knob():
+    src = textwrap.dedent("""
+        def _key(op, mode):
+            return (("op", op), ("ktier", mode))
+    """)
+    knobs = (dict(name="kernel_tier", token="ktier", reads=(),
+                  required=False, autotune=False),)
+    res = cachekey.audit(sources={"kernel_tier.py": src}, knobs=knobs)
+    assert _rules(res["findings"]) == ["CK303"]
+    assert res["findings"][0]["node"] == "kernel_tier"
+
+
+# ===================================================== DT4xx fixtures
+DT401_SRC = textwrap.dedent("""
+    import time
+
+    def admit(queue):
+        deadline = time.time() + 0.5
+        return [q for q in queue if q.t < deadline]
+""")
+
+
+def test_dt401_wall_clock_off_the_seam():
+    res = determinism.audit(sources={"serve/sched.py": DT401_SRC})
+    assert _rules(res["findings"]) == ["DT401"]
+    assert not res["ok"]
+
+
+def test_dt401_clock_module_is_the_seam():
+    res = determinism.audit(sources={"serve/clock.py": DT401_SRC})
+    assert res["ok"], _rules(res["findings"])
+
+
+def test_dt402_global_rng_in_graph_build():
+    src = textwrap.dedent("""
+        import numpy as np
+
+        def init_graph(nodes):
+            return np.random.rand(len(nodes))
+    """)
+    res = determinism.audit(sources={"executor.py": src})
+    assert _rules(res["findings"]) == ["DT402"]
+
+
+def test_dt403_set_iteration_orders_program_structure():
+    src = textwrap.dedent("""
+        def emit(parts):
+            out = []
+            for p in {"a", "b"} | set(parts):
+                out.append(p)
+            return out
+    """)
+    res = determinism.audit(sources={"executor.py": src})
+    assert _rules(res["findings"]) == ["DT403"]
+
+
+def test_dt403_sorted_set_is_clean():
+    src = textwrap.dedent("""
+        def emit(parts):
+            out = []
+            for p in sorted({"a", "b"} | set(parts)):
+                out.append(p)
+            return out
+    """)
+    res = determinism.audit(sources={"executor.py": src})
+    assert res["ok"], _rules(res["findings"])
+
+
+def test_dt_allow_annotation_suppresses_and_records():
+    src = DT401_SRC.replace("time.time()",
+                            "time.time()  # mxlint: allow(DT401)")
+    res = determinism.audit(sources={"serve/sched.py": src})
+    assert res["ok"], _rules(res["findings"])
+    assert len(res["allowed"]) == 1
+
+
+# ======================================= catalog + clean-corpus gates
+def test_rule_catalog_has_dynamic_rules():
+    for rid in ("RC201", "RC202", "RC203", "CK301", "CK302", "CK303",
+                "DT401", "DT402", "DT403"):
+        assert rid in RULES
+        assert RULES[rid][0] == "error"
+
+
+def test_race_audit_full_tree_clean():
+    """Zero-FP gate over the whole package, not just the serve dirs —
+    every remaining cross-thread write is either locked or carries a
+    reviewed guarded-by claim."""
+    res = racecheck.audit(REPO_ROOT, subdirs=("",))
+    assert res["files_scanned"] > 50
+    assert res["ok"], "\n".join(f["message"] for f in res["findings"])
+
+
+def test_cachekey_audit_real_corpus_clean_and_fully_covered():
+    res = cachekey.audit(REPO_ROOT)
+    assert res["ok"], "\n".join(f["message"] for f in res["findings"])
+    uncovered = [k for k, v in res["coverage"].items() if not v]
+    assert not uncovered, uncovered
+    assert set(res["coverage"]) == {k["name"] for k in cachekey.KNOBS}
+
+
+def test_determinism_audit_real_corpus_clean():
+    res = determinism.audit(REPO_ROOT)
+    assert res["files_scanned"] >= 10
+    assert res["ok"], "\n".join(f["message"] for f in res["findings"])
+
+
+def test_mxlint_dynamic_audit_flags_exit_zero(capsys):
+    main = _mxlint_main()
+    assert main(["--race-audit"]) == 0
+    assert main(["--cachekey-audit"]) == 0
+    assert main(["--determinism-audit"]) == 0
+    out = capsys.readouterr().out
+    assert "race-audit" in out
+    assert "cachekey-audit" in out
+    assert "determinism-audit" in out
+
+
+# ========================================== runtime knob-flip battery
+BATCH, CLASSES, FEATS = 4, 3, 6
+
+
+def _mlp(prefix, extra=False):
+    data = mx.sym.var("data")
+    fc1 = mx.sym.FullyConnected(data=data, num_hidden=8,
+                                name=f"{prefix}_fc1")
+    act = mx.sym.Activation(fc1, act_type="relu", name=f"{prefix}_relu1")
+    if extra:
+        act = mx.sym.Activation(act, act_type="tanh",
+                                name=f"{prefix}_tanh")
+    fc2 = mx.sym.FullyConnected(act, num_hidden=CLASSES,
+                                name=f"{prefix}_fc2")
+    return mx.sym.SoftmaxOutput(fc2, name="softmax")
+
+
+def _init_args(prefix):
+    rs = np.random.RandomState(1)
+    return {
+        f"{prefix}_fc1_weight": mx.nd.array(
+            rs.randn(8, FEATS).astype(np.float32) * 0.1),
+        f"{prefix}_fc1_bias": mx.nd.array(np.zeros(8, np.float32)),
+        f"{prefix}_fc2_weight": mx.nd.array(
+            rs.randn(CLASSES, 8).astype(np.float32) * 0.1),
+        f"{prefix}_fc2_bias": mx.nd.array(np.zeros(CLASSES, np.float32)),
+    }
+
+
+def _fit_builder(prefix, cfg):
+    """One-epoch tiny fit; every program-shaping input comes from
+    ``cfg`` so a flip is one dict write."""
+    def build():
+        rs = np.random.RandomState(0)
+        X = rs.rand(2 * BATCH, FEATS).astype(np.float32)
+        y = rs.randint(0, CLASSES, (2 * BATCH,)).astype(np.float32)
+        mx.random.seed(7)
+        it = mx.io.NDArrayIter(X, y, batch_size=BATCH)
+        ctxs = [mx.cpu(i) for i in range(cfg.get("n_ctx", 1))]
+        mod = mx.mod.Module(_mlp(prefix, extra=cfg.get("extra", False)),
+                            context=ctxs if len(ctxs) > 1 else ctxs[0],
+                            compute_dtype=cfg.get("compute_dtype"),
+                            fixed_param_names=cfg.get("fixed"))
+        mod.fit(it, num_epoch=1,
+                steps_per_dispatch=cfg.get("K", 1),
+                zero_stage=cfg.get("zero", 0),
+                health=cfg.get("health"),
+                arg_params={k: v.copy()
+                            for k, v in _init_args(prefix).items()},
+                optimizer=cfg.get("opt", "sgd"),
+                optimizer_params={"learning_rate": 0.05},
+                allow_missing=False)
+    return build
+
+
+def _bind_builder(prefix, cfg):
+    """Inference bind + forward; exercises the base-key knobs that
+    don't need a train step."""
+    def build():
+        sym = _mlp(prefix)
+        exe = sym.simple_bind(ctx=cfg.get("ctx") or mx.cpu(),
+                              grad_req="null", data=(BATCH, FEATS))
+        for k, v in _init_args(prefix).items():
+            exe.arg_dict[k][:] = v
+        exe.forward(is_train=False)
+        for o in exe.outputs:
+            o.wait_to_read()
+    return build
+
+
+def _two_head(prefix):
+    data = mx.sym.var("data")
+    fc1 = mx.sym.FullyConnected(data=data, num_hidden=8,
+                                name=f"{prefix}_fc1")
+    act = mx.sym.Activation(fc1, act_type="relu", name=f"{prefix}_relu1")
+    h1 = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(act, num_hidden=CLASSES,
+                              name=f"{prefix}_h1fc"), name="h1")
+    h2 = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(act, num_hidden=CLASSES,
+                              name=f"{prefix}_h2fc"), name="h2")
+    return mx.sym.Group([h1, h2])
+
+
+# decode symbols are memoized: rebuilding mutates the auto-naming
+# counters, so only an identical *object* replays with zero compiles —
+# exactly how the serving path holds one symbol per config
+_DECODE_SYMS = {}
+
+
+def _decode_sym(cfg):
+    key = tuple(sorted((k, str(v)) for k, v in cfg.items()))
+    if key not in _DECODE_SYMS:
+        _DECODE_SYMS[key] = get_decode_symbol(
+            vocab_size=16, d_model=8, n_layer=1, n_head=2, capacity=8,
+            per_slot=cfg["per_slot"], step_len=cfg["step_len"],
+            cache_dtype=cfg["cache_dtype"], name=cfg["name"])
+    return _DECODE_SYMS[key]
+
+
+def _decode_builder(cfg):
+    def build():
+        exe = _decode_sym(cfg).simple_bind(
+            ctx=mx.cpu(), grad_req="null", data=(2, cfg["step_len"]))
+        exe.forward(is_train=False)
+        for o in exe.outputs:
+            o.wait_to_read()
+    return build
+
+
+def _env_flip(var, val):
+    def flip():
+        os.environ[var] = val
+
+    def restore():
+        os.environ.pop(var, None)
+    return flip, restore
+
+
+def _set_flip(cfg, key, val):
+    def flip():
+        cfg[key] = val
+    return flip
+
+
+# name -> zero-arg factory returning (builder, flip, restore|None);
+# keys must cover cachekey.KNOBS exactly (asserted below)
+FLIPS = {}
+
+
+def _case(name):
+    def deco(fn):
+        FLIPS[name] = fn
+        return fn
+    return deco
+
+
+@_case("remat_policy")
+def _flip_remat():
+    f, r = _env_flip("MXNET_REMAT_POLICY", "dots")
+    return _fit_builder("kf_remat", {}), f, r
+
+
+@_case("kernel_tier")
+def _flip_ktier():
+    f, r = _env_flip("MXNET_KERNEL_TIER", "xla")
+    return _fit_builder("kf_ktier", {}), f, r
+
+
+@_case("keep_grads")
+def _flip_keep_grads():
+    f, r = _env_flip("MXNET_FUSED_KEEP_GRADS", "1")
+    return _fit_builder("kf_kg", {}), f, r
+
+
+@_case("health_armed")
+def _flip_health():
+    cfg = {}
+    return _fit_builder("kf_health", cfg), _set_flip(cfg, "health", True), \
+        None
+
+
+@_case("scan_length")
+def _flip_scan():
+    cfg = {}
+    return _fit_builder("kf_scan", cfg), _set_flip(cfg, "K", 2), None
+
+
+@_case("optimizer_plan")
+def _flip_opt():
+    cfg = {}
+    return _fit_builder("kf_opt", cfg), _set_flip(cfg, "opt", "adam"), None
+
+
+@_case("compute_dtype")
+def _flip_dtype():
+    cfg = {}
+    return _fit_builder("kf_dtype", cfg), \
+        _set_flip(cfg, "compute_dtype", "bfloat16"), None
+
+
+@_case("watched_params")
+def _flip_watched():
+    cfg = {}
+    return _fit_builder("kf_watch", cfg), \
+        _set_flip(cfg, "fixed", ["kf_watch_fc1_bias"]), None
+
+
+@_case("comm_plan")
+def _flip_comm():
+    # two virtual CPU devices (conftest forces 8) so ZeRO actually arms
+    cfg = {"n_ctx": 2}
+    return _fit_builder("kf_zero", cfg), _set_flip(cfg, "zero", 1), None
+
+
+@_case("symbol_signature")
+def _flip_symbol():
+    cfg = {}
+    return _fit_builder("kf_sym", cfg), _set_flip(cfg, "extra", True), None
+
+
+@_case("mesh_axes")
+def _flip_mesh():
+    cfg = {}
+    return _bind_builder("kb_mesh", cfg), \
+        _set_flip(cfg, "ctx", mx.cpu(1)), None
+
+
+@_case("device_type")
+def _flip_device_type():
+    # cpu_pinned maps to the same jax device but is a distinct
+    # Context type string — the cheapest honest device_type flip
+    cfg = {}
+    return _bind_builder("kb_devt", cfg), \
+        _set_flip(cfg, "ctx", mx.Context("cpu_pinned", 0)), None
+
+
+@_case("layout_opt")
+def _flip_layout():
+    f, r = _env_flip("MXNET_NHWC_LAYOUT", "0")
+    return _bind_builder("kb_layout", {}), f, r
+
+
+@_case("remat_segments")
+def _flip_mirror():
+    f, r = _env_flip("MXNET_BACKWARD_DO_MIRROR", "1")
+    return _bind_builder("kb_mirror", {}), f, r
+
+
+@_case("metric_pairs")
+def _flip_metric_pairs():
+    # the (output, label) pairing follows the iterator's provide_label
+    # order, so the flip is the label-dict order
+    cfg = {"order": ("h1_label", "h2_label")}
+
+    def build():
+        rs = np.random.RandomState(0)
+        X = rs.rand(2 * BATCH, FEATS).astype(np.float32)
+        y = rs.randint(0, CLASSES, (2 * BATCH,)).astype(np.float32)
+        mx.random.seed(7)
+        it = mx.io.NDArrayIter(X, {nm: y for nm in cfg["order"]},
+                               batch_size=BATCH)
+        mod = mx.mod.Module(_two_head("kf_met"), context=mx.cpu(),
+                            label_names=["h1_label", "h2_label"])
+        mod.fit(it, num_epoch=1, optimizer="sgd",
+                optimizer_params={"learning_rate": 0.05})
+    return build, _set_flip(cfg, "order", ("h2_label", "h1_label")), None
+
+
+@_case("decode_per_slot")
+def _flip_per_slot():
+    cfg = {"per_slot": False, "step_len": 1, "cache_dtype": None,
+           "name": "kd_ps"}
+    return _decode_builder(cfg), _set_flip(cfg, "per_slot", True), None
+
+
+@_case("decode_step_len")
+def _flip_step_len():
+    cfg = {"per_slot": True, "step_len": 1, "cache_dtype": None,
+           "name": "kd_sl"}
+    return _decode_builder(cfg), _set_flip(cfg, "step_len", 2), None
+
+
+@_case("spec_k")
+def _flip_spec_k():
+    # the speculative verify window IS a step_len-K window graph
+    cfg = {"per_slot": True, "step_len": 3, "cache_dtype": None,
+           "name": "kd_sk"}
+    return _decode_builder(cfg), _set_flip(cfg, "step_len", 4), None
+
+
+@_case("cache_dtype")
+def _flip_cache_dtype():
+    cfg = {"per_slot": True, "step_len": 1, "cache_dtype": None,
+           "name": "kd_cd"}
+    return _decode_builder(cfg), \
+        _set_flip(cfg, "cache_dtype", "bfloat16"), None
+
+
+def test_flip_battery_covers_every_registered_knob():
+    assert set(FLIPS) == {k["name"] for k in cachekey.KNOBS}
+
+
+@pytest.mark.parametrize("knob", sorted(FLIPS))
+def test_cache_key_knob_flip(knob):
+    builder, flip, restore = FLIPS[knob]()
+    check_cache_key_knob(builder, flip, restore, name=knob)

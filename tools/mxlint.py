@@ -301,6 +301,57 @@ def run_metric_audit(out, as_json=False, quiet=False):
     return findings
 
 
+def run_race_audit(out, as_json=False, quiet=False):
+    """RC2xx host-concurrency lint over serve/checkpoint/telemetry/
+    faults; returns the findings (error severity, so the CI gate
+    enforces zero unannotated)."""
+    from mxnet_tpu.analysis import racecheck
+
+    result = racecheck.audit(_REPO_ROOT)
+    if as_json:
+        json.dump(result, out, indent=2)
+        print(file=out)
+    elif not quiet:
+        print(f"  race-audit: {result['files_scanned']} files, "
+              f"{len(result['findings'])} finding(s), "
+              f"{len(result['annotated'])} guarded-by annotation(s)",
+              file=out)
+    return result["findings"]
+
+
+def run_cachekey_audit(out, as_json=False, quiet=False):
+    """CK3xx program-cache-key completeness verifier; returns the
+    findings."""
+    from mxnet_tpu.analysis import cachekey
+
+    result = cachekey.audit(_REPO_ROOT)
+    if as_json:
+        json.dump(result, out, indent=2)
+        print(file=out)
+    elif not quiet:
+        covered = sum(1 for v in result["coverage"].values() if v)
+        print(f"  cachekey-audit: {len(result['scopes'])} key "
+              f"construction scope(s), {covered}/"
+              f"{len(result['coverage'])} registered knobs covered, "
+              f"{len(result['findings'])} finding(s)", file=out)
+    return result["findings"]
+
+
+def run_determinism_audit(out, as_json=False, quiet=False):
+    """DT4xx determinism/replay audit; returns the findings."""
+    from mxnet_tpu.analysis import determinism
+
+    result = determinism.audit(_REPO_ROOT)
+    if as_json:
+        json.dump(result, out, indent=2)
+        print(file=out)
+    elif not quiet:
+        print(f"  determinism-audit: {result['files_scanned']} files, "
+              f"{len(result['findings'])} finding(s), "
+              f"{len(result['allowed'])} allow annotation(s)", file=out)
+    return result["findings"]
+
+
 def run_check(out, as_json=False):
     """Lint the bundled corpus; returns the merged findings list."""
     from mxnet_tpu import analysis
@@ -401,6 +452,21 @@ def main(argv=None):
                    help="audit recorded metric names against the "
                         "docs/telemetry.md Metric catalog (both "
                         "directions)")
+    p.add_argument("--race-audit", action="store_true",
+                   dest="race_audit",
+                   help="RC2xx host-concurrency lint over serve/, "
+                        "checkpoint/, telemetry/, faults/ (cross-thread "
+                        "shared state without a common guard)")
+    p.add_argument("--cachekey-audit", action="store_true",
+                   dest="cachekey_audit",
+                   help="CK3xx program-cache-key completeness: the "
+                        "declared knob registry vs. the actual key "
+                        "composition")
+    p.add_argument("--determinism-audit", action="store_true",
+                   dest="determinism_audit",
+                   help="DT4xx determinism/replay audit: wall-clock off "
+                        "the injectable seam, global RNG draws, "
+                        "unordered set iteration")
     p.add_argument("--json", action="store_true", dest="as_json",
                    help="emit findings as one JSON document")
     p.add_argument("--strict", action="store_true",
@@ -455,7 +521,8 @@ def main(argv=None):
         return 1 if partial else 0
 
     audit_mode = args.precision_audit or args.memory_plan or \
-        args.env_audit or args.metric_audit
+        args.env_audit or args.metric_audit or args.race_audit or \
+        args.cachekey_audit or args.determinism_audit
     if not args.check and not args.paths and not audit_mode:
         p.print_usage(file=sys.stderr)
         print("mxlint: nothing to lint (pass symbol JSON paths or "
@@ -482,6 +549,11 @@ def main(argv=None):
                 capacity_gb=args.capacity_gb, quiet=args.as_json)
             findings += run_env_audit(out, quiet=args.as_json)
             findings += run_metric_audit(out, quiet=args.as_json)
+            # the dynamic-behavior passes: host races, cache-key
+            # completeness, determinism — all pure-AST, no bind cost
+            findings += run_race_audit(out, quiet=args.as_json)
+            findings += run_cachekey_audit(out, quiet=args.as_json)
+            findings += run_determinism_audit(out, quiet=args.as_json)
         if args.precision_audit:
             dtypes = tuple(
                 d.strip() for d in
@@ -506,6 +578,12 @@ def main(argv=None):
             findings += run_env_audit(out, as_json=args.as_json)
         if args.metric_audit:
             findings += run_metric_audit(out, as_json=args.as_json)
+        if args.race_audit:
+            findings += run_race_audit(out, as_json=args.as_json)
+        if args.cachekey_audit:
+            findings += run_cachekey_audit(out, as_json=args.as_json)
+        if args.determinism_audit:
+            findings += run_determinism_audit(out, as_json=args.as_json)
         for path in args.paths:
             findings += lint_path(path, shapes, out, as_json=args.as_json)
     except FileNotFoundError as e:
